@@ -1,0 +1,3 @@
+from . import types, validation
+from .types import *  # noqa: F401,F403
+from .validation import ValidationError, validate_spec  # noqa: F401

@@ -1,0 +1,325 @@
+"""TPUJob API types — the resource users submit to run allreduce-style
+distributed training on TPU slices.
+
+This is the TPU-native analogue of the reference MPIJob CRD. It merges the
+*served* v1alpha1 surface (reference pkg/apis/kubeflow/v1alpha1/types.go:25-130)
+with the strictly-richer v1alpha2 status/condition model (reference
+pkg/apis/kubeflow/v1alpha2/common_types.go:23-156), because the latter is the
+direction the reference was heading (it defines but never reconciles it).
+
+Key translation decisions (see SURVEY.md §7):
+  - ``gpus`` / ``gpusPerNode`` / ``nvidia.com/gpu``  →  ``tpus`` /
+    ``tpusPerWorker`` / ``google.com/tpu`` with v5e slice-shape validation.
+  - hostfile + ``slots=``                            →  worker-hostnames
+    discovery data consumed by ``jax.distributed.initialize``.
+  - launcher runs ``mpirun``                         →  launcher is a thin
+    coordinator (rank 0); workers run the training process directly.
+
+Everything is a plain frozen-ish dataclass: the in-memory API server
+(`mpi_operator_tpu.cluster`) stores deep copies, exactly as the reference's
+client-go caches require DeepCopy-before-mutate
+(mpi_job_controller.go:762-765).
+"""
+from __future__ import annotations
+
+import copy
+import dataclasses
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+# ---------------------------------------------------------------------------
+# Constants mirroring the reference's well-known strings
+# ---------------------------------------------------------------------------
+
+GROUP_NAME = "tpu.kubeflow.org"          # ref: pkg/apis/kubeflow/v1alpha1/register.go:23-27
+API_VERSION = "v1alpha1"
+KIND = "TPUJob"
+PLURAL = "tpujobs"
+
+# Processing-resource types (ref types.go:64-69 uses nvidia.com/gpu|cpu).
+RESOURCE_TPU = "google.com/tpu"
+RESOURCE_CPU = "cpu"
+
+DEFAULT_BACKOFF_LIMIT = 6                # ref types.go:79-83 (OnFailure default 6)
+DEFAULT_SLOTS_PER_WORKER = 1             # ref mpi_job_controller.go:861-868
+
+# Valid single-slice chip counts for v5e (host granularity 4 chips; slices of
+# 1/2/4 are sub-host). The reference CRD constrains gpus to 1,2,4 or multiples
+# of 8 via openAPIV3 oneOf (deploy/0-crd.yaml:27-35); on TPU the analogous
+# admission rule is "a valid slice shape", which we enforce at validation time
+# rather than at runtime (SURVEY.md §7 "Hard parts").
+V5E_VALID_SLICE_CHIPS = (1, 2, 4, 8, 16, 32, 64, 128, 256)
+
+
+# ---------------------------------------------------------------------------
+# Object metadata (apimachinery-equivalent, minimal)
+# ---------------------------------------------------------------------------
+
+@dataclass
+class OwnerReference:
+    """ref: metav1.OwnerReference as set by NewControllerRef
+    (mpi_job_controller.go:876-878 and six sibling sites)."""
+    api_version: str
+    kind: str
+    name: str
+    uid: str
+    controller: bool = True
+    block_owner_deletion: bool = True
+
+
+@dataclass
+class ObjectMeta:
+    name: str = ""
+    namespace: str = "default"
+    uid: str = ""
+    resource_version: int = 0
+    labels: Dict[str, str] = field(default_factory=dict)
+    annotations: Dict[str, str] = field(default_factory=dict)
+    owner_references: List[OwnerReference] = field(default_factory=list)
+    creation_timestamp: Optional[float] = None
+    deletion_timestamp: Optional[float] = None
+
+    def controller_ref(self) -> Optional[OwnerReference]:
+        for ref in self.owner_references:
+            if ref.controller:
+                return ref
+        return None
+
+
+def is_controlled_by(obj_meta: ObjectMeta, owner_meta: ObjectMeta) -> bool:
+    """ref: metav1.IsControlledBy — ownership checks guard every getOrCreate*
+    (e.g. mpi_job_controller.go:641-645)."""
+    ref = obj_meta.controller_ref()
+    return ref is not None and ref.uid == owner_meta.uid
+
+
+# ---------------------------------------------------------------------------
+# Pod template (simplified PodTemplateSpec)
+# ---------------------------------------------------------------------------
+
+@dataclass
+class Container:
+    name: str = "tpu"
+    image: str = ""
+    command: List[str] = field(default_factory=list)
+    args: List[str] = field(default_factory=list)
+    env: Dict[str, str] = field(default_factory=dict)
+    # resource limits, keyed by resource name (e.g. "google.com/tpu": 4)
+    limits: Dict[str, int] = field(default_factory=dict)
+    requests: Dict[str, int] = field(default_factory=dict)
+    volume_mounts: List[Dict[str, str]] = field(default_factory=list)
+
+    def copy(self) -> "Container":
+        return copy.deepcopy(self)
+
+
+@dataclass
+class PodTemplateSpec:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    containers: List[Container] = field(default_factory=lambda: [Container()])
+    init_containers: List[Container] = field(default_factory=list)
+    restart_policy: str = "OnFailure"
+    node_selector: Dict[str, str] = field(default_factory=dict)
+    volumes: List[Dict[str, str]] = field(default_factory=list)
+
+    def main_container(self) -> Container:
+        if not self.containers:
+            raise ValueError("pod template has no containers")
+        return self.containers[0]
+
+
+# ---------------------------------------------------------------------------
+# TPUJob spec — sizing modes mirror v1alpha1 (ref types.go:36-100)
+# ---------------------------------------------------------------------------
+
+@dataclass
+class TPUJobSpec:
+    """Exactly one of (tpus, processing_units, replicas) must be set — the
+    reference enforces this with an openAPIV3 oneOf (deploy/0-crd.yaml:16-99);
+    we enforce it in api.validation.validate_spec.
+
+    Mode A ("auto-allocation", ref mpi_job_controller.go:547-582): the user
+    gives a total chip count; the controller divides by the per-worker count
+    to get the worker replica count.
+
+    Mode B ("custom", ref mpi_job_controller.go:584-593): the user gives an
+    explicit replica count and puts per-worker resource limits on the pod
+    template's container.
+    """
+    # --- Mode A: total accelerator count -----------------------------------
+    tpus: Optional[int] = None                 # ref: spec.gpus (types.go:38-44)
+    tpus_per_worker: Optional[int] = None      # ref: spec.gpusPerNode (types.go:46-50)
+    # generic processing-unit surface (ref types.go:52-69)
+    processing_units: Optional[int] = None
+    processing_units_per_worker: Optional[int] = None
+    processing_resource_type: Optional[str] = None   # RESOURCE_TPU | RESOURCE_CPU
+    # --- Mode B: explicit replicas -----------------------------------------
+    replicas: Optional[int] = None             # ref: types.go:96-100
+
+    # ranks per worker written into discovery data (ref: slotsPerWorker,
+    # types.go:71-74; hostfile "slots=" mpi_job_controller.go:857-869). On TPU
+    # this is processes-per-host (usually 1 process driving all local chips).
+    slots_per_worker: Optional[int] = None
+
+    # TPU slice topology hint, e.g. "4x8" for v5e-32. Optional; used for node
+    # selectors in the worker set. (TPU-native extension; SURVEY.md §7.)
+    slice_topology: Optional[str] = None
+    # Accelerator generation for node selection, e.g. "v5litepod".
+    accelerator_type: str = "v5litepod"
+    # Number of slices (multi-slice DCN training; 1 = single slice).
+    num_slices: int = 1
+
+    # run the launcher on the master/control node (ref types.go:90-94)
+    launcher_on_master: bool = False
+
+    # failure semantics (ref types.go:76-88; precedence documented there:
+    # activeDeadlineSeconds takes precedence over backoffLimit)
+    backoff_limit: Optional[int] = None
+    active_deadline_seconds: Optional[int] = None
+
+    # gang scheduling opt-in recorded per job (operator flag in the reference,
+    # cmd/mpi-operator/main.go:112-113)
+    gang_scheduling: bool = False
+
+    # the worker pod template (ref types.go:99 Template)
+    template: PodTemplateSpec = field(default_factory=PodTemplateSpec)
+
+    # clean-pod policy from v1alpha2 (ref v1alpha2/types.go:55-66):
+    # "Running" | "All" | "None". The v1alpha1 controller behaves like
+    # "Running" (workers scaled to 0 on done, mpi_job_controller.go:594-596).
+    clean_pod_policy: str = "Running"
+
+
+# ---------------------------------------------------------------------------
+# Status — v1alpha2 condition model (ref common_types.go:23-156)
+# ---------------------------------------------------------------------------
+
+# ref common_types.go:101-127
+COND_CREATED = "Created"
+COND_RUNNING = "Running"
+COND_RESTARTING = "Restarting"
+COND_SUCCEEDED = "Succeeded"
+COND_FAILED = "Failed"
+
+# v1alpha1 launcher status surface kept for parity (ref types.go:102-116)
+LAUNCHER_ACTIVE = "Active"
+LAUNCHER_SUCCEEDED = "Succeeded"
+LAUNCHER_FAILED = "Failed"
+
+
+@dataclass
+class JobCondition:
+    """ref: common_types.go:24-48."""
+    type: str
+    status: str = "True"              # "True" | "False" | "Unknown"
+    reason: str = ""
+    message: str = ""
+    last_update_time: float = field(default_factory=time.time)
+    last_transition_time: float = field(default_factory=time.time)
+
+
+@dataclass
+class ReplicaStatus:
+    """ref: common_types.go:68-80."""
+    active: int = 0
+    succeeded: int = 0
+    failed: int = 0
+
+
+@dataclass
+class TPUJobStatus:
+    """Merged v1alpha1 (launcher_status/worker_replicas, ref types.go:102-130)
+    + v1alpha2 (conditions/replica_statuses, ref common_types.go:50-66)."""
+    launcher_status: Optional[str] = None       # LAUNCHER_* (v1alpha1 surface)
+    worker_replicas: int = 0                    # ready workers (types.go:124-126)
+    conditions: List[JobCondition] = field(default_factory=list)
+    replica_statuses: Dict[str, ReplicaStatus] = field(default_factory=dict)
+    start_time: Optional[float] = None
+    completion_time: Optional[float] = None
+
+    # -- condition helpers (ref: v1alpha2 intent; pkg has no impl) ----------
+    def get_condition(self, cond_type: str) -> Optional[JobCondition]:
+        for c in self.conditions:
+            if c.type == cond_type:
+                return c
+        return None
+
+    def set_condition(self, cond: JobCondition) -> None:
+        """Last-writer-wins per type; terminal conditions (Succeeded/Failed)
+        flip Running to False, mirroring common job-controller semantics."""
+        now = time.time()
+        existing = self.get_condition(cond.type)
+        if existing is not None:
+            if existing.status != cond.status or existing.reason != cond.reason:
+                cond.last_transition_time = now
+            else:
+                cond.last_transition_time = existing.last_transition_time
+            self.conditions = [c for c in self.conditions if c.type != cond.type]
+        self.conditions.append(cond)
+        if cond.type in (COND_SUCCEEDED, COND_FAILED) and cond.status == "True":
+            run = self.get_condition(COND_RUNNING)
+            if run is not None and run.status == "True":
+                run.status = "False"
+                run.last_transition_time = now
+
+    def is_done(self) -> bool:
+        for t in (COND_SUCCEEDED, COND_FAILED):
+            c = self.get_condition(t)
+            if c is not None and c.status == "True":
+                return True
+        return False
+
+
+# ---------------------------------------------------------------------------
+# The TPUJob resource
+# ---------------------------------------------------------------------------
+
+@dataclass
+class TPUJob:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: TPUJobSpec = field(default_factory=TPUJobSpec)
+    status: TPUJobStatus = field(default_factory=TPUJobStatus)
+    kind: str = KIND
+    api_version: str = f"{GROUP_NAME}/{API_VERSION}"
+
+    def deepcopy(self) -> "TPUJob":
+        return copy.deepcopy(self)
+
+    def controller_owner_reference(self) -> OwnerReference:
+        """ref: NewControllerRef sites (mpi_job_controller.go:876-878 etc.)."""
+        return OwnerReference(
+            api_version=self.api_version,
+            kind=self.kind,
+            name=self.metadata.name,
+            uid=self.metadata.uid,
+        )
+
+
+def new_tpu_job(name: str, namespace: str = "default", **spec_kwargs) -> TPUJob:
+    """Convenience constructor used by tests and examples."""
+    return TPUJob(
+        metadata=ObjectMeta(name=name, namespace=namespace),
+        spec=TPUJobSpec(**spec_kwargs),
+    )
+
+
+# dataclasses are mutable; provide a module-level deepcopy util the cluster
+# layer uses for store round-trips.
+def deepcopy_obj(obj):
+    return copy.deepcopy(obj)
+
+
+__all__ = [
+    "GROUP_NAME", "API_VERSION", "KIND", "PLURAL",
+    "RESOURCE_TPU", "RESOURCE_CPU",
+    "DEFAULT_BACKOFF_LIMIT", "DEFAULT_SLOTS_PER_WORKER",
+    "V5E_VALID_SLICE_CHIPS",
+    "OwnerReference", "ObjectMeta", "is_controlled_by",
+    "Container", "PodTemplateSpec",
+    "TPUJobSpec", "JobCondition", "ReplicaStatus", "TPUJobStatus", "TPUJob",
+    "COND_CREATED", "COND_RUNNING", "COND_RESTARTING", "COND_SUCCEEDED",
+    "COND_FAILED",
+    "LAUNCHER_ACTIVE", "LAUNCHER_SUCCEEDED", "LAUNCHER_FAILED",
+    "new_tpu_job", "deepcopy_obj",
+]

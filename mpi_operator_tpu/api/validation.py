@@ -1,0 +1,153 @@
+"""Admission-time validation for TPUJob specs.
+
+The reference enforces its invariants in an openAPIV3 schema on the CRD
+(reference deploy/0-crd.yaml:16-99): exactly ONE of the three sizing modes
+(``gpus`` / ``processingUnits`` / ``replicas``) may be set, and ``gpus`` is
+constrained to 1, 2, 4, or a multiple of 8 (deploy/0-crd.yaml:27-35).
+
+The TPU-native analogue enforces the same oneOf discipline plus slice-shape
+validity: an invalid chip count must fail at admission, not at runtime
+(SURVEY.md §7 "Hard parts" — slice-topology allocation math).
+"""
+from __future__ import annotations
+
+from typing import List
+
+from .types import (
+    RESOURCE_CPU,
+    RESOURCE_TPU,
+    TPUJobSpec,
+    V5E_VALID_SLICE_CHIPS,
+)
+
+
+class ValidationError(ValueError):
+    """Raised when a TPUJob spec fails admission validation."""
+
+    def __init__(self, errors: List[str]):
+        self.errors = list(errors)
+        super().__init__("; ".join(self.errors))
+
+
+# Topology strings accepted for v5e slices, keyed by chip count.
+# v5e is a 2D mesh; host granularity is 4 chips (2x2). SURVEY.md §7.
+V5E_TOPOLOGIES = {
+    1: ("1x1",),
+    2: ("1x2", "2x1"),
+    4: ("2x2",),
+    8: ("2x4", "4x2"),
+    16: ("4x4",),
+    32: ("4x8", "8x4"),
+    64: ("8x8",),
+    128: ("8x16", "16x8"),
+    256: ("16x16",),
+}
+
+
+def _valid_tpu_count(n: int) -> bool:
+    """Mirror of the reference's gpus constraint (1, 2, 4, or multiple of 8;
+    deploy/0-crd.yaml:27-35) tightened to valid v5e slice shapes."""
+    return n in V5E_VALID_SLICE_CHIPS
+
+
+def validate_spec(spec: TPUJobSpec) -> None:
+    """Raises ValidationError listing every violation (the reference's schema
+    reports oneOf failure wholesale; we itemize for developer ergonomics)."""
+    errs: List[str] = []
+
+    modes = [
+        spec.tpus is not None,
+        spec.processing_units is not None,
+        spec.replicas is not None,
+    ]
+    if sum(modes) == 0:
+        errs.append(
+            "exactly one of spec.tpus, spec.processingUnits, spec.replicas "
+            "must be set (ref deploy/0-crd.yaml oneOf)"
+        )
+    elif sum(modes) > 1:
+        errs.append(
+            "spec.tpus, spec.processingUnits, spec.replicas are mutually "
+            "exclusive (ref deploy/0-crd.yaml oneOf)"
+        )
+
+    if spec.tpus is not None:
+        if spec.tpus < 1:
+            errs.append(f"spec.tpus must be >= 1, got {spec.tpus}")
+        elif not _valid_tpu_count(spec.tpus):
+            errs.append(
+                f"spec.tpus={spec.tpus} is not a valid v5e slice chip count "
+                f"{V5E_VALID_SLICE_CHIPS}"
+            )
+
+    if spec.processing_units is not None and spec.processing_units < 1:
+        errs.append(f"spec.processingUnits must be >= 1, got {spec.processing_units}")
+
+    if spec.replicas is not None and spec.replicas < 1:
+        errs.append(f"spec.replicas must be >= 1, got {spec.replicas}")
+
+    if spec.tpus_per_worker is not None and spec.tpus_per_worker < 1:
+        errs.append(f"spec.tpusPerWorker must be >= 1, got {spec.tpus_per_worker}")
+
+    if (
+        spec.processing_resource_type is not None
+        and spec.processing_resource_type not in (RESOURCE_TPU, RESOURCE_CPU)
+    ):
+        # ref: cmd/mpi-operator/main.go:108-110 restricts to nvidia.com/gpu|cpu
+        errs.append(
+            f"spec.processingResourceType must be {RESOURCE_TPU!r} or "
+            f"{RESOURCE_CPU!r}, got {spec.processing_resource_type!r}"
+        )
+
+    if spec.slots_per_worker is not None and spec.slots_per_worker < 1:
+        errs.append(f"spec.slotsPerWorker must be >= 1, got {spec.slots_per_worker}")
+
+    if spec.slice_topology is not None:
+        total = spec.tpus or spec.processing_units
+        valid_topos = V5E_TOPOLOGIES.get(total) if total else None
+        if valid_topos is not None and spec.slice_topology not in valid_topos:
+            errs.append(
+                f"spec.sliceTopology={spec.slice_topology!r} does not match "
+                f"{total} chips; valid: {valid_topos}"
+            )
+        elif valid_topos is None and total is not None:
+            errs.append(
+                f"no known v5e topology for {total} chips with an explicit "
+                f"sliceTopology"
+            )
+
+    if spec.num_slices < 1:
+        errs.append(f"spec.numSlices must be >= 1, got {spec.num_slices}")
+
+    if spec.backoff_limit is not None and spec.backoff_limit < 0:
+        errs.append(f"spec.backoffLimit must be >= 0, got {spec.backoff_limit}")
+
+    if (
+        spec.active_deadline_seconds is not None
+        and spec.active_deadline_seconds < 1
+    ):
+        errs.append(
+            f"spec.activeDeadlineSeconds must be >= 1, got "
+            f"{spec.active_deadline_seconds}"
+        )
+
+    if spec.clean_pod_policy not in ("Running", "All", "None"):
+        # ref: v1alpha2/types.go:55-66 CleanPodPolicy
+        errs.append(
+            f"spec.cleanPodPolicy must be Running|All|None, got "
+            f"{spec.clean_pod_policy!r}"
+        )
+
+    if errs:
+        raise ValidationError(errs)
+
+
+def default_topology(chips: int) -> str:
+    """Pick the canonical topology string for a chip count (first entry)."""
+    topos = V5E_TOPOLOGIES.get(chips)
+    if topos is None:
+        raise ValidationError([f"no v5e topology for {chips} chips"])
+    return topos[0]
+
+
+__all__ = ["ValidationError", "validate_spec", "default_topology", "V5E_TOPOLOGIES"]

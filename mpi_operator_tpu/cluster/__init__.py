@@ -1,0 +1,6 @@
+from .apiserver import (  # noqa: F401
+    Action, AlreadyExistsError, ApiError, InMemoryAPIServer, NotFoundError,
+)
+from .informers import Informer, InformerFactory, Lister  # noqa: F401
+from .workqueue import RateLimitingQueue, meta_namespace_key, split_key  # noqa: F401
+from . import resources  # noqa: F401

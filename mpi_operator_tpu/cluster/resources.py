@@ -1,0 +1,134 @@
+"""Dependent-resource types the controller materializes for each TPUJob.
+
+These are the six child kinds the reference reconciler creates
+(reference pkg/controllers/mpi_job_controller.go:849-1236):
+ConfigMap, ServiceAccount, Role, RoleBinding, PodDisruptionBudget,
+StatefulSet (workers), Job (launcher). Modeled as minimal dataclasses —
+just the fields the reconcile loop and tests observe.
+"""
+from __future__ import annotations
+
+import copy
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from ..api.types import Container, ObjectMeta, PodTemplateSpec
+
+
+@dataclass
+class ConfigMap:
+    """ref: newConfigMap (mpi_job_controller.go:849-885) — carried the
+    hostfile + kubexec.sh; ours carries worker discovery data (SURVEY §2.4)."""
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    data: Dict[str, str] = field(default_factory=dict)
+    kind: str = "ConfigMap"
+
+
+@dataclass
+class ServiceAccount:
+    """ref: newLauncherServiceAccount (mpi_job_controller.go:890-901)."""
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    kind: str = "ServiceAccount"
+
+
+@dataclass
+class PolicyRule:
+    """ref: rbacv1.PolicyRule (mpi_job_controller.go:920-933)."""
+    verbs: List[str] = field(default_factory=list)
+    resources: List[str] = field(default_factory=list)
+    resource_names: List[str] = field(default_factory=list)
+    api_groups: List[str] = field(default_factory=lambda: [""])
+
+
+@dataclass
+class Role:
+    """ref: newLauncherRole (mpi_job_controller.go:906-935)."""
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    rules: List[PolicyRule] = field(default_factory=list)
+    kind: str = "Role"
+
+
+@dataclass
+class RoleBinding:
+    """ref: newLauncherRoleBinding (mpi_job_controller.go:940-964)."""
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    role_name: str = ""
+    subject_service_accounts: List[str] = field(default_factory=list)
+    kind: str = "RoleBinding"
+
+
+@dataclass
+class PodDisruptionBudget:
+    """ref: newPDB (mpi_job_controller.go:969-986) — gang scheduling hint
+    (minAvailable = worker replicas) for the batch scheduler."""
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    min_available: int = 0
+    kind: str = "PodDisruptionBudget"
+
+
+@dataclass
+class StatefulSetSpec:
+    replicas: int = 0
+    service_name: str = ""          # headless svc → stable DNS (ref :1079)
+    pod_management_policy: str = "Parallel"   # ref :1074
+    template: PodTemplateSpec = field(default_factory=PodTemplateSpec)
+
+
+@dataclass
+class StatefulSetStatus:
+    ready_replicas: int = 0
+    replicas: int = 0
+
+
+@dataclass
+class StatefulSet:
+    """ref: newWorker (mpi_job_controller.go:1004-1083). Workers get stable
+    DNS names `<job>-worker-<i>` matching the discovery data."""
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: StatefulSetSpec = field(default_factory=StatefulSetSpec)
+    status: StatefulSetStatus = field(default_factory=StatefulSetStatus)
+    kind: str = "StatefulSet"
+
+
+@dataclass
+class JobSpec:
+    template: PodTemplateSpec = field(default_factory=PodTemplateSpec)
+    backoff_limit: int = 6                    # ref :1059-1062
+    active_deadline_seconds: Optional[int] = None   # ref :1221-1222
+
+
+@dataclass
+class JobStatus:
+    active: int = 0
+    succeeded: int = 0
+    failed: int = 0
+    start_time: Optional[float] = None
+    completion_time: Optional[float] = None
+
+
+@dataclass
+class Job:
+    """ref: newLauncher (mpi_job_controller.go:1088-1236) — the batch Job
+    whose completion is the TPUJob's completion signal."""
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: JobSpec = field(default_factory=JobSpec)
+    status: JobStatus = field(default_factory=JobStatus)
+    kind: str = "Job"
+
+    def succeeded(self) -> bool:
+        return self.status.succeeded > 0
+
+    def failed(self) -> bool:
+        return self.status.failed > 0
+
+
+def deepcopy_resource(obj):
+    return copy.deepcopy(obj)
+
+
+__all__ = [
+    "ConfigMap", "ServiceAccount", "PolicyRule", "Role", "RoleBinding",
+    "PodDisruptionBudget", "StatefulSet", "StatefulSetSpec",
+    "StatefulSetStatus", "Job", "JobSpec", "JobStatus", "Container",
+    "deepcopy_resource",
+]

@@ -1,0 +1,136 @@
+"""Rate-limited, deduplicating work queue keyed by "namespace/name".
+
+ref: k8s.io/client-go/util/workqueue as used by the controller
+(mpi_job_controller.go:125-130, :366-415). The contract the controller
+depends on:
+
+  - a key being processed is never handed to a second worker concurrently
+    (dirty/processing set semantics) — this is the reference's entire
+    concurrency-safety story (SURVEY §5 "Race detection");
+  - Add while processing marks dirty → key is re-queued on Done;
+  - AddRateLimited implements per-item exponential backoff;
+  - Forget resets the backoff counter (ref :399-404).
+"""
+from __future__ import annotations
+
+import heapq
+import threading
+import time
+from typing import Dict, List, Optional, Set
+
+
+class RateLimitingQueue:
+    def __init__(self, base_delay: float = 0.005, max_delay: float = 1000.0):
+        self._lock = threading.Condition()
+        self._queue: List[str] = []
+        self._dirty: Set[str] = set()
+        self._processing: Set[str] = set()
+        self._failures: Dict[str, int] = {}
+        self._base_delay = base_delay
+        self._max_delay = max_delay
+        # delayed items: heap of (ready_time, key)
+        self._waiting: List[tuple] = []
+        self._shutting_down = False
+
+    # -- core queue (workqueue.Interface) -----------------------------------
+
+    def add(self, key: str) -> None:
+        with self._lock:
+            if self._shutting_down or key in self._dirty:
+                return
+            self._dirty.add(key)
+            if key not in self._processing:
+                self._queue.append(key)
+                self._lock.notify()
+
+    def get(self, timeout: Optional[float] = None) -> Optional[str]:
+        """Blocks until an item is available; returns None on shutdown or
+        timeout. The caller MUST call done(key) afterwards."""
+        deadline = None if timeout is None else time.monotonic() + timeout
+        with self._lock:
+            while True:
+                self._drain_waiting_locked()
+                if self._queue:
+                    key = self._queue.pop(0)
+                    self._processing.add(key)
+                    self._dirty.discard(key)
+                    return key
+                if self._shutting_down:
+                    return None
+                # Return None only when the CALLER's deadline expired; a due
+                # rate-limited item instead loops back to re-drain.
+                now = time.monotonic()
+                if deadline is not None and now >= deadline:
+                    return None
+                waits = []
+                if self._waiting:
+                    waits.append(self._waiting[0][0] - now)
+                if deadline is not None:
+                    waits.append(deadline - now)
+                wait = min(waits) if waits else None
+                if wait is not None and wait <= 0:
+                    continue
+                self._lock.wait(wait)
+
+    def done(self, key: str) -> None:
+        with self._lock:
+            self._processing.discard(key)
+            if key in self._dirty:
+                self._queue.append(key)
+                self._lock.notify()
+
+    # -- rate limiting (workqueue.RateLimitingInterface) --------------------
+
+    def add_rate_limited(self, key: str) -> None:
+        with self._lock:
+            n = self._failures.get(key, 0)
+            self._failures[key] = n + 1
+            delay = min(self._base_delay * (2 ** n), self._max_delay)
+            heapq.heappush(self._waiting, (time.monotonic() + delay, key))
+            self._lock.notify()
+
+    def forget(self, key: str) -> None:
+        with self._lock:
+            self._failures.pop(key, None)
+
+    def num_requeues(self, key: str) -> int:
+        with self._lock:
+            return self._failures.get(key, 0)
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def shut_down(self) -> None:
+        with self._lock:
+            self._shutting_down = True
+            self._lock.notify_all()
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._queue) + len(self._waiting)
+
+    # -- internal -----------------------------------------------------------
+
+    def _drain_waiting_locked(self) -> None:
+        now = time.monotonic()
+        while self._waiting and self._waiting[0][0] <= now:
+            _, key = heapq.heappop(self._waiting)
+            if key not in self._dirty:
+                self._dirty.add(key)
+                if key not in self._processing:
+                    self._queue.append(key)
+
+
+def split_key(key: str):
+    """ref: cache.SplitMetaNamespaceKey (mpi_job_controller.go:422)."""
+    parts = key.split("/")
+    if len(parts) != 2 or not parts[0] or not parts[1]:
+        raise ValueError(f"invalid resource key: {key!r}")
+    return parts[0], parts[1]
+
+
+def meta_namespace_key(obj) -> str:
+    """ref: cache.MetaNamespaceKeyFunc (mpi_job_controller.go:798-801)."""
+    return f"{obj.metadata.namespace}/{obj.metadata.name}"
+
+
+__all__ = ["RateLimitingQueue", "split_key", "meta_namespace_key"]

@@ -1,0 +1,4 @@
+from .controller import (  # noqa: F401
+    ControllerConfig, Event, EventRecorder, ForeignOwnershipError,
+    TPUJobController,
+)

@@ -1,0 +1,766 @@
+"""TPUJobController — the watch-driven reconciler.
+
+ref: pkg/controllers/mpi_job_controller.go (1,236 LoC, the reference's core).
+This module mirrors its state machine (SURVEY.md §3.2) while replacing every
+GPU/MPI mechanism with the TPU-native counterpart (SURVEY.md §7):
+
+  reference                          this controller
+  ---------                          ---------------
+  hostfile + kubexec.sh ConfigMap    worker-hostnames + coordinator ConfigMap
+  per-job Role: create pods/exec     per-job Role: get pods/configmaps (discovery)
+  kubectl-delivery init container    none needed (env-based bootstrap)
+  launcher runs `mpirun`             launcher = thin coordinator / rank 0
+  workers `sleep 365d`               workers run the training process
+  gpus / nvidia.com/gpu              tpus / google.com/tpu + slice topology
+
+The reconcile loop is level-triggered and idempotent: it re-runs on every
+event and converges desired → actual, refusing to adopt foreign-owned
+children (ref :641-645 and siblings).
+"""
+from __future__ import annotations
+
+import logging
+import threading
+from dataclasses import dataclass, field
+from typing import List, Optional, Tuple
+
+from ..api import types as api
+from ..api.types import (
+    COND_CREATED,
+    COND_FAILED,
+    COND_RUNNING,
+    COND_SUCCEEDED,
+    LAUNCHER_ACTIVE,
+    LAUNCHER_FAILED,
+    LAUNCHER_SUCCEEDED,
+    RESOURCE_CPU,
+    RESOURCE_TPU,
+    Container,
+    ObjectMeta,
+    PodTemplateSpec,
+    TPUJob,
+    is_controlled_by,
+)
+from ..cluster.apiserver import InMemoryAPIServer, NotFoundError
+from ..cluster.informers import InformerFactory
+from ..cluster.resources import (
+    ConfigMap,
+    Job,
+    JobSpec,
+    PodDisruptionBudget,
+    PolicyRule,
+    Role,
+    RoleBinding,
+    ServiceAccount,
+    StatefulSet,
+    StatefulSetSpec,
+)
+from ..cluster.workqueue import RateLimitingQueue, meta_namespace_key, split_key
+
+logger = logging.getLogger("tpujob-controller")
+
+# suffixes / mount paths (ref mpi_job_controller.go:58-78 constants)
+CONFIG_SUFFIX = "-config"
+LAUNCHER_SUFFIX = "-launcher"
+WORKER_SUFFIX = "-worker"
+CONFIG_VOLUME_NAME = "tpu-job-config"
+CONFIG_MOUNT_PATH = "/etc/tpu"          # ref configMountPath "/etc/mpi" (:62)
+COORDINATOR_PORT = 8476                 # jax.distributed default port
+LABEL_GROUP = "tpu_job_name"            # ref "mpi_job_name" label (:1007-1012)
+
+# Kubernetes node-selector keys for TPU slices (GKE conventions).
+NS_ACCELERATOR = "cloud.google.com/gke-tpu-accelerator"
+NS_TOPOLOGY = "cloud.google.com/gke-tpu-topology"
+
+ERR_RESOURCE_EXISTS = "ErrResourceExists"   # ref :88-96
+MSG_RESOURCE_EXISTS = "Resource %s already exists and is not managed by TPUJob"
+
+
+class ForeignOwnershipError(Exception):
+    """Raised when a dependent resource exists but is not controlled by the
+    TPUJob (ref :641-645 — adoption is refused, never forced)."""
+    def __init__(self, kind: str, name: str):
+        self.kind = kind
+        self.name = name
+        super().__init__(MSG_RESOURCE_EXISTS % f"{kind}/{name}")
+
+
+@dataclass
+class Event:
+    """Recorded controller event (ref record.EventRecorder, :169-172)."""
+    type: str       # Normal | Warning
+    reason: str
+    message: str
+
+
+class EventRecorder:
+    """In-memory recorder; the FakeRecorder equivalent the tests use
+    (ref mpi_job_controller_test.go:177)."""
+    def __init__(self):
+        self.events: List[Event] = []
+
+    def event(self, _obj, etype: str, reason: str, message: str) -> None:
+        self.events.append(Event(etype, reason, message))
+
+
+@dataclass
+class ControllerConfig:
+    """Cluster-level flags (ref cmd/mpi-operator/main.go:98-115). Spec fields
+    override these per-job (ref mpi_job_controller.go:447-460)."""
+    tpus_per_worker: int = 4            # ref --gpus-per-node (default 8); v5e host = 4 chips
+    processing_units_per_worker: int = 4
+    processing_resource_type: str = RESOURCE_TPU
+    enable_gang_scheduling: bool = False
+    namespace: Optional[str] = None
+    # ref --kubectl-delivery-image; on TPU an optional discovery init image
+    discovery_image: Optional[str] = None
+
+
+@dataclass
+class AllocationResult:
+    """Output of allocate_processing_units (ref :547-598)."""
+    worker_replicas: int
+    units_per_worker: int
+    resource_type: str
+    slots_per_worker: int
+
+
+class TPUJobController:
+    """ref: MPIJobController struct + NewMPIJobController (:102-324)."""
+
+    def __init__(
+        self,
+        api_server: InMemoryAPIServer,
+        factory: Optional[InformerFactory] = None,
+        config: Optional[ControllerConfig] = None,
+        recorder: Optional[EventRecorder] = None,
+    ):
+        self.api = api_server
+        self.config = config or ControllerConfig()
+        self.recorder = recorder or EventRecorder()
+        self.factory = factory or InformerFactory(api_server, self.config.namespace)
+        self.queue = RateLimitingQueue()
+
+        # Admission: reject invalid TPUJob specs at create/update, the CRD
+        # openAPIV3-schema analogue (ref deploy/0-crd.yaml:16-99) — invalid
+        # shapes must fail at admission, not at runtime (SURVEY §7).
+        from ..api.validation import validate_spec
+        api_server.register_admission_validator(
+            api.KIND, lambda obj: validate_spec(obj.spec)
+        )
+
+        # 8 informers, matching the reference's registration (:204-321)
+        self.job_informer = self.factory.informer(api.KIND)
+        self.configmap_informer = self.factory.informer("ConfigMap")
+        self.sa_informer = self.factory.informer("ServiceAccount")
+        self.role_informer = self.factory.informer("Role")
+        self.rolebinding_informer = self.factory.informer("RoleBinding")
+        self.statefulset_informer = self.factory.informer("StatefulSet")
+        self.batchjob_informer = self.factory.informer("Job")
+        self.pdb_informer = self.factory.informer("PodDisruptionBudget")
+
+        self.job_lister = self.job_informer.lister()
+        self.configmap_lister = self.configmap_informer.lister()
+        self.sa_lister = self.sa_informer.lister()
+        self.role_lister = self.role_informer.lister()
+        self.rolebinding_lister = self.rolebinding_informer.lister()
+        self.statefulset_lister = self.statefulset_informer.lister()
+        self.batchjob_lister = self.batchjob_informer.lister()
+        self.pdb_lister = self.pdb_informer.lister()
+
+        # TPUJob events: enqueue the job itself (ref :204-209)
+        self.job_informer.add_event_handler(
+            on_add=self.enqueue_tpu_job,
+            on_update=lambda old, new: self.enqueue_tpu_job(new),
+        )
+        # dependent kinds: map back to owning TPUJob (ref :210-321)
+        for informer in (
+            self.configmap_informer, self.sa_informer, self.role_informer,
+            self.rolebinding_informer, self.statefulset_informer,
+            self.batchjob_informer, self.pdb_informer,
+        ):
+            informer.add_event_handler(
+                on_add=self.handle_object,
+                on_update=lambda old, new: self.handle_object(new),
+                on_delete=self.handle_object,
+            )
+
+        self._threads: List[threading.Thread] = []
+
+    # ------------------------------------------------------------------
+    # queue plumbing
+    # ------------------------------------------------------------------
+
+    def enqueue_tpu_job(self, obj) -> None:
+        """ref: enqueueMPIJob (:796-804)."""
+        self.queue.add(meta_namespace_key(obj))
+
+    def handle_object(self, obj) -> None:
+        """ref: handleObject (:811-844) — owner lookup → enqueue TPUJob."""
+        ref = obj.metadata.controller_ref()
+        if ref is None or ref.kind != api.KIND:
+            return
+        owner = self.job_lister.try_get(obj.metadata.namespace, ref.name)
+        if owner is None:
+            logger.debug(
+                "ignoring orphaned %s/%s of tpujob %s",
+                obj.kind, obj.metadata.name, ref.name,
+            )
+            return
+        self.enqueue_tpu_job(owner)
+
+    # ------------------------------------------------------------------
+    # run loop (ref Run/runWorker/processNextWorkItem :330-415)
+    # ------------------------------------------------------------------
+
+    def run(self, threadiness: int = 2, stop_event: Optional[threading.Event] = None):
+        self.factory.start_all()
+        if not self.factory.wait_for_cache_sync():
+            raise RuntimeError("failed to wait for caches to sync")
+        for obj in self.job_lister.list():
+            self.enqueue_tpu_job(obj)
+        stop_event = stop_event or threading.Event()
+        for i in range(threadiness):
+            t = threading.Thread(
+                target=self._run_worker, args=(stop_event,),
+                name=f"tpujob-worker-{i}", daemon=True,
+            )
+            t.start()
+            self._threads.append(t)
+        return stop_event
+
+    def _run_worker(self, stop_event: threading.Event) -> None:
+        while not stop_event.is_set():
+            if not self.process_next_work_item(timeout=0.1):
+                if self.queue._shutting_down:  # noqa: SLF001
+                    return
+
+    def process_next_work_item(self, timeout: Optional[float] = None) -> bool:
+        key = self.queue.get(timeout=timeout)
+        if key is None:
+            return False
+        try:
+            self.sync_handler(key)
+            self.queue.forget(key)          # ref :399-404
+        except Exception:                   # noqa: BLE001
+            logger.exception("error syncing %s; requeuing", key)
+            self.queue.add_rate_limited(key)
+        finally:
+            self.queue.done(key)
+        return True
+
+    # ------------------------------------------------------------------
+    # THE core: sync_handler (ref syncHandler :420-520; SURVEY §3.2)
+    # ------------------------------------------------------------------
+
+    def sync_handler(self, key: str) -> None:
+        try:
+            namespace, name = split_key(key)
+        except ValueError:
+            logger.error("invalid resource key: %s", key)
+            return  # invalid key is a no-op, not a retry (ref :422-426)
+
+        job = self.job_lister.try_get(namespace, name)
+        if job is None:
+            # work item no longer exists → drop (ref :431-436)
+            logger.debug("tpujob '%s' no longer exists", key)
+            return
+
+        launcher = self.get_launcher_job(job)                  # ref :440, :522-544
+        done = launcher is not None and (
+            launcher.succeeded() or launcher.failed()          # ref :445
+        )
+
+        alloc = self.allocate_processing_units(job, done)      # ref :462, :547-598
+
+        if not done:
+            self.get_or_create_config_map(job, alloc)          # ref :470
+            self.get_or_create_launcher_service_account(job)   # ref :475
+            self.get_or_create_launcher_role(job, alloc.worker_replicas)  # ref :480
+            self.get_or_create_launcher_role_binding(job)      # ref :485
+            if self.config.enable_gang_scheduling or job.spec.gang_scheduling:
+                self.get_or_create_pdb(job, alloc.worker_replicas)  # ref :490-494
+
+        worker = self.get_or_create_worker_statefulset(job, alloc)  # ref :497
+
+        # THE GATE: launcher starts only once ALL workers report Ready
+        # (ref :503-509). On TPU this is also the ICI-formation gate: the
+        # jax.distributed coordinator must not start before every worker
+        # process of the slice can come up (SURVEY §7 hard parts).
+        workers_ready = (
+            worker is not None
+            and worker.status.ready_replicas == alloc.worker_replicas
+        ) or alloc.worker_replicas == 0
+        if not done and workers_ready and launcher is None:
+            launcher = self.api.create(self.new_launcher(job, alloc))
+
+        self.update_tpu_job_status(job, launcher, worker)      # ref :513, :761-791
+        self.recorder.event(job, "Normal", "Synced", "TPUJob synced successfully")
+
+    # ------------------------------------------------------------------
+    # launcher lookup (ref getLauncherJob :522-544)
+    # ------------------------------------------------------------------
+
+    def get_launcher_job(self, job: TPUJob) -> Optional[Job]:
+        launcher = self.batchjob_lister.try_get(
+            job.metadata.namespace, job.metadata.name + LAUNCHER_SUFFIX
+        )
+        if launcher is None:
+            return None
+        if not is_controlled_by(launcher.metadata, job.metadata):   # ref :537
+            self.recorder.event(
+                job, "Warning", ERR_RESOURCE_EXISTS,
+                MSG_RESOURCE_EXISTS % f"Job/{launcher.metadata.name}",
+            )
+            raise ForeignOwnershipError("Job", launcher.metadata.name)
+        return launcher
+
+    # ------------------------------------------------------------------
+    # allocation math (ref allocateProcessingUnits :547-598)
+    # ------------------------------------------------------------------
+
+    def allocate_processing_units(self, job: TPUJob, done: bool) -> AllocationResult:
+        spec = job.spec
+        resource_type = (
+            spec.processing_resource_type or self.config.processing_resource_type
+        )
+        slots = spec.slots_per_worker or api.DEFAULT_SLOTS_PER_WORKER
+
+        if spec.tpus is not None:
+            # Mode A via tpus: pair with tpusPerWorker (spec overrides the
+            # cluster flag, ref :449-453)
+            total = spec.tpus
+            per_worker = (
+                spec.tpus_per_worker
+                if spec.tpus_per_worker is not None
+                else self.config.tpus_per_worker
+            )
+        elif spec.processing_units is not None:
+            # Mode A via processingUnits: pair with processingUnitsPerWorker
+            # (ref :455-460 — each total field uses ITS OWN per-node default)
+            total = spec.processing_units
+            per_worker = (
+                spec.processing_units_per_worker
+                if spec.processing_units_per_worker is not None
+                else self.config.processing_units_per_worker
+            )
+        else:
+            total = per_worker = None
+
+        if total is not None:
+            # Mode A (ref :573-582)
+            if total < per_worker:
+                workers = 1          # total < perNode → 1 worker with all units
+                units = total
+            elif total % per_worker != 0:
+                raise ValueError(
+                    f"specified number of processing units ({total}) must be a "
+                    f"multiple of the number per worker ({per_worker})"
+                )  # ref :580
+            else:
+                workers = total // per_worker
+                units = per_worker
+        elif spec.replicas is not None:
+            # Mode B (ref :584-593): per-worker from container resource limits
+            workers = spec.replicas
+            units = spec.template.main_container().limits.get(resource_type, 0)
+        else:
+            raise ValueError(
+                "TPUJob spec must set one of tpus, processingUnits, replicas"
+            )
+
+        if done:
+            workers = 0              # scale-down after completion (ref :594-596)
+        return AllocationResult(
+            worker_replicas=workers,
+            units_per_worker=units,
+            resource_type=resource_type,
+            slots_per_worker=slots,
+        )
+
+    # ------------------------------------------------------------------
+    # dependent resources — each getOrCreate enforces ownership
+    # ------------------------------------------------------------------
+
+    def _check_ownership(self, obj, job: TPUJob):
+        if not is_controlled_by(obj.metadata, job.metadata):
+            self.recorder.event(
+                job, "Warning", ERR_RESOURCE_EXISTS,
+                MSG_RESOURCE_EXISTS % f"{obj.kind}/{obj.metadata.name}",
+            )
+            raise ForeignOwnershipError(obj.kind, obj.metadata.name)
+        return obj
+
+    def get_or_create_config_map(self, job: TPUJob, alloc: AllocationResult) -> ConfigMap:
+        """ref: getOrCreateConfigMap (:627-648) + newConfigMap (:849-885).
+        Updates in place if the discovery data drifted (worker count change),
+        as the reference updates the hostfile."""
+        name = job.metadata.name + CONFIG_SUFFIX
+        desired = self.new_config_map(job, alloc)
+        existing = self.configmap_lister.try_get(job.metadata.namespace, name)
+        if existing is None:
+            return self.api.create(desired)
+        self._check_ownership(existing, job)
+        if existing.data != desired.data:
+            existing.data = desired.data
+            return self.api.update(existing)
+        return existing
+
+    def get_or_create_launcher_service_account(self, job: TPUJob) -> ServiceAccount:
+        """ref: getOrCreateLauncherServiceAccount (:652-673)."""
+        name = job.metadata.name + LAUNCHER_SUFFIX
+        existing = self.sa_lister.try_get(job.metadata.namespace, name)
+        if existing is None:
+            return self.api.create(self.new_launcher_service_account(job))
+        return self._check_ownership(existing, job)
+
+    def get_or_create_launcher_role(self, job: TPUJob, worker_replicas: int) -> Role:
+        """ref: getOrCreateLauncherRole (:676-697); updates rules on drift
+        (worker count change alters resourceNames)."""
+        name = job.metadata.name + LAUNCHER_SUFFIX
+        desired = self.new_launcher_role(job, worker_replicas)
+        existing = self.role_lister.try_get(job.metadata.namespace, name)
+        if existing is None:
+            return self.api.create(desired)
+        self._check_ownership(existing, job)
+        if existing.rules != desired.rules:
+            existing.rules = desired.rules
+            return self.api.update(existing)
+        return existing
+
+    def get_or_create_launcher_role_binding(self, job: TPUJob) -> RoleBinding:
+        """ref: getLauncherRoleBinding (:701-722)."""
+        name = job.metadata.name + LAUNCHER_SUFFIX
+        existing = self.rolebinding_lister.try_get(job.metadata.namespace, name)
+        if existing is None:
+            return self.api.create(self.new_launcher_role_binding(job))
+        return self._check_ownership(existing, job)
+
+    def get_or_create_pdb(self, job: TPUJob, worker_replicas: int) -> PodDisruptionBudget:
+        """ref: getOrCreatePodGroups/PDB (:601-623)."""
+        name = job.metadata.name + WORKER_SUFFIX
+        desired = self.new_pdb(job, worker_replicas)
+        existing = self.pdb_lister.try_get(job.metadata.namespace, name)
+        if existing is None:
+            return self.api.create(desired)
+        self._check_ownership(existing, job)
+        if existing.min_available != desired.min_available:
+            existing.min_available = desired.min_available
+            return self.api.update(existing)
+        return existing
+
+    def get_or_create_worker_statefulset(
+        self, job: TPUJob, alloc: AllocationResult
+    ) -> Optional[StatefulSet]:
+        """ref: getOrCreateWorkerStatefulSet (:726-759): create if missing and
+        workers>0; update on replica drift (incl. scale-down-to-0 on done)."""
+        name = job.metadata.name + WORKER_SUFFIX
+        existing = self.statefulset_lister.try_get(job.metadata.namespace, name)
+        if existing is None:
+            if alloc.worker_replicas == 0:
+                return None
+            return self.api.create(self.new_worker(job, alloc))
+        self._check_ownership(existing, job)
+        if existing.spec.replicas != alloc.worker_replicas:    # ref :748-756
+            existing.spec.replicas = alloc.worker_replicas
+            return self.api.update(existing)
+        return existing
+
+    # ------------------------------------------------------------------
+    # resource constructors (ref newConfigMap etc. :849-1236)
+    # ------------------------------------------------------------------
+
+    def worker_hostnames(self, job: TPUJob, replicas: int) -> List[str]:
+        """Stable DNS names from the headless service (ref StatefulSet
+        ServiceName :1079; hostfile lines :857-869)."""
+        base = job.metadata.name + WORKER_SUFFIX
+        ns = job.metadata.namespace
+        return [f"{base}-{i}.{base}.{ns}.svc" for i in range(replicas)]
+
+    def discovery_topology(self, job: TPUJob, alloc: AllocationResult):
+        """Single source of truth for the rendezvous data: the ConfigMap and
+        the injected env MUST agree for workers to find each other.
+        Returns (hostnames, coordinator_address, num_processes)."""
+        hostnames = self.worker_hostnames(job, alloc.worker_replicas)
+        coordinator = (
+            f"{hostnames[0]}:{COORDINATOR_PORT}" if hostnames
+            else f"localhost:{COORDINATOR_PORT}"
+        )
+        num_processes = max(alloc.worker_replicas, 1) * alloc.slots_per_worker
+        return hostnames, coordinator, num_processes
+
+    def new_config_map(self, job: TPUJob, alloc: AllocationResult) -> ConfigMap:
+        """The hostfile analogue (ref newConfigMap :849-885). Instead of
+        `<host> slots=<n>` + a kubexec rsh script, we publish exactly what
+        `jax.distributed.initialize` needs (SURVEY §2.4 TPU-native equivalent):
+        coordinator address, process count, and per-worker hostnames."""
+        hostnames, coordinator, num_processes = self.discovery_topology(job, alloc)
+        data = {
+            # newline list — greppable like the reference hostfile
+            "worker-hostnames": "\n".join(hostnames) + ("\n" if hostnames else ""),
+            "coordinator-address": coordinator,
+            "num-processes": str(num_processes),
+            "slots-per-worker": str(alloc.slots_per_worker),
+            "tpus-per-worker": str(alloc.units_per_worker),
+            "resource-type": alloc.resource_type,
+            "num-slices": str(job.spec.num_slices),
+        }
+        return ConfigMap(
+            metadata=ObjectMeta(
+                name=job.metadata.name + CONFIG_SUFFIX,
+                namespace=job.metadata.namespace,
+                labels={LABEL_GROUP: job.metadata.name},
+                owner_references=[job.controller_owner_reference()],
+            ),
+            data=data,
+        )
+
+    def new_launcher_service_account(self, job: TPUJob) -> ServiceAccount:
+        """ref: newLauncherServiceAccount (:890-901)."""
+        return ServiceAccount(
+            metadata=ObjectMeta(
+                name=job.metadata.name + LAUNCHER_SUFFIX,
+                namespace=job.metadata.namespace,
+                labels={LABEL_GROUP: job.metadata.name},
+                owner_references=[job.controller_owner_reference()],
+            )
+        )
+
+    def new_launcher_role(self, job: TPUJob, worker_replicas: int) -> Role:
+        """ref: newLauncherRole (:906-935). The reference grants `get pods` +
+        `create pods/exec` on the named worker pods (the kubexec transport).
+        TPU-native: no exec needed — the launcher only reads worker pod state
+        and the discovery ConfigMap (least privilege preserved)."""
+        pod_names = [
+            f"{job.metadata.name}{WORKER_SUFFIX}-{i}" for i in range(worker_replicas)
+        ]
+        return Role(
+            metadata=ObjectMeta(
+                name=job.metadata.name + LAUNCHER_SUFFIX,
+                namespace=job.metadata.namespace,
+                labels={LABEL_GROUP: job.metadata.name},
+                owner_references=[job.controller_owner_reference()],
+            ),
+            rules=[
+                PolicyRule(verbs=["get", "list", "watch"], resources=["pods"],
+                           resource_names=pod_names),
+                PolicyRule(verbs=["get"], resources=["configmaps"],
+                           resource_names=[job.metadata.name + CONFIG_SUFFIX]),
+            ],
+        )
+
+    def new_launcher_role_binding(self, job: TPUJob) -> RoleBinding:
+        """ref: newLauncherRoleBinding (:940-964)."""
+        name = job.metadata.name + LAUNCHER_SUFFIX
+        return RoleBinding(
+            metadata=ObjectMeta(
+                name=name,
+                namespace=job.metadata.namespace,
+                labels={LABEL_GROUP: job.metadata.name},
+                owner_references=[job.controller_owner_reference()],
+            ),
+            role_name=name,
+            subject_service_accounts=[name],
+        )
+
+    def new_pdb(self, job: TPUJob, worker_replicas: int) -> PodDisruptionBudget:
+        """ref: newPDB (:969-986) — minAvailable = workers, the gang hint."""
+        return PodDisruptionBudget(
+            metadata=ObjectMeta(
+                name=job.metadata.name + WORKER_SUFFIX,
+                namespace=job.metadata.namespace,
+                labels={LABEL_GROUP: job.metadata.name},
+                owner_references=[job.controller_owner_reference()],
+            ),
+            min_available=worker_replicas,
+        )
+
+    def _discovery_env(self, job: TPUJob, alloc: AllocationResult,
+                       is_launcher: bool) -> dict:
+        """Bootstrap env (replaces OMPI_MCA_* injection, ref :1123-1131).
+
+        Workers do NOT get an explicit TPU_WORKER_ID: the StatefulSet gives
+        each pod a stable hostname `<job>-worker-<ordinal>`, and
+        `mpi_operator_tpu.bootstrap` derives the worker id from that trailing
+        ordinal at process start (the same way TPU-VM pods do). Kubernetes
+        offers no downward-API field for the ordinal, so hostname parsing is
+        the reliable channel."""
+        hostnames, coordinator, num_processes = self.discovery_topology(job, alloc)
+        env = {
+            "TPU_JOB_NAME": job.metadata.name,
+            "TPU_WORKER_HOSTNAMES": ",".join(
+                h.split(".")[0] for h in hostnames
+            ),
+            "TPU_COORDINATOR_ADDRESS": coordinator,
+            "TPU_NUM_PROCESSES": str(num_processes),
+            "TPU_SLOTS_PER_WORKER": str(alloc.slots_per_worker),
+            "TPU_CONFIG_PATH": CONFIG_MOUNT_PATH,
+            "TPU_NUM_SLICES": str(job.spec.num_slices),
+        }
+        if is_launcher:
+            env["TPU_LAUNCHER"] = "1"
+        return env
+
+    def new_worker(self, job: TPUJob, alloc: AllocationResult) -> StatefulSet:
+        """ref: newWorker (:1004-1083). Differences by design (SURVEY §7):
+        workers run the actual training process (not `sleep 365d`), carry
+        `google.com/tpu` limits + slice node selectors, and get the bootstrap
+        env so `jax.distributed.initialize` needs zero user wiring."""
+        name = job.metadata.name + WORKER_SUFFIX
+        template = api.deepcopy_obj(job.spec.template)
+        container = template.main_container()
+        if alloc.units_per_worker > 0:
+            container.limits = dict(container.limits)
+            container.limits[alloc.resource_type] = alloc.units_per_worker
+        container.env = {
+            **container.env,
+            **self._discovery_env(job, alloc, is_launcher=False),
+        }
+        container.volume_mounts = container.volume_mounts + [
+            {"name": CONFIG_VOLUME_NAME, "mountPath": CONFIG_MOUNT_PATH}
+        ]
+        template.volumes = template.volumes + [
+            {"name": CONFIG_VOLUME_NAME,
+             "configMap": job.metadata.name + CONFIG_SUFFIX}
+        ]
+        template.restart_policy = "Always"    # ref :1021
+        if alloc.resource_type == RESOURCE_TPU:
+            template.node_selector = {
+                **template.node_selector,
+                NS_ACCELERATOR: job.spec.accelerator_type,
+            }
+            if job.spec.slice_topology:
+                template.node_selector[NS_TOPOLOGY] = job.spec.slice_topology
+        template.metadata.labels = {
+            **template.metadata.labels, LABEL_GROUP: job.metadata.name,
+        }
+        return StatefulSet(
+            metadata=ObjectMeta(
+                name=name,
+                namespace=job.metadata.namespace,
+                labels={LABEL_GROUP: job.metadata.name},
+                owner_references=[job.controller_owner_reference()],
+            ),
+            spec=StatefulSetSpec(
+                replicas=alloc.worker_replicas,
+                service_name=name,                      # stable DNS (ref :1079)
+                pod_management_policy="Parallel",       # ref :1074
+                template=template,
+            ),
+        )
+
+    def new_launcher(self, job: TPUJob, alloc: AllocationResult) -> Job:
+        """ref: newLauncher (:1088-1236). No kubectl-delivery init container
+        (ref :1106-1121) and no OMPI_MCA_* env (ref :1123-1131): the launcher
+        is a thin coordinator / rank-0 process bootstrapped by the same env
+        the workers get. It remains the completion signal."""
+        name = job.metadata.name + LAUNCHER_SUFFIX
+        template = api.deepcopy_obj(job.spec.template)
+        container = template.main_container()
+        container.env = {
+            **container.env,
+            **self._discovery_env(job, alloc, is_launcher=True),
+        }
+        container.volume_mounts = container.volume_mounts + [
+            {"name": CONFIG_VOLUME_NAME, "mountPath": CONFIG_MOUNT_PATH}
+        ]
+        template.volumes = template.volumes + [
+            {"name": CONFIG_VOLUME_NAME,
+             "configMap": job.metadata.name + CONFIG_SUFFIX}
+        ]
+        if self.config.discovery_image:
+            template.init_containers = template.init_containers + [
+                Container(name="discovery", image=self.config.discovery_image)
+            ]
+        # OnFailure, not Never (ref :1175-1177): with Never, the batch Job
+        # controller increments status.failed on the FIRST pod failure, which
+        # our done-check (sync_handler) would read as terminal — backoffLimit
+        # would never get a retry. OnFailure retries in place; failed only
+        # goes >0 once retries are exhausted.
+        template.restart_policy = "OnFailure"
+        template.metadata.labels = {
+            **template.metadata.labels, LABEL_GROUP: job.metadata.name,
+        }
+        backoff = (
+            job.spec.backoff_limit
+            if job.spec.backoff_limit is not None
+            else api.DEFAULT_BACKOFF_LIMIT       # ref :1059-1062
+        )
+        return Job(
+            metadata=ObjectMeta(
+                name=name,
+                namespace=job.metadata.namespace,
+                labels={LABEL_GROUP: job.metadata.name},
+                owner_references=[job.controller_owner_reference()],
+            ),
+            spec=JobSpec(
+                template=template,
+                backoff_limit=backoff,
+                active_deadline_seconds=job.spec.active_deadline_seconds,  # ref :1221-1222
+            ),
+        )
+
+    # ------------------------------------------------------------------
+    # status (ref updateMPIJobStatus :761-791) + v1alpha2 conditions
+    # ------------------------------------------------------------------
+
+    def update_tpu_job_status(
+        self, job: TPUJob, launcher: Optional[Job], worker: Optional[StatefulSet]
+    ) -> None:
+        import time as _time
+
+        # NEVER mutate the lister's copy (ref DeepCopy note :762-765) — our
+        # listers already hand out copies, so mutate-and-update is safe.
+        changed = False
+        if launcher is not None:
+            if launcher.status.active > 0:
+                new = LAUNCHER_ACTIVE
+            elif launcher.succeeded():
+                new = LAUNCHER_SUCCEEDED
+            elif launcher.failed():
+                new = LAUNCHER_FAILED
+            else:
+                new = job.status.launcher_status
+            if new != job.status.launcher_status:
+                job.status.launcher_status = new
+                changed = True
+                now = _time.time()
+                if new == LAUNCHER_ACTIVE:
+                    if job.status.start_time is None:
+                        job.status.start_time = launcher.status.start_time or now
+                    job.status.set_condition(api.JobCondition(
+                        COND_RUNNING, "True", "TPUJobRunning",
+                        f"launcher {launcher.metadata.name} is active"))
+                elif new == LAUNCHER_SUCCEEDED:
+                    job.status.completion_time = (
+                        launcher.status.completion_time or now)
+                    job.status.set_condition(api.JobCondition(
+                        COND_SUCCEEDED, "True", "TPUJobSucceeded",
+                        f"launcher {launcher.metadata.name} completed"))
+                elif new == LAUNCHER_FAILED:
+                    job.status.completion_time = (
+                        launcher.status.completion_time or now)
+                    job.status.set_condition(api.JobCondition(
+                        COND_FAILED, "True", "TPUJobFailed",
+                        f"launcher {launcher.metadata.name} failed"))
+        if job.status.get_condition(COND_CREATED) is None:
+            job.status.set_condition(api.JobCondition(
+                COND_CREATED, "True", "TPUJobCreated", "TPUJob resources created"))
+            changed = True
+
+        ready = worker.status.ready_replicas if worker is not None else 0
+        if ready != job.status.worker_replicas:       # ref :780-786
+            job.status.worker_replicas = ready
+            changed = True
+
+        if changed:
+            # full-object Update, like the reference (ref :789)
+            self.api.update(job)
+
+
+__all__ = [
+    "TPUJobController", "ControllerConfig", "AllocationResult",
+    "EventRecorder", "Event", "ForeignOwnershipError",
+    "CONFIG_SUFFIX", "LAUNCHER_SUFFIX", "WORKER_SUFFIX",
+    "CONFIG_MOUNT_PATH", "COORDINATOR_PORT", "LABEL_GROUP",
+]

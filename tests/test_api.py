@@ -1,0 +1,97 @@
+"""API type + validation tests (the reference enforces these via the CRD's
+openAPIV3 schema, deploy/0-crd.yaml:16-99; SURVEY.md §2.1)."""
+import pytest
+
+from mpi_operator_tpu.api.types import (
+    COND_FAILED, COND_RUNNING, COND_SUCCEEDED, JobCondition, ObjectMeta,
+    OwnerReference, TPUJobSpec, TPUJobStatus, is_controlled_by, new_tpu_job,
+)
+from mpi_operator_tpu.api.validation import (
+    ValidationError, default_topology, validate_spec,
+)
+
+
+def test_exactly_one_sizing_mode_required():
+    with pytest.raises(ValidationError, match="exactly one"):
+        validate_spec(TPUJobSpec())
+    with pytest.raises(ValidationError, match="mutually exclusive"):
+        validate_spec(TPUJobSpec(tpus=8, replicas=2))
+    with pytest.raises(ValidationError, match="mutually exclusive"):
+        validate_spec(TPUJobSpec(tpus=8, processing_units=8))
+
+
+@pytest.mark.parametrize("n", [1, 2, 4, 8, 16, 32, 64, 128, 256])
+def test_valid_slice_chip_counts(n):
+    validate_spec(TPUJobSpec(tpus=n))
+
+
+@pytest.mark.parametrize("n", [3, 5, 6, 7, 12, 24, 48, 100])
+def test_invalid_slice_chip_counts(n):
+    """Invalid shapes fail at admission, not at runtime (SURVEY §7)."""
+    with pytest.raises(ValidationError, match="slice chip count"):
+        validate_spec(TPUJobSpec(tpus=n))
+
+
+def test_topology_must_match_chip_count():
+    validate_spec(TPUJobSpec(tpus=32, slice_topology="4x8"))
+    with pytest.raises(ValidationError, match="does not match"):
+        validate_spec(TPUJobSpec(tpus=32, slice_topology="4x4"))
+
+
+def test_default_topology():
+    assert default_topology(32) == "4x8"
+    assert default_topology(4) == "2x2"
+    with pytest.raises(ValidationError):
+        default_topology(13)
+
+
+def test_resource_type_restricted():
+    """ref cmd/mpi-operator/main.go:108-110."""
+    with pytest.raises(ValidationError, match="processingResourceType"):
+        validate_spec(TPUJobSpec(tpus=8, processing_resource_type="nvidia.com/gpu"))
+
+
+def test_clean_pod_policy_restricted():
+    with pytest.raises(ValidationError, match="cleanPodPolicy"):
+        validate_spec(TPUJobSpec(tpus=8, clean_pod_policy="Sometimes"))
+
+
+def test_backoff_and_deadline_bounds():
+    with pytest.raises(ValidationError, match="backoffLimit"):
+        validate_spec(TPUJobSpec(tpus=8, backoff_limit=-1))
+    with pytest.raises(ValidationError, match="activeDeadlineSeconds"):
+        validate_spec(TPUJobSpec(tpus=8, active_deadline_seconds=0))
+
+
+def test_is_controlled_by():
+    owner = new_tpu_job("job1")
+    owner.metadata.uid = "u1"
+    child = ObjectMeta(
+        name="c", owner_references=[owner.controller_owner_reference()]
+    )
+    assert is_controlled_by(child, owner.metadata)
+    other = ObjectMeta(name="c", owner_references=[OwnerReference(
+        api_version="v1", kind="TPUJob", name="job1", uid="u2")])
+    assert not is_controlled_by(other, owner.metadata)
+
+
+def test_conditions_model():
+    """v1alpha2 condition semantics (ref common_types.go:101-127)."""
+    st = TPUJobStatus()
+    st.set_condition(JobCondition(COND_RUNNING, "True"))
+    assert not st.is_done()
+    st.set_condition(JobCondition(COND_SUCCEEDED, "True"))
+    assert st.is_done()
+    # terminal condition flips Running to False
+    assert st.get_condition(COND_RUNNING).status == "False"
+    # last-writer-wins per type: no duplicates
+    st.set_condition(JobCondition(COND_SUCCEEDED, "True"))
+    assert sum(1 for c in st.conditions if c.type == COND_SUCCEEDED) == 1
+
+
+def test_condition_transition_time_stable_when_unchanged():
+    st = TPUJobStatus()
+    st.set_condition(JobCondition(COND_RUNNING, "True", reason="r"))
+    t0 = st.get_condition(COND_RUNNING).last_transition_time
+    st.set_condition(JobCondition(COND_RUNNING, "True", reason="r"))
+    assert st.get_condition(COND_RUNNING).last_transition_time == t0

@@ -1,0 +1,456 @@
+"""Controller reconcile tests.
+
+Mirrors the reference's single test layer — controller unit tests against
+fake clientsets asserting the exact emitted write actions
+(reference pkg/controllers/mpi_job_controller_test.go, 16 scenarios at
+:466-789; fixture/oracle mechanics at :48-311, SURVEY.md §4).
+
+The fixture here plays the same roles: the InMemoryAPIServer is both the
+fake object tracker (recording Actions) and the informer source; sync_handler
+is called synchronously with zero concurrency (ref alwaysReady stubs :169-177).
+"""
+import pytest
+
+from mpi_operator_tpu.api import types as api
+from mpi_operator_tpu.api.types import (
+    Container, ObjectMeta, PodTemplateSpec, TPUJob, TPUJobSpec,
+)
+from mpi_operator_tpu.cluster.apiserver import InMemoryAPIServer
+from mpi_operator_tpu.cluster.resources import (
+    ConfigMap, Job, JobStatus, Role, ServiceAccount, StatefulSet,
+    StatefulSetSpec, StatefulSetStatus, RoleBinding,
+)
+from mpi_operator_tpu.controller import (
+    ControllerConfig, ForeignOwnershipError, TPUJobController,
+)
+from mpi_operator_tpu.controller.controller import (
+    CONFIG_SUFFIX, LAUNCHER_SUFFIX, WORKER_SUFFIX,
+)
+
+
+# ---------------------------------------------------------------------------
+# fixture (ref mpi_job_controller_test.go:48-267)
+# ---------------------------------------------------------------------------
+
+class Fixture:
+    def __init__(self, **config_kwargs):
+        self.api = InMemoryAPIServer()
+        self.controller = TPUJobController(
+            self.api, config=ControllerConfig(**config_kwargs)
+        )
+        self.controller.factory.start_all()
+
+    def seed(self, obj):
+        """Seed both the fake tracker and the informer cache, like setUp*
+        helpers (ref :401-445). Watch events keep informers in sync."""
+        return self.api.create(obj)
+
+    def run(self, key, expect_error=None):
+        """ref: fixture.run/runController (:214-267). Clears setup actions so
+        assertions see only what sync emitted."""
+        self.api.clear_actions()
+        if expect_error is None:
+            self.controller.sync_handler(key)
+        else:
+            with pytest.raises(expect_error):
+                self.controller.sync_handler(key)
+        return self.api.write_actions()
+
+
+def new_job(name="test", tpus=8, **kw) -> TPUJob:
+    spec = TPUJobSpec(
+        tpus=tpus,
+        template=PodTemplateSpec(
+            containers=[Container(name="train", image="tpu-bench:latest")]
+        ),
+        **kw,
+    )
+    return TPUJob(metadata=ObjectMeta(name=name, namespace="default"), spec=spec)
+
+
+def owned(job: TPUJob):
+    return [job.controller_owner_reference()]
+
+
+def verbs(actions):
+    return [(a.verb, a.kind) for a in actions]
+
+
+# ---------------------------------------------------------------------------
+# no-op paths (ref TestDoNothingWithInvalidKey / NonexistentMPIJob :466-477)
+# ---------------------------------------------------------------------------
+
+def test_invalid_key_is_noop():
+    f = Fixture()
+    actions = f.run("metadata")     # no namespace separator
+    assert actions == []
+
+
+def test_nonexistent_job_is_noop():
+    f = Fixture()
+    actions = f.run("default/nonexistent")
+    assert actions == []
+
+
+# ---------------------------------------------------------------------------
+# full creation fan-out (ref TestAllResourcesCreated :533-562)
+# ---------------------------------------------------------------------------
+
+def test_all_resources_created():
+    f = Fixture()
+    job = f.seed(new_job(tpus=8))   # 8 chips / 4 per worker = 2 workers
+    actions = f.run("default/test")
+    assert verbs(actions) == [
+        ("create", "ConfigMap"),
+        ("create", "ServiceAccount"),
+        ("create", "Role"),
+        ("create", "RoleBinding"),
+        ("create", "StatefulSet"),
+        ("update", "TPUJob"),       # status: Created condition
+    ]
+    sts = f.api.get("StatefulSet", "default", "test" + WORKER_SUFFIX)
+    assert sts.spec.replicas == 2
+    assert sts.spec.pod_management_policy == "Parallel"
+    assert sts.metadata.owner_references[0].uid == job.metadata.uid
+    # TPU resource limits injected, zero nvidia.com/gpu anywhere (BASELINE.md)
+    limits = sts.spec.template.main_container().limits
+    assert limits == {api.RESOURCE_TPU: 4}
+    cm = f.api.get("ConfigMap", "default", "test" + CONFIG_SUFFIX)
+    assert cm.data["worker-hostnames"] == (
+        "test-worker-0.test-worker.default.svc\n"
+        "test-worker-1.test-worker.default.svc\n"
+    )
+    assert cm.data["coordinator-address"].startswith("test-worker-0.")
+    assert cm.data["num-processes"] == "2"
+
+
+def test_single_worker_when_total_below_per_worker():
+    """ref allocateProcessingUnits: total < perNode → 1 worker (:573-578)."""
+    f = Fixture()
+    f.seed(new_job(tpus=2))
+    f.run("default/test")
+    sts = f.api.get("StatefulSet", "default", "test" + WORKER_SUFFIX)
+    assert sts.spec.replicas == 1
+    assert sts.spec.template.main_container().limits[api.RESOURCE_TPU] == 2
+
+
+def test_indivisible_total_errors():
+    """ref: total % perNode != 0 → error (:580). 16 valid chips but
+    per-worker 5 via spec override."""
+    f = Fixture()
+    f.seed(new_job(tpus=16, tpus_per_worker=5))
+    f.run("default/test", expect_error=ValueError)
+
+
+def test_custom_replicas_cpu():
+    """Mode B with cpu resource type (ref TestAllResourcesCreatedCustom
+    cpu variant :564-596)."""
+    f = Fixture()
+    job = new_job(tpus=None)
+    job.spec.replicas = 4
+    job.spec.processing_resource_type = api.RESOURCE_CPU
+    job.spec.template.main_container().limits = {"cpu": 2}
+    f.seed(job)
+    f.run("default/test")
+    sts = f.api.get("StatefulSet", "default", "test" + WORKER_SUFFIX)
+    assert sts.spec.replicas == 4
+    # cpu jobs get no TPU node selectors
+    assert "cloud.google.com/gke-tpu-accelerator" not in (
+        sts.spec.template.node_selector
+    )
+
+
+def test_custom_replicas_tpu_limits():
+    """Mode B with explicit google.com/tpu limits (ref :584-593)."""
+    f = Fixture()
+    job = new_job(tpus=None)
+    job.spec.replicas = 2
+    job.spec.template.main_container().limits = {api.RESOURCE_TPU: 4}
+    f.seed(job)
+    f.run("default/test")
+    cm = f.api.get("ConfigMap", "default", "test" + CONFIG_SUFFIX)
+    assert cm.data["tpus-per-worker"] == "4"
+
+
+def test_gang_scheduling_creates_pdb():
+    """ref: getOrCreatePDB (:490-494, :601-623) minAvailable=workers."""
+    f = Fixture(enable_gang_scheduling=True)
+    f.seed(new_job(tpus=16))
+    actions = f.run("default/test")
+    assert ("create", "PodDisruptionBudget") in verbs(actions)
+    pdb = f.api.get("PodDisruptionBudget", "default", "test" + WORKER_SUFFIX)
+    assert pdb.min_available == 4
+
+
+# ---------------------------------------------------------------------------
+# launcher gating (ref TestWorkerNotReady / TestWorkerReady :712-789)
+# ---------------------------------------------------------------------------
+
+def _seed_workers(f, job, replicas, ready):
+    alloc = f.controller.allocate_processing_units(job, False)
+    sts = f.controller.new_worker(job, alloc)
+    sts.status = StatefulSetStatus(ready_replicas=ready, replicas=replicas)
+    return f.seed(sts)
+
+
+def test_launcher_not_created_until_workers_ready():
+    f = Fixture()
+    job = f.seed(new_job(tpus=8))
+    _seed_workers(f, job, replicas=2, ready=1)
+    actions = f.run("default/test")
+    assert ("create", "Job") not in verbs(actions)
+
+
+def test_launcher_created_when_workers_ready():
+    """ref TestWorkerReady (:739-763): ready==desired → launcher Job."""
+    f = Fixture()
+    job = f.seed(new_job(tpus=8))
+    _seed_workers(f, job, replicas=2, ready=2)
+    # seed remaining deps so only the launcher create is new
+    actions = f.run("default/test")
+    assert ("create", "Job") in verbs(actions)
+    launcher = f.api.get("Job", "default", "test" + LAUNCHER_SUFFIX)
+    env = launcher.spec.template.main_container().env
+    assert env["TPU_COORDINATOR_ADDRESS"].startswith("test-worker-0.")
+    assert env["TPU_NUM_PROCESSES"] == "2"
+    assert env["TPU_LAUNCHER"] == "1"
+    assert "TPU_WORKER_ID" not in env
+    # no kubectl-delivery init container (SURVEY §7: bootstrap path is env)
+    assert launcher.spec.template.init_containers == []
+    assert launcher.spec.backoff_limit == api.DEFAULT_BACKOFF_LIMIT
+
+
+def test_launcher_created_cpu_variant():
+    """ref TestWorkerReadyCPU variant (:765-789)."""
+    f = Fixture()
+    job = new_job(tpus=None)
+    job.spec.processing_units = 2
+    job.spec.processing_resource_type = api.RESOURCE_CPU
+    job = f.seed(job)
+    _seed_workers(f, job, replicas=1, ready=1)
+    actions = f.run("default/test")
+    assert ("create", "Job") in verbs(actions)
+
+
+# ---------------------------------------------------------------------------
+# status propagation (ref TestLauncherSucceeded/Failed :494-531)
+# ---------------------------------------------------------------------------
+
+def _seed_finished_launcher(f, job, *, succeeded):
+    alloc = f.controller.allocate_processing_units(job, False)
+    launcher = f.controller.new_launcher(job, alloc)
+    launcher.status = JobStatus(
+        succeeded=1 if succeeded else 0, failed=0 if succeeded else 1,
+        completion_time=123.0,
+    )
+    return f.seed(launcher)
+
+
+def test_launcher_succeeded_updates_status_and_scales_down():
+    """ref TestLauncherSucceeded (:494-512) + TestShutdownWorker (:667-692):
+    done → status Succeeded, workers scaled to 0."""
+    f = Fixture()
+    job = f.seed(new_job(tpus=8))
+    _seed_workers(f, job, replicas=2, ready=2)
+    _seed_finished_launcher(f, job, succeeded=True)
+    actions = f.run("default/test")
+    # no ConfigMap/RBAC recreation when done (ref :468)
+    assert ("create", "ConfigMap") not in verbs(actions)
+    sts = f.api.get("StatefulSet", "default", "test" + WORKER_SUFFIX)
+    assert sts.spec.replicas == 0                       # ref :594-596
+    updated = f.api.get(api.KIND, "default", "test")
+    assert updated.status.launcher_status == api.LAUNCHER_SUCCEEDED
+    assert updated.status.completion_time == 123.0
+    assert updated.status.is_done()
+    assert updated.status.get_condition(api.COND_SUCCEEDED).status == "True"
+
+
+def test_launcher_failed_updates_status():
+    f = Fixture()
+    job = f.seed(new_job(tpus=8))
+    _seed_finished_launcher(f, job, succeeded=False)
+    f.run("default/test")
+    updated = f.api.get(api.KIND, "default", "test")
+    assert updated.status.launcher_status == api.LAUNCHER_FAILED
+    assert updated.status.get_condition(api.COND_FAILED).status == "True"
+
+
+def test_launcher_active_sets_running_condition_and_start_time():
+    f = Fixture()
+    job = f.seed(new_job(tpus=8))
+    alloc = f.controller.allocate_processing_units(job, False)
+    launcher = f.controller.new_launcher(job, alloc)
+    launcher.status = JobStatus(active=1, start_time=100.0)
+    f.seed(launcher)
+    f.run("default/test")
+    updated = f.api.get(api.KIND, "default", "test")
+    assert updated.status.launcher_status == api.LAUNCHER_ACTIVE
+    assert updated.status.start_time == 100.0
+    assert updated.status.get_condition(api.COND_RUNNING).status == "True"
+
+
+def test_worker_replicas_status_tracks_ready():
+    """ref updateMPIJobStatus worker readiness (:780-786)."""
+    f = Fixture()
+    job = f.seed(new_job(tpus=8))
+    _seed_workers(f, job, replicas=2, ready=2)
+    f.run("default/test")
+    updated = f.api.get(api.KIND, "default", "test")
+    assert updated.status.worker_replicas == 2
+
+
+# ---------------------------------------------------------------------------
+# ownership conflicts — one per child kind (ref :479-492, :598-710)
+# ---------------------------------------------------------------------------
+
+def _foreign_meta(name):
+    return ObjectMeta(
+        name=name, namespace="default",
+        owner_references=[api.OwnerReference(
+            api_version="v1", kind="Foreign", name="other", uid="foreign-uid",
+        )],
+    )
+
+
+@pytest.mark.parametrize("make_obj", [
+    lambda: ConfigMap(metadata=_foreign_meta("test" + CONFIG_SUFFIX)),
+    lambda: ServiceAccount(metadata=_foreign_meta("test" + LAUNCHER_SUFFIX)),
+    lambda: Role(metadata=_foreign_meta("test" + LAUNCHER_SUFFIX)),
+    lambda: RoleBinding(metadata=_foreign_meta("test" + LAUNCHER_SUFFIX)),
+    lambda: StatefulSet(metadata=_foreign_meta("test" + WORKER_SUFFIX)),
+    lambda: Job(metadata=_foreign_meta("test" + LAUNCHER_SUFFIX)),
+], ids=["configmap", "serviceaccount", "role", "rolebinding",
+        "statefulset", "launcher-job"])
+def test_foreign_ownership_refused(make_obj):
+    """Adoption is refused, never forced (ref :641-645 and siblings); a
+    Warning event is recorded (ref :539)."""
+    f = Fixture()
+    f.seed(new_job(tpus=8))
+    f.seed(make_obj())
+    f.run("default/test", expect_error=ForeignOwnershipError)
+    assert any(e.type == "Warning" for e in f.controller.recorder.events)
+
+
+# ---------------------------------------------------------------------------
+# idempotence / drift repair (level-triggered model, SURVEY §3.2)
+# ---------------------------------------------------------------------------
+
+def test_second_sync_is_idempotent():
+    f = Fixture()
+    job = f.seed(new_job(tpus=8))
+    f.run("default/test")
+    actions = f.run("default/test")
+    # nothing to create or update on a converged state
+    assert verbs(actions) == []
+
+
+def test_replica_drift_is_repaired():
+    """ref :748-756: update worker set if replica drift."""
+    f = Fixture()
+    job = f.seed(new_job(tpus=8))
+    alloc = f.controller.allocate_processing_units(job, False)
+    sts = f.controller.new_worker(job, alloc)
+    sts.spec.replicas = 5   # drifted
+    f.seed(sts)
+    actions = f.run("default/test")
+    assert ("update", "StatefulSet") in verbs(actions)
+    assert f.api.get("StatefulSet", "default", "test" + WORKER_SUFFIX).spec.replicas == 2
+
+
+def test_configmap_drift_is_repaired():
+    """The hostfile analogue is rewritten when contents drift
+    (ref getOrCreateConfigMap :627-648)."""
+    f = Fixture()
+    job = f.seed(new_job(tpus=8))
+    cm = f.controller.new_config_map(
+        job, f.controller.allocate_processing_units(job, False))
+    cm.data = {"worker-hostnames": "stale\n"}
+    f.seed(cm)
+    actions = f.run("default/test")
+    assert ("update", "ConfigMap") in verbs(actions)
+    fixed = f.api.get("ConfigMap", "default", "test" + CONFIG_SUFFIX)
+    assert "test-worker-0" in fixed.data["worker-hostnames"]
+
+
+# ---------------------------------------------------------------------------
+# event → queue plumbing (ref handleObject :811-844)
+# ---------------------------------------------------------------------------
+
+def _drain(queue):
+    while True:
+        key = queue.get(timeout=0)
+        if key is None:
+            return
+        queue.done(key)
+
+
+def test_dependent_event_enqueues_owner():
+    f = Fixture()
+    job = f.seed(new_job(tpus=8))
+    _drain(f.controller.queue)
+    sts = StatefulSet(metadata=ObjectMeta(
+        name="test" + WORKER_SUFFIX, namespace="default",
+        owner_references=owned(job),
+    ), spec=StatefulSetSpec(replicas=2))
+    f.api.create(sts)
+    key = f.controller.queue.get(timeout=1)
+    assert key == "default/test"
+
+
+def test_orphan_event_ignored():
+    f = Fixture()
+    f.seed(new_job(tpus=8))
+    _drain(f.controller.queue)
+    f.api.create(StatefulSet(metadata=_foreign_meta("orphan")))
+    assert f.controller.queue.get(timeout=0.05) is None
+
+
+def test_admission_rejects_invalid_spec_at_create():
+    """Invalid shapes fail at admission, not at runtime (SURVEY §7): the
+    controller registers validate_spec as the CRD-schema analogue."""
+    from mpi_operator_tpu.cluster.apiserver import InMemoryAPIServer as S
+    f = Fixture()
+    with pytest.raises(S.AdmissionError, match="slice chip count"):
+        f.api.create(new_job(tpus=3))
+
+
+def test_launcher_restart_policy_is_on_failure():
+    """ref :1175-1177 — Never would make the first pod failure terminal,
+    defeating backoffLimit."""
+    f = Fixture()
+    job = f.seed(new_job(tpus=8))
+    alloc = f.controller.allocate_processing_units(job, False)
+    launcher = f.controller.new_launcher(job, alloc)
+    assert launcher.spec.template.restart_policy == "OnFailure"
+
+
+def test_per_worker_default_pairs_with_sizing_field():
+    """tpus pairs with tpus_per_worker config; processing_units with
+    processing_units_per_worker (ref :449-460)."""
+    f = Fixture(tpus_per_worker=4, processing_units_per_worker=8)
+    job = new_job(tpus=None)
+    job.spec.processing_units = 16
+    job.spec.processing_resource_type = api.RESOURCE_CPU
+    alloc = f.controller.allocate_processing_units(job, False)
+    assert alloc.worker_replicas == 2       # 16/8, not 16/4
+    assert alloc.units_per_worker == 8
+
+
+def test_workqueue_returns_due_rate_limited_item():
+    """A due rate-limited item must be returned, not treated as timeout."""
+    from mpi_operator_tpu.cluster.workqueue import RateLimitingQueue
+    q = RateLimitingQueue(base_delay=0.01)
+    q.add_rate_limited("ns/x")
+    assert q.get(timeout=2.0) == "ns/x"
+
+
+def test_cascade_delete_on_owner():
+    """ref SURVEY §3.4: deletion is K8s GC via ownerReferences — the
+    controller has no delete logic of its own."""
+    f = Fixture()
+    job = f.seed(new_job(tpus=8))
+    f.run("default/test")
+    doomed = f.api.cascade_delete(job.metadata.uid)
+    assert {k for k, _, _ in doomed} >= {
+        "ConfigMap", "ServiceAccount", "Role", "RoleBinding", "StatefulSet",
+    }
