@@ -1,0 +1,90 @@
+#!/usr/bin/env python
+"""Headline benchmark: ResNet-101, synthetic ImageNet, batch 64/device —
+the reference's published configuration (reference README.md:97-133:
+132.1 images/sec per GPU, 264.26 aggregate on 2 GPUs, fp32, 100 steps).
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": "images/sec", "vs_baseline": N/132.1}
+
+vs_baseline is per-device throughput against the reference's 132.1
+images/sec-per-device number (BASELINE.md). Run on whatever devices are
+visible (one real TPU chip under the driver; --smoke forces a tiny CPU run).
+"""
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+REFERENCE_PER_DEVICE_IPS = 132.1      # ref README.md:113-125
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--model", default="resnet101")
+    parser.add_argument("--batch-per-device", type=int, default=64)
+    parser.add_argument("--steps", type=int, default=100)     # ref README.md:89
+    parser.add_argument("--warmup", type=int, default=10)
+    parser.add_argument("--image-size", type=int, default=224)
+    parser.add_argument("--dtype", default="bfloat16",
+                        choices=["bfloat16", "float32"])
+    parser.add_argument("--smoke", action="store_true",
+                        help="tiny CPU config for CI/verification")
+    args = parser.parse_args()
+
+    if args.smoke:
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "--xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + " --xla_force_host_platform_device_count=8").strip()
+
+    import jax
+    if args.smoke:
+        jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+
+    from mpi_operator_tpu.data import SyntheticImageDataset
+    from mpi_operator_tpu.models.resnet import create_model
+    from mpi_operator_tpu.parallel import MeshConfig, batch_sharding, make_mesh
+    from mpi_operator_tpu.train import Trainer, TrainerConfig
+
+    if args.smoke:
+        args.model = "resnet18"
+        args.batch_per_device = 2
+        args.steps = 4
+        args.warmup = 1
+        args.image_size = 64
+
+    n = jax.device_count()
+    mesh = make_mesh(MeshConfig.data_parallel(n))
+    dtype = jnp.bfloat16 if args.dtype == "bfloat16" else jnp.float32
+    global_batch = args.batch_per_device * n
+
+    print(f"# devices: {n} ({jax.devices()[0].device_kind}); model={args.model} "
+          f"global_batch={global_batch} dtype={args.dtype}", file=sys.stderr)
+
+    model = create_model(args.model, num_classes=1000, dtype=dtype)
+    cfg = TrainerConfig(global_batch_size=global_batch,
+                        image_size=args.image_size, num_classes=1000)
+    trainer = Trainer(model, mesh, cfg)
+    state = trainer.init_state(jax.random.PRNGKey(0))
+    dataset = SyntheticImageDataset(
+        global_batch, image_size=args.image_size, num_classes=1000,
+        dtype=dtype, sharding=batch_sharding(mesh))
+
+    metrics = trainer.benchmark(
+        state, dataset, num_steps=args.steps, warmup_steps=args.warmup,
+        log=lambda s: print(s, file=sys.stderr))
+
+    per_device = metrics["images_per_sec_per_device"]
+    print(json.dumps({
+        "metric": f"{args.model}_images_per_sec_per_device",
+        "value": round(per_device, 2),
+        "unit": "images/sec",
+        "vs_baseline": round(per_device / REFERENCE_PER_DEVICE_IPS, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

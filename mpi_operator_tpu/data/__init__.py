@@ -1,1 +1,3 @@
-
+from .synthetic import (  # noqa: F401
+    SyntheticImageDataset, synthetic_image_batch, synthetic_token_batch,
+)

@@ -1,0 +1,73 @@
+"""Synthetic data — the equivalent of tf_cnn_benchmarks' synthetic ImageNet.
+
+The reference benchmark runs with synthetic data by default
+(reference README.md:101 "Data format: NCHW ... Data: synthetic"; our layout
+is NHWC, XLA's native TPU conv layout). Batches are generated ON DEVICE so
+the input pipeline contributes zero host↔device traffic — the benchmark
+measures compute + collectives, not feeding (SURVEY §6).
+"""
+from __future__ import annotations
+
+from typing import Iterator, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def synthetic_image_batch(
+    rng: jax.Array,
+    batch_size: int,
+    image_size: int = 224,
+    num_classes: int = 1000,
+    dtype=jnp.bfloat16,
+) -> Tuple[jax.Array, jax.Array]:
+    """One (images, labels) batch. jit-able; runs on device."""
+    k1, k2 = jax.random.split(rng)
+    images = jax.random.normal(
+        k1, (batch_size, image_size, image_size, 3), dtype=jnp.float32
+    ).astype(dtype)
+    labels = jax.random.randint(k2, (batch_size,), 0, num_classes)
+    return images, labels
+
+
+def synthetic_token_batch(
+    rng: jax.Array,
+    batch_size: int,
+    seq_len: int,
+    vocab_size: int,
+) -> Tuple[jax.Array, jax.Array]:
+    """One (tokens, targets) batch for LM workloads (GPT-2/BERT configs)."""
+    tokens = jax.random.randint(rng, (batch_size, seq_len + 1), 0, vocab_size)
+    return tokens[:, :-1], tokens[:, 1:]
+
+
+class SyntheticImageDataset:
+    """Iterator of device-resident synthetic batches with a fixed-seed
+    stream — deterministic across workers given the same seed, like the
+    reference's synthetic mode."""
+
+    def __init__(self, batch_size: int, image_size: int = 224,
+                 num_classes: int = 1000, dtype=jnp.bfloat16, seed: int = 0,
+                 sharding=None):
+        self.batch_size = batch_size
+        self.image_size = image_size
+        self.num_classes = num_classes
+        self.dtype = dtype
+        self._rng = jax.random.PRNGKey(seed)
+        self._sharding = sharding
+        self._make = jax.jit(
+            lambda rng: synthetic_image_batch(
+                rng, batch_size, image_size, num_classes, dtype),
+            out_shardings=(sharding, sharding) if sharding is not None else None,
+        )
+
+    def __iter__(self) -> Iterator[Tuple[jax.Array, jax.Array]]:
+        return self
+
+    def __next__(self) -> Tuple[jax.Array, jax.Array]:
+        self._rng, sub = jax.random.split(self._rng)
+        return self._make(sub)
+
+
+__all__ = ["synthetic_image_batch", "synthetic_token_batch",
+           "SyntheticImageDataset"]

@@ -1,1 +1,2 @@
-
+from . import resnet  # noqa: F401
+from .resnet import create_model  # noqa: F401

@@ -1,0 +1,134 @@
+"""ResNet v1.5 in Flax — the benchmark workload family.
+
+The reference's example image runs TensorFlow `tf_cnn_benchmarks` ResNet-50/
+101 with Horovod allreduce (reference examples/tensorflow-benchmarks/
+Dockerfile:12-16, README.md:97-133: ResNet-101, batch 64/device, synthetic
+ImageNet). This is the TPU-first reimplementation: NHWC layout (XLA's native
+conv layout on TPU), bfloat16 compute with float32 parameters and batch-norm
+statistics — convs land on the MXU as large batched contractions.
+"""
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Callable, Sequence, Tuple
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+ModuleDef = Any
+
+
+class ResNetBlock(nn.Module):
+    """Basic block (ResNet-18/34)."""
+    filters: int
+    conv: ModuleDef
+    norm: ModuleDef
+    act: Callable
+    strides: Tuple[int, int] = (1, 1)
+
+    @nn.compact
+    def __call__(self, x):
+        residual = x
+        y = self.conv(self.filters, (3, 3), self.strides)(x)
+        y = self.norm()(y)
+        y = self.act(y)
+        y = self.conv(self.filters, (3, 3))(y)
+        y = self.norm(scale_init=nn.initializers.zeros)(y)
+        if residual.shape != y.shape:
+            residual = self.conv(self.filters, (1, 1), self.strides,
+                                 name="conv_proj")(residual)
+            residual = self.norm(name="norm_proj")(residual)
+        return self.act(residual + y)
+
+
+class BottleneckResNetBlock(nn.Module):
+    """Bottleneck block (ResNet-50/101/152); stride on the 3x3 (v1.5, as
+    tf_cnn_benchmarks uses)."""
+    filters: int
+    conv: ModuleDef
+    norm: ModuleDef
+    act: Callable
+    strides: Tuple[int, int] = (1, 1)
+
+    @nn.compact
+    def __call__(self, x):
+        residual = x
+        y = self.conv(self.filters, (1, 1))(x)
+        y = self.norm()(y)
+        y = self.act(y)
+        y = self.conv(self.filters, (3, 3), self.strides)(y)
+        y = self.norm()(y)
+        y = self.act(y)
+        y = self.conv(self.filters * 4, (1, 1))(y)
+        y = self.norm(scale_init=nn.initializers.zeros)(y)
+        if residual.shape != y.shape:
+            residual = self.conv(self.filters * 4, (1, 1), self.strides,
+                                 name="conv_proj")(residual)
+            residual = self.norm(name="norm_proj")(residual)
+        return self.act(residual + y)
+
+
+class ResNet(nn.Module):
+    stage_sizes: Sequence[int]
+    block_cls: ModuleDef
+    num_classes: int = 1000
+    num_filters: int = 64
+    dtype: Any = jnp.bfloat16          # MXU-friendly compute dtype
+    act: Callable = nn.relu
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        conv = partial(nn.Conv, use_bias=False, dtype=self.dtype)
+        norm = partial(
+            nn.BatchNorm,
+            use_running_average=not train,
+            momentum=0.9,
+            epsilon=1e-5,
+            dtype=self.dtype,
+            # keep statistics in f32 regardless of compute dtype
+            param_dtype=jnp.float32,
+        )
+        x = x.astype(self.dtype)
+        x = conv(self.num_filters, (7, 7), (2, 2), padding=[(3, 3), (3, 3)],
+                 name="conv_init")(x)
+        x = norm(name="bn_init")(x)
+        x = self.act(x)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
+        for i, block_size in enumerate(self.stage_sizes):
+            for j in range(block_size):
+                strides = (2, 2) if i > 0 and j == 0 else (1, 1)
+                x = self.block_cls(
+                    self.num_filters * 2 ** i,
+                    strides=strides, conv=conv, norm=norm, act=self.act,
+                )(x)
+        x = jnp.mean(x, axis=(1, 2))
+        # classifier head in f32 for numerically-stable softmax/loss
+        x = nn.Dense(self.num_classes, dtype=jnp.float32,
+                     name="head")(x.astype(jnp.float32))
+        return x
+
+
+ResNet18 = partial(ResNet, stage_sizes=[2, 2, 2, 2], block_cls=ResNetBlock)
+ResNet34 = partial(ResNet, stage_sizes=[3, 4, 6, 3], block_cls=ResNetBlock)
+ResNet50 = partial(ResNet, stage_sizes=[3, 4, 6, 3],
+                   block_cls=BottleneckResNetBlock)
+ResNet101 = partial(ResNet, stage_sizes=[3, 4, 23, 3],
+                    block_cls=BottleneckResNetBlock)
+ResNet152 = partial(ResNet, stage_sizes=[3, 8, 36, 3],
+                    block_cls=BottleneckResNetBlock)
+
+MODELS = {
+    "resnet18": ResNet18, "resnet34": ResNet34, "resnet50": ResNet50,
+    "resnet101": ResNet101, "resnet152": ResNet152,
+}
+
+
+def create_model(name: str, num_classes: int = 1000, dtype=jnp.bfloat16,
+                 **kw) -> nn.Module:
+    if name not in MODELS:
+        raise ValueError(f"unknown resnet {name!r}; have {sorted(MODELS)}")
+    return MODELS[name](num_classes=num_classes, dtype=dtype, **kw)
+
+
+__all__ = ["ResNet", "ResNet18", "ResNet34", "ResNet50", "ResNet101",
+           "ResNet152", "create_model", "MODELS"]

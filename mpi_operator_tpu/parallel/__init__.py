@@ -1,1 +1,5 @@
-
+from .mesh import (  # noqa: F401
+    AXIS_ORDER, BATCH_AXES, MeshConfig, batch_sharding, batch_spec,
+    local_batch_size, make_mesh, replicated_sharding, replicated_spec,
+)
+from . import collectives  # noqa: F401

@@ -1,1 +1,3 @@
-
+from .trainer import (  # noqa: F401
+    TrainState, Trainer, TrainerConfig, cross_entropy_loss, make_sgd,
+)

@@ -4,15 +4,23 @@ Data-plane tests simulate multi-worker collectives on 8 virtual CPU devices
 (the reference tests multi-node declaratively with fake clientsets,
 SURVEY.md §4; we additionally own a data plane, so we use
 --xla_force_host_platform_device_count to exercise real XLA collectives
-without TPUs)."""
+without TPUs).
+
+NOTE: this environment ships an `axon` sitecustomize (PYTHONPATH) that
+forces the TPU platform regardless of JAX_PLATFORMS; overriding via
+jax.config BEFORE any backend is initialized is the reliable channel.
+"""
 import os
 import sys
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 flags = os.environ.get("XLA_FLAGS", "")
 if "--xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

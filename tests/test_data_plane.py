@@ -1,0 +1,157 @@
+"""Data-plane tests on 8 virtual CPU devices (conftest sets
+--xla_force_host_platform_device_count=8): real XLA collectives without TPUs,
+the multi-worker simulation strategy from SURVEY.md §4."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from mpi_operator_tpu.data import SyntheticImageDataset, synthetic_image_batch
+from mpi_operator_tpu.models.resnet import create_model
+from mpi_operator_tpu.parallel import MeshConfig, make_mesh, local_batch_size
+from mpi_operator_tpu.parallel.collectives import (
+    allreduce_gradients, hierarchical_allreduce_mean, sharded_allreduce_fn,
+)
+from mpi_operator_tpu.train import Trainer, TrainerConfig
+
+
+def test_eight_virtual_devices():
+    assert jax.device_count() == 8
+
+
+def test_mesh_data_parallel():
+    mesh = make_mesh(MeshConfig.data_parallel(8))
+    assert mesh.shape["dp"] == 8
+    assert mesh.size == 8
+    assert local_batch_size(64, mesh) == 8
+
+
+def test_mesh_multislice_shape():
+    mesh = make_mesh(MeshConfig.data_parallel(8, num_slices=2))
+    assert mesh.shape["dcn"] == 2 and mesh.shape["dp"] == 4
+
+
+def test_mesh_wrong_device_count_errors():
+    with pytest.raises(ValueError, match="devices"):
+        make_mesh(MeshConfig(dp=4))     # 4 != 8
+
+
+def test_explicit_allreduce_matches_mean():
+    mesh = make_mesh(MeshConfig.data_parallel(8))
+    fn = sharded_allreduce_fn(mesh, ("dp",))
+    x = jnp.arange(8 * 4, dtype=jnp.float32).reshape(8, 4)
+    xs = jax.device_put(x, NamedSharding(mesh, P("dp")))
+    out = fn(xs)
+    np.testing.assert_allclose(out, x.mean(0, keepdims=True), rtol=1e-6)
+
+
+def test_hierarchical_allreduce_matches_flat():
+    """Two-phase ICI/DCN allreduce must equal a plain global mean."""
+    from jax import shard_map
+    mesh = make_mesh(MeshConfig(dp=4, dcn=2))
+    x = jax.random.normal(jax.random.PRNGKey(0), (8, 33))  # odd inner dim
+
+    flat = shard_map(lambda v: jax.lax.pmean(v, ("dcn", "dp")),
+                     mesh=mesh, in_specs=(P(("dcn", "dp")),), out_specs=P())
+    # the scatter/gather chain's replication can't be statically inferred
+    hier = shard_map(
+        lambda v: hierarchical_allreduce_mean(v, ici_axes=("dp",), dcn_axis="dcn"),
+        mesh=mesh, in_specs=(P(("dcn", "dp")),), out_specs=P(),
+        check_vma=False)
+    xs = jax.device_put(x, NamedSharding(mesh, P(("dcn", "dp"))))
+    np.testing.assert_allclose(jax.jit(hier)(xs), jax.jit(flat)(xs),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_allreduce_gradients_pytree():
+    from jax import shard_map
+    mesh = make_mesh(MeshConfig.data_parallel(8))
+    tree = {"w": jnp.ones((8, 2)), "b": jnp.arange(8, dtype=jnp.float32)}
+    fn = shard_map(lambda t: allreduce_gradients(t, ("dp",)),
+                   mesh=mesh,
+                   in_specs=({"w": P("dp"), "b": P("dp")},),
+                   out_specs={"w": P(), "b": P()})
+    out = jax.jit(fn)(jax.device_put(
+        tree, {"w": NamedSharding(mesh, P("dp")),
+               "b": NamedSharding(mesh, P("dp"))}))
+    np.testing.assert_allclose(out["w"], tree["w"].mean(0, keepdims=True))
+
+
+def test_synthetic_batch_shapes():
+    imgs, labels = synthetic_image_batch(
+        jax.random.PRNGKey(0), 16, image_size=32, num_classes=10)
+    assert imgs.shape == (16, 32, 32, 3) and imgs.dtype == jnp.bfloat16
+    assert labels.shape == (16,) and int(labels.max()) < 10
+
+
+def test_resnet_forward_shapes():
+    model = create_model("resnet18", num_classes=10, dtype=jnp.float32)
+    x = jnp.zeros((2, 32, 32, 3))
+    vars_ = model.init(jax.random.PRNGKey(0), x, train=False)
+    logits = model.apply(vars_, x, train=False)
+    assert logits.shape == (2, 10)
+    assert logits.dtype == jnp.float32
+
+
+def test_trainer_step_runs_and_improves_loss():
+    """End-to-end DP train step on the 8-device mesh: loss must drop on a
+    fixed batch (the optimizer + implicit allreduce actually work)."""
+    mesh = make_mesh(MeshConfig.data_parallel(8))
+    model = create_model("resnet18", num_classes=10, dtype=jnp.float32)
+    cfg = TrainerConfig(global_batch_size=16, image_size=32, num_classes=10,
+                        learning_rate=0.05)
+    trainer = Trainer(model, mesh, cfg)
+    state = trainer.init_state(jax.random.PRNGKey(0))
+    imgs, labels = synthetic_image_batch(
+        jax.random.PRNGKey(1), 16, image_size=32, num_classes=10,
+        dtype=jnp.float32)
+    imgs = jax.device_put(imgs, trainer.batch_sharding)
+    labels = jax.device_put(labels, trainer.batch_sharding)
+    state, m0 = trainer.train_step(state, imgs, labels)
+    first = float(m0["loss"])
+    for _ in range(5):
+        state, m = trainer.train_step(state, imgs, labels)
+    assert float(m["loss"]) < first
+    assert int(state.step) == 6
+
+
+def test_trainer_dp_matches_single_device():
+    """Gradient-allreduce correctness: a DP-8 step must produce the same
+    params as a single-device step on the same global batch."""
+    model = create_model("resnet18", num_classes=10, dtype=jnp.float32)
+    cfg = TrainerConfig(global_batch_size=16, image_size=32, num_classes=10)
+    imgs, labels = synthetic_image_batch(
+        jax.random.PRNGKey(1), 16, image_size=32, num_classes=10,
+        dtype=jnp.float32)
+
+    mesh8 = make_mesh(MeshConfig.data_parallel(8))
+    t8 = Trainer(model, mesh8, cfg)
+    s8 = t8.init_state(jax.random.PRNGKey(0))
+    s8, _ = t8.train_step(
+        s8,
+        jax.device_put(imgs, t8.batch_sharding),
+        jax.device_put(labels, t8.batch_sharding))
+
+    mesh1 = make_mesh(MeshConfig.data_parallel(1), devices=jax.devices()[:1])
+    t1 = Trainer(model, mesh1, cfg)
+    s1 = t1.init_state(jax.random.PRNGKey(0))
+    s1, _ = t1.train_step(
+        s1,
+        jax.device_put(imgs, t1.batch_sharding),
+        jax.device_put(labels, t1.batch_sharding))
+
+    flat8 = jax.tree_util.tree_leaves(s8.params)
+    flat1 = jax.tree_util.tree_leaves(s1.params)
+    for a, b in zip(flat8, flat1):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-4, atol=2e-5)
+
+
+def test_synthetic_dataset_sharded():
+    mesh = make_mesh(MeshConfig.data_parallel(8))
+    from mpi_operator_tpu.parallel import batch_sharding
+    ds = SyntheticImageDataset(16, image_size=32, num_classes=10,
+                               sharding=batch_sharding(mesh))
+    imgs, labels = next(iter(ds))
+    assert imgs.sharding.spec == P(("dcn", "dp", "fsdp"))
