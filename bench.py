@@ -42,39 +42,26 @@ def main() -> None:
     import jax
     if args.smoke:
         jax.config.update("jax_platforms", "cpu")
-    import jax.numpy as jnp
-
-    from mpi_operator_tpu.data import SyntheticImageDataset
-    from mpi_operator_tpu.models.resnet import create_model
-    from mpi_operator_tpu.parallel import MeshConfig, batch_sharding, make_mesh
-    from mpi_operator_tpu.train import Trainer, TrainerConfig
-
-    if args.smoke:
         args.model = "resnet18"
         args.batch_per_device = 2
         args.steps = 4
         args.warmup = 1
         args.image_size = 64
 
+    from mpi_operator_tpu.examples.benchmark import run_benchmark
+
     n = jax.device_count()
-    mesh = make_mesh(MeshConfig.data_parallel(n))
-    dtype = jnp.bfloat16 if args.dtype == "bfloat16" else jnp.float32
-    global_batch = args.batch_per_device * n
-
     print(f"# devices: {n} ({jax.devices()[0].device_kind}); model={args.model} "
-          f"global_batch={global_batch} dtype={args.dtype}", file=sys.stderr)
+          f"global_batch={args.batch_per_device * n} dtype={args.dtype}",
+          file=sys.stderr)
 
-    model = create_model(args.model, num_classes=1000, dtype=dtype)
-    cfg = TrainerConfig(global_batch_size=global_batch,
-                        image_size=args.image_size, num_classes=1000)
-    trainer = Trainer(model, mesh, cfg)
-    state = trainer.init_state(jax.random.PRNGKey(0))
-    dataset = SyntheticImageDataset(
-        global_batch, image_size=args.image_size, num_classes=1000,
-        dtype=dtype, sharding=batch_sharding(mesh))
-
-    metrics = trainer.benchmark(
-        state, dataset, num_steps=args.steps, warmup_steps=args.warmup,
+    _state, metrics = run_benchmark(
+        model_name=args.model,
+        batch_per_device=args.batch_per_device,
+        num_steps=args.steps,
+        warmup_steps=args.warmup,
+        image_size=args.image_size,
+        dtype_name=args.dtype,
         log=lambda s: print(s, file=sys.stderr))
 
     per_device = metrics["images_per_sec_per_device"]

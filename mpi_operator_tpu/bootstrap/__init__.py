@@ -1,1 +1,4 @@
-
+from .bootstrap import (  # noqa: F401
+    BootstrapError, ProcessInfo, initialize, process_info,
+    resolve_worker_ordinal,
+)

@@ -1,0 +1,285 @@
+"""Worker/launcher process bootstrap — replaces the reference's entire
+rsh-agent machinery with environment-driven `jax.distributed` initialization.
+
+Reference flow (SURVEY §2.4): mpirun on the launcher reads a hostfile and
+forks `kubexec.sh <pod> orted ...` per worker through the Kubernetes exec
+API (reference pkg/controllers/mpi_job_controller.go:849-885, :1123-1131),
+requiring a kubectl-delivery init container and per-job pods/exec RBAC.
+
+TPU-native flow: every worker pod runs its own process from the pod command.
+At startup the process calls `initialize()` below, which
+  1. reads the env the controller injected (TPU_COORDINATOR_ADDRESS,
+     TPU_NUM_PROCESSES, TPU_WORKER_HOSTNAMES — controller.py
+     _discovery_env), falling back to the ConfigMap mount at /etc/tpu;
+  2. derives its process id from the StatefulSet pod hostname's trailing
+     ordinal (`<job>-worker-<i>`), the stable identity the controller
+     guarantees (reference StatefulSet ServiceName, :1079);
+  3. calls `jax.distributed.initialize(coordinator, num_processes, id)` —
+     after which XLA owns all collective transport over ICI/DCN.
+
+No kubectl, no exec, no rsh. The launcher (TPU_LAUNCHER=1) participates as
+the coordinator host or runs launcher-only logic (monitoring, completion).
+"""
+from __future__ import annotations
+
+import os
+import re
+import socket
+from dataclasses import dataclass
+from typing import Mapping, Optional
+
+# env names match controller.py:_discovery_env
+ENV_COORDINATOR = "TPU_COORDINATOR_ADDRESS"
+ENV_NUM_PROCESSES = "TPU_NUM_PROCESSES"
+ENV_WORKER_HOSTNAMES = "TPU_WORKER_HOSTNAMES"
+ENV_WORKER_ID = "TPU_WORKER_ID"            # explicit override only
+ENV_SLOTS = "TPU_SLOTS_PER_WORKER"
+ENV_LOCAL_RANK = "TPU_LOCAL_RANK"          # set by bootstrap.launch for slots>1
+ENV_CONFIG_PATH = "TPU_CONFIG_PATH"
+ENV_LAUNCHER = "TPU_LAUNCHER"
+ENV_NUM_SLICES = "TPU_NUM_SLICES"
+
+#: rank-0 serves job status here for the launcher's completion poll
+STATUS_PORT = 8477
+
+_ORDINAL_RE = re.compile(r"-(\d+)$")
+
+
+class BootstrapError(RuntimeError):
+    pass
+
+
+@dataclass(frozen=True)
+class ProcessInfo:
+    """Everything jax.distributed.initialize needs, plus topology context."""
+    coordinator_address: str
+    num_processes: int
+    process_id: int
+    slots_per_worker: int = 1
+    num_slices: int = 1
+    is_launcher: bool = False
+
+    @property
+    def is_coordinator(self) -> bool:
+        return self.process_id == 0
+
+
+def resolve_worker_ordinal(hostname: str) -> int:
+    """`<job>-worker-<i>` → i. The hostfile-analogue rank derivation."""
+    m = _ORDINAL_RE.search(hostname)
+    if m is None:
+        raise BootstrapError(
+            f"hostname {hostname!r} carries no trailing ordinal; expected a "
+            f"StatefulSet pod name like 'job-worker-3'")
+    return int(m.group(1))
+
+
+def _read_config_dir(path: str) -> dict:
+    """Fallback discovery from the ConfigMap mount (controller.new_config_map
+    keys), for processes exec'd without the env (debug shells)."""
+    data = {}
+    if not os.path.isdir(path):
+        return data
+    for key in ("coordinator-address", "num-processes", "slots-per-worker",
+                "num-slices"):
+        p = os.path.join(path, key)
+        if os.path.exists(p):
+            with open(p) as f:
+                data[key] = f.read().strip()
+    return data
+
+
+def process_info(
+    env: Optional[Mapping[str, str]] = None,
+    hostname: Optional[str] = None,
+) -> ProcessInfo:
+    """Pure resolution (no jax import) — unit-testable."""
+    env = dict(os.environ if env is None else env)
+    cfg = _read_config_dir(env.get(ENV_CONFIG_PATH, "/etc/tpu"))
+
+    coordinator = env.get(ENV_COORDINATOR) or cfg.get("coordinator-address")
+    if not coordinator:
+        raise BootstrapError(
+            f"{ENV_COORDINATOR} not set and no ConfigMap fallback — was this "
+            f"process started by the TPUJob controller?")
+    num_processes = int(
+        env.get(ENV_NUM_PROCESSES) or cfg.get("num-processes") or 1)
+    slots = int(env.get(ENV_SLOTS) or cfg.get("slots-per-worker") or 1)
+    num_slices = int(env.get(ENV_NUM_SLICES) or cfg.get("num-slices") or 1)
+    is_launcher = env.get(ENV_LAUNCHER) == "1"
+
+    if ENV_WORKER_ID in env:
+        pid = int(env[ENV_WORKER_ID])
+    elif is_launcher or num_processes == 1:
+        # The launcher is NOT a rank (see initialize()); pid 0 here is only
+        # its bookkeeping view. Single-process jobs are rank 0 by definition
+        # — no ordinal-bearing hostname needed (dev boxes, notebooks).
+        pid = 0
+    else:
+        ordinal = resolve_worker_ordinal(hostname or socket.gethostname())
+        # slots>1: bootstrap.launch forks `slots` local processes per worker
+        # (the orted replacement) and tags each with TPU_LOCAL_RANK; the
+        # global rank interleaves exactly like the reference hostfile's
+        # `slots=` lines (ref mpi_job_controller.go:857-869).
+        local_rank = int(env.get(ENV_LOCAL_RANK, 0))
+        if local_rank >= slots:
+            raise BootstrapError(
+                f"{ENV_LOCAL_RANK}={local_rank} >= slots_per_worker {slots}")
+        pid = ordinal * slots + local_rank
+        if pid >= num_processes:
+            raise BootstrapError(
+                f"derived rank {pid} (worker {ordinal}, local {local_rank}) "
+                f">= num_processes {num_processes}")
+    return ProcessInfo(
+        coordinator_address=coordinator,
+        num_processes=num_processes,
+        process_id=pid,
+        slots_per_worker=slots,
+        num_slices=num_slices,
+        is_launcher=is_launcher,
+    )
+
+
+def initialize(env: Optional[Mapping[str, str]] = None,
+               hostname: Optional[str] = None) -> ProcessInfo:
+    """Resolve + `jax.distributed.initialize`.
+
+    The LAUNCHER never joins the process group: it has no TPUs and rank 0
+    lives on worker-0 (whose hostname the coordinator address points at).
+    Like `mpirun` in the reference, the launcher is only the completion
+    signal — it observes rank-0's status channel (`launcher_wait`) and exits
+    with the job's code so the batch Job's success/failure semantics carry
+    over unchanged (ref SURVEY §7 "launcher Job as thin coordinator").
+
+    Single-process jobs (num_processes == 1) also skip distributed init —
+    single-host JAX needs none, keeping dev/test flows zero-config.
+    """
+    info = process_info(env, hostname)
+    if not info.is_launcher and info.num_processes > 1:
+        import jax
+
+        jax.distributed.initialize(
+            coordinator_address=info.coordinator_address,
+            num_processes=info.num_processes,
+            process_id=info.process_id,
+        )
+    return info
+
+
+# ---------------------------------------------------------------------------
+# Completion channel: rank-0 status server ←poll— launcher
+# ---------------------------------------------------------------------------
+# Replaces the completion semantics mpirun gave the reference for free (all
+# ranks are mpirun's children; it exits when they do — SURVEY §3.3). Here
+# ranks are independent pods, so rank-0 exposes a one-line TCP status
+# ("running" | "done <exitcode>") and the launcher polls it.
+
+class StatusServer:
+    """Tiny TCP status endpoint served by rank-0 next to training."""
+
+    def __init__(self, port: int = STATUS_PORT):
+        import threading
+
+        self._state = "running"
+        self._lock = threading.Lock()
+        self._served_done = threading.Event()
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind(("", port))
+        self._sock.listen(8)
+        self.port = self._sock.getsockname()[1]
+        self._thread = threading.Thread(
+            target=self._serve, name="tpu-status", daemon=True)
+        self._thread.start()
+
+    def _serve(self) -> None:
+        while True:
+            try:
+                conn, _ = self._sock.accept()
+            except OSError:
+                return
+            with self._lock:
+                state = self._state
+            try:
+                conn.sendall(state.encode() + b"\n")
+                conn.close()
+            except OSError:
+                pass
+            if state.startswith("done"):
+                self._served_done.set()
+
+    def set_done(self, exit_code: int, linger: float = 10.0) -> None:
+        """Mark done and give the launcher a chance to observe it before the
+        process exits: returns once a poller has read the done state or
+        `linger` elapsed."""
+        with self._lock:
+            self._state = f"done {exit_code}"
+        self._served_done.wait(timeout=linger)
+
+    def close(self) -> None:
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+
+def poll_status(host: str, port: int = STATUS_PORT,
+                timeout: float = 2.0) -> Optional[str]:
+    """One status read; None if unreachable."""
+    try:
+        with socket.create_connection((host, port), timeout=timeout) as conn:
+            return conn.makefile().readline().strip()
+    except OSError:
+        return None
+
+
+def launcher_wait(info: ProcessInfo, port: int = STATUS_PORT,
+                  poll_interval: float = 2.0,
+                  startup_timeout: float = 600.0,
+                  lost_timeout: float = 120.0) -> int:
+    """Block until rank-0 reports completion; return its exit code.
+
+    State machine: before first contact, wait up to `startup_timeout`
+    (workers are already Ready — the controller gates the launcher on that —
+    so rank-0's server appears as soon as its process starts). After contact,
+    an unreachable server for more than `lost_timeout` means the worker pod
+    restarted mid-run (kubelet restarts workers, ref RestartPolicy Always,
+    mpi_job_controller.go:1021); we keep waiting for it to come back and
+    report, failing only at `startup_timeout` scale again. Job-level
+    activeDeadlineSeconds (ref :1221-1222) remains the global stop."""
+    import time as _time
+
+    host = info.coordinator_address.split(":")[0]
+    deadline = _time.monotonic() + startup_timeout
+    seen = False
+    lost_since: Optional[float] = None
+    while True:
+        status = poll_status(host, port, timeout=poll_interval)
+        now = _time.monotonic()
+        if status is None:
+            if not seen:
+                if now > deadline:
+                    raise BootstrapError(
+                        f"rank-0 status channel {host}:{port} unreachable for "
+                        f"{startup_timeout}s")
+            else:
+                lost_since = lost_since or now
+                if now - lost_since > lost_timeout:
+                    # worker restarted and never came back in time
+                    return 1
+        elif status.startswith("done"):
+            parts = status.split()
+            return int(parts[1]) if len(parts) > 1 else 0
+        else:
+            seen = True
+            lost_since = None
+        _time.sleep(poll_interval)
+
+
+__all__ = [
+    "BootstrapError", "ProcessInfo", "initialize", "process_info",
+    "resolve_worker_ordinal",
+    "ENV_COORDINATOR", "ENV_NUM_PROCESSES", "ENV_WORKER_HOSTNAMES",
+    "ENV_WORKER_ID", "ENV_SLOTS", "ENV_CONFIG_PATH", "ENV_LAUNCHER",
+    "ENV_NUM_SLICES",
+]

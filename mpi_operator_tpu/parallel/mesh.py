@@ -17,6 +17,7 @@ Axis vocabulary (scaling-book conventions):
 """
 from __future__ import annotations
 
+import logging
 import math
 from dataclasses import dataclass, field
 from typing import Dict, Optional, Sequence, Tuple
@@ -88,6 +89,13 @@ def make_mesh(config: MeshConfig,
         try:
             dev_array = mesh_utils.create_device_mesh(shape, devices=devices)
         except (ValueError, AssertionError):
+            if devices[0].platform == "tpu":
+                # on real hardware this loses ICI-adjacency-aware placement —
+                # collectives may cross non-neighbor links; say so loudly
+                logging.getLogger(__name__).warning(
+                    "create_device_mesh failed for shape %s on TPU; falling "
+                    "back to enumeration-order layout (topology-unaware — "
+                    "collective performance may degrade)", dict(sizes))
             dev_array = np.asarray(devices).reshape(shape)
     return Mesh(dev_array, AXIS_ORDER)
 

@@ -1,0 +1,77 @@
+"""Checkpoint / resume (orbax-backed).
+
+The reference operator has NO checkpointing — it delegates to the workload
+(the example merely mounts --train_dir on an emptyDir, reference
+examples/tensorflow-benchmarks-imagenet.yaml:32-45; SURVEY §5). We keep the
+same boundary: the operator never touches checkpoints, the workload
+(train side) owns them — but unlike the reference image's TF checkpoint,
+this is orbax, sharding-aware: on restore, arrays land back on the mesh
+with their recorded shardings.
+"""
+from __future__ import annotations
+
+import os
+from typing import Any, Optional
+
+import jax
+import orbax.checkpoint as ocp
+
+from .trainer import TrainState
+
+
+def _state_payload(state: TrainState):
+    """Only the array pytree is persisted; tx/apply_fn are static config
+    reconstructed by the caller."""
+    return {
+        "step": state.step,
+        "params": state.params,
+        "batch_stats": state.batch_stats,
+        "opt_state": state.opt_state,
+    }
+
+
+def save_checkpoint(directory: str, state: TrainState,
+                    step: Optional[int] = None) -> str:
+    """Write a checkpoint under `directory/step_<n>`; returns the path."""
+    step = int(state.step) if step is None else step
+    path = os.path.join(os.path.abspath(directory), f"step_{step}")
+    ckptr = ocp.StandardCheckpointer()
+    ckptr.save(path, _state_payload(state), force=True)
+    ckptr.wait_until_finished()
+    return path
+
+
+def latest_checkpoint(directory: str) -> Optional[str]:
+    directory = os.path.abspath(directory)
+    if not os.path.isdir(directory):
+        return None
+    steps = []
+    for name in os.listdir(directory):
+        if name.startswith("step_") and name[5:].isdigit():
+            steps.append(int(name[5:]))
+    if not steps:
+        return None
+    return os.path.join(directory, f"step_{max(steps)}")
+
+
+def restore_checkpoint(directory_or_path: str, state: TrainState) -> TrainState:
+    """Restore into the structure (and shardings) of `state`. Accepts either
+    a checkpoint path or a directory of step_N checkpoints (takes latest)."""
+    path = directory_or_path
+    if not os.path.basename(path).startswith("step_"):
+        latest = latest_checkpoint(path)
+        if latest is None:
+            raise FileNotFoundError(f"no checkpoints under {path!r}")
+        path = latest
+    ckptr = ocp.StandardCheckpointer()
+    target = jax.tree.map(ocp.utils.to_shape_dtype_struct, _state_payload(state))
+    restored = ckptr.restore(path, target)
+    return state.replace(
+        step=restored["step"],
+        params=restored["params"],
+        batch_stats=restored["batch_stats"],
+        opt_state=restored["opt_state"],
+    )
+
+
+__all__ = ["save_checkpoint", "restore_checkpoint", "latest_checkpoint"]

@@ -146,8 +146,11 @@ class Trainer:
 
     def benchmark(self, state: TrainState, dataset, num_steps: int = 100,
                   warmup_steps: int = 10,
-                  log: Callable[[str], None] = print) -> Dict[str, float]:
+                  log: Callable[[str], None] = print,
+                  ) -> Tuple[TrainState, Dict[str, float]]:
         """Windowed throughput measurement, tf_cnn_benchmarks-style.
+        Returns (final_state, metrics) — the input state is DONATED by the
+        jitted step, so callers must use the returned state afterwards.
 
         Synchronization note: each window is closed by FETCHING the loss
         scalar to the host, not by `block_until_ready` — on remote-relay
@@ -189,7 +192,7 @@ class Trainer:
         log("-" * 40)
         log(f"total images/sec: {total_ips:.2f}")   # ref README.md:127-131
         log("-" * 40)
-        return {
+        return state, {
             "images_per_sec": total_ips,
             "images_per_sec_per_device": total_ips / self.mesh.size,
             "steps": num_steps,

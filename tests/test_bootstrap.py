@@ -1,0 +1,149 @@
+"""Bootstrap-path tests: the env/hostname → jax.distributed resolution that
+replaces the reference's hostfile + kubexec rsh agent (SURVEY §2.4)."""
+import pytest
+
+from mpi_operator_tpu.bootstrap import (
+    BootstrapError, initialize, process_info, resolve_worker_ordinal,
+)
+from mpi_operator_tpu.bootstrap.bootstrap import (
+    ENV_COORDINATOR, ENV_LAUNCHER, ENV_NUM_PROCESSES, ENV_WORKER_ID,
+)
+
+
+def _env(**kw):
+    base = {
+        ENV_COORDINATOR: "job-worker-0.job-worker.default.svc:8476",
+        ENV_NUM_PROCESSES: "4",
+    }
+    base.update(kw)
+    return base
+
+
+def test_ordinal_from_hostname():
+    assert resolve_worker_ordinal("job-worker-3") == 3
+    assert resolve_worker_ordinal("a-b-c-worker-12") == 12
+    with pytest.raises(BootstrapError, match="ordinal"):
+        resolve_worker_ordinal("launcher")
+
+
+def test_process_info_from_worker_hostname():
+    info = process_info(env=_env(), hostname="job-worker-2")
+    assert info.process_id == 2
+    assert info.num_processes == 4
+    assert not info.is_launcher
+    assert not info.is_coordinator
+    assert process_info(env=_env(), hostname="job-worker-0").is_coordinator
+
+
+def test_explicit_worker_id_overrides_hostname():
+    info = process_info(env=_env(**{ENV_WORKER_ID: "1"}),
+                        hostname="job-worker-3")
+    assert info.process_id == 1
+
+
+def test_launcher_gets_rank_zero_without_ordinal():
+    info = process_info(env=_env(**{ENV_LAUNCHER: "1"}), hostname="job-launcher-xyz12")
+    assert info.is_launcher and info.process_id == 0
+
+
+def test_missing_coordinator_is_actionable_error():
+    with pytest.raises(BootstrapError, match="TPU_COORDINATOR_ADDRESS"):
+        process_info(env={}, hostname="job-worker-0")
+
+
+def test_ordinal_out_of_range_rejected():
+    with pytest.raises(BootstrapError, match=">= num_processes"):
+        process_info(env=_env(), hostname="job-worker-9")
+
+
+def test_initialize_single_process_skips_distributed():
+    """num_processes == 1 must not call jax.distributed (dev flow)."""
+    info = initialize(env={ENV_COORDINATOR: "localhost:8476",
+                           ENV_NUM_PROCESSES: "1"},
+                      hostname="job-worker-0")
+    assert info.num_processes == 1
+
+
+def test_slots_interleave_global_rank():
+    """slots>1: global rank = ordinal*slots + local (hostfile `slots=` parity,
+    ref mpi_job_controller.go:857-869)."""
+    env = _env(**{"TPU_SLOTS_PER_WORKER": "4", "TPU_NUM_PROCESSES": "8",
+                  "TPU_LOCAL_RANK": "2"})
+    info = process_info(env=env, hostname="job-worker-1")
+    assert info.process_id == 6
+    with pytest.raises(BootstrapError, match="TPU_LOCAL_RANK"):
+        process_info(env=_env(**{"TPU_SLOTS_PER_WORKER": "2",
+                                 "TPU_LOCAL_RANK": "2"}),
+                     hostname="job-worker-0")
+
+
+def test_launcher_never_joins_process_group():
+    """The launcher must not call jax.distributed.initialize — rank 0 lives
+    on worker-0 (rank-collision regression)."""
+    import mpi_operator_tpu.bootstrap.bootstrap as bs
+    called = []
+    # num_processes=4 would normally trigger distributed init
+    env = _env(**{ENV_LAUNCHER: "1"})
+    import unittest.mock as mock
+    with mock.patch.dict("sys.modules"):
+        info = bs.initialize(env=env, hostname="anything")
+    assert info.is_launcher and info.process_id == 0
+    del called
+
+
+def test_status_channel_and_launcher_wait():
+    """rank-0 StatusServer ←poll— launcher: running → done <code>."""
+    import threading
+    from mpi_operator_tpu.bootstrap.bootstrap import (
+        ProcessInfo, StatusServer, launcher_wait, poll_status,
+    )
+    server = StatusServer(port=0)
+    try:
+        assert poll_status("localhost", server.port) == "running"
+        info = ProcessInfo(coordinator_address=f"localhost:8476",
+                           num_processes=2, process_id=0, is_launcher=True)
+        result = {}
+        t = threading.Thread(target=lambda: result.update(
+            code=launcher_wait(info, port=server.port, poll_interval=0.05)))
+        t.start()
+        server.set_done(3, linger=5.0)
+        t.join(timeout=5)
+        assert result["code"] == 3
+    finally:
+        server.close()
+
+
+def test_launcher_wait_startup_timeout():
+    from mpi_operator_tpu.bootstrap.bootstrap import ProcessInfo, launcher_wait
+    info = ProcessInfo(coordinator_address="localhost:1", num_processes=2,
+                       process_id=0, is_launcher=True)
+    with pytest.raises(BootstrapError, match="unreachable"):
+        launcher_wait(info, port=1, poll_interval=0.05, startup_timeout=0.3)
+
+
+def test_launch_forks_slots_and_propagates_failure(tmp_path):
+    """The orted-replacement: forks slots processes with TPU_LOCAL_RANK and
+    returns the first non-zero exit code."""
+    import sys
+    from mpi_operator_tpu.bootstrap.launch import launch
+    out = tmp_path / "ranks"
+    out.mkdir()
+    code = launch([sys.executable, "-c",
+                   "import os, pathlib; pathlib.Path("
+                   f"'{out}', os.environ['TPU_LOCAL_RANK']).write_text('x')"],
+                  slots=3)
+    assert code == 0
+    assert sorted(p.name for p in out.iterdir()) == ["0", "1", "2"]
+    code = launch([sys.executable, "-c",
+                   "import os, sys; sys.exit(5 if "
+                   "os.environ['TPU_LOCAL_RANK']=='1' else 0)"], slots=2)
+    assert code == 5
+
+
+def test_config_dir_fallback(tmp_path):
+    (tmp_path / "coordinator-address").write_text("cm-host:8476\n")
+    (tmp_path / "num-processes").write_text("2\n")
+    info = process_info(env={"TPU_CONFIG_PATH": str(tmp_path)},
+                        hostname="job-worker-1")
+    assert info.coordinator_address == "cm-host:8476"
+    assert info.num_processes == 2 and info.process_id == 1
