@@ -41,6 +41,10 @@ ENV_NUM_SLICES = "TPU_NUM_SLICES"
 
 #: rank-0 serves job status here for the launcher's completion poll
 STATUS_PORT = 8477
+# launcher gave up on an unreachable rank-0 (infra loss, NOT a workload
+# failure); chosen in the 128-255 "retryable" band of the reference's
+# v1alpha2 exit-code policy (ref common_types.go:150-155)
+LAUNCHER_LOST_EXIT = 213
 
 _ORDINAL_RE = re.compile(r"-(\d+)$")
 
@@ -242,11 +246,14 @@ def launcher_wait(info: ProcessInfo, port: int = STATUS_PORT,
     State machine: before first contact, wait up to `startup_timeout`
     (workers are already Ready — the controller gates the launcher on that —
     so rank-0's server appears as soon as its process starts). After contact,
-    an unreachable server for more than `lost_timeout` means the worker pod
-    restarted mid-run (kubelet restarts workers, ref RestartPolicy Always,
-    mpi_job_controller.go:1021); we keep waiting for it to come back and
-    report, failing only at `startup_timeout` scale again. Job-level
-    activeDeadlineSeconds (ref :1221-1222) remains the global stop."""
+    an unreachable server means the worker pod restarted mid-run (kubelet
+    restarts workers, ref RestartPolicy Always, mpi_job_controller.go:1021);
+    we tolerate the outage for `lost_timeout` (rescheduling, image pull) and
+    then KEEP waiting up to a fresh `startup_timeout` window before giving
+    up with LAUNCHER_LOST_EXIT — an exit code distinct from workload codes
+    so operators can tell an infra loss from an application failure.
+    Job-level activeDeadlineSeconds (ref :1221-1222) remains the global
+    stop."""
     import time as _time
 
     host = info.coordinator_address.split(":")[0]
@@ -264,9 +271,9 @@ def launcher_wait(info: ProcessInfo, port: int = STATUS_PORT,
                         f"{startup_timeout}s")
             else:
                 lost_since = lost_since or now
-                if now - lost_since > lost_timeout:
-                    # worker restarted and never came back in time
-                    return 1
+                if now - lost_since > lost_timeout + startup_timeout:
+                    # worker restarted and never came back in startup scale
+                    return LAUNCHER_LOST_EXIT
         elif status.startswith("done"):
             parts = status.split()
             return int(parts[1]) if len(parts) > 1 else 0

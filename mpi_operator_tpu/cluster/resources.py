@@ -58,6 +58,21 @@ class RoleBinding:
 
 
 @dataclass
+class Service:
+    """Headless Service giving workers their stable DNS names
+    (`<job>-worker-<i>.<job>-worker.<ns>.svc`). The reference never creates
+    one — its hostfile names resolve via the StatefulSet's governing service
+    that operators had to pre-provision; here the controller owns it so
+    worker discovery works with zero cluster prerequisites (StatefulSet
+    ServiceName, ref mpi_job_controller.go:1079)."""
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    cluster_ip: str = "None"              # headless
+    selector: Dict[str, str] = field(default_factory=dict)
+    ports: List[int] = field(default_factory=list)
+    kind: str = "Service"
+
+
+@dataclass
 class PodDisruptionBudget:
     """ref: newPDB (mpi_job_controller.go:969-986) — gang scheduling hint
     (minAvailable = worker replicas) for the batch scheduler."""
@@ -128,7 +143,7 @@ def deepcopy_resource(obj):
 
 __all__ = [
     "ConfigMap", "ServiceAccount", "PolicyRule", "Role", "RoleBinding",
-    "PodDisruptionBudget", "StatefulSet", "StatefulSetSpec",
+    "PodDisruptionBudget", "Service", "StatefulSet", "StatefulSetSpec",
     "StatefulSetStatus", "Job", "JobSpec", "JobStatus", "Container",
     "deepcopy_resource",
 ]

@@ -51,6 +51,7 @@ from ..cluster.resources import (
     PolicyRule,
     Role,
     RoleBinding,
+    Service,
     ServiceAccount,
     StatefulSet,
     StatefulSetSpec,
@@ -158,6 +159,7 @@ class TPUJobController:
         self.statefulset_informer = self.factory.informer("StatefulSet")
         self.batchjob_informer = self.factory.informer("Job")
         self.pdb_informer = self.factory.informer("PodDisruptionBudget")
+        self.service_informer = self.factory.informer("Service")
 
         self.job_lister = self.job_informer.lister()
         self.configmap_lister = self.configmap_informer.lister()
@@ -167,6 +169,7 @@ class TPUJobController:
         self.statefulset_lister = self.statefulset_informer.lister()
         self.batchjob_lister = self.batchjob_informer.lister()
         self.pdb_lister = self.pdb_informer.lister()
+        self.service_lister = self.service_informer.lister()
 
         # TPUJob events: enqueue the job itself (ref :204-209)
         self.job_informer.add_event_handler(
@@ -178,6 +181,7 @@ class TPUJobController:
             self.configmap_informer, self.sa_informer, self.role_informer,
             self.rolebinding_informer, self.statefulset_informer,
             self.batchjob_informer, self.pdb_informer,
+            self.service_informer,
         ):
             informer.add_event_handler(
                 on_add=self.handle_object,
@@ -275,6 +279,10 @@ class TPUJobController:
 
         if not done:
             self.get_or_create_config_map(job, alloc)          # ref :470
+            # headless Service — gives workers the stable DNS names the
+            # discovery data points at (no reference equivalent: the
+            # reference assumed a pre-provisioned governing service)
+            self.get_or_create_worker_service(job)
             self.get_or_create_launcher_service_account(job)   # ref :475
             self.get_or_create_launcher_role(job, alloc.worker_replicas)  # ref :480
             self.get_or_create_launcher_role_binding(job)      # ref :485
@@ -405,6 +413,30 @@ class TPUJobController:
             existing.data = desired.data
             return self.api.update(existing)
         return existing
+
+    def get_or_create_worker_service(self, job: TPUJob) -> Service:
+        """Headless governing Service for the worker StatefulSet — the DNS
+        backing for the hostnames published in the ConfigMap."""
+        name = job.metadata.name + WORKER_SUFFIX
+        existing = self.service_lister.try_get(job.metadata.namespace, name)
+        if existing is None:
+            return self.api.create(self.new_worker_service(job))
+        return self._check_ownership(existing, job)
+
+    def new_worker_service(self, job: TPUJob) -> Service:
+        name = job.metadata.name + WORKER_SUFFIX
+        return Service(
+            metadata=ObjectMeta(
+                name=name,
+                namespace=job.metadata.namespace,
+                labels={LABEL_GROUP: job.metadata.name},
+                owner_references=[job.controller_owner_reference()],
+            ),
+            cluster_ip="None",
+            selector={LABEL_GROUP: job.metadata.name,
+                      "tpu_job_role": "worker"},
+            ports=[COORDINATOR_PORT],
+        )
 
     def get_or_create_launcher_service_account(self, job: TPUJob) -> ServiceAccount:
         """ref: getOrCreateLauncherServiceAccount (:652-673)."""
@@ -633,6 +665,7 @@ class TPUJobController:
                 template.node_selector[NS_TOPOLOGY] = job.spec.slice_topology
         template.metadata.labels = {
             **template.metadata.labels, LABEL_GROUP: job.metadata.name,
+            "tpu_job_role": "worker",     # headless Service selector target
         }
         return StatefulSet(
             metadata=ObjectMeta(

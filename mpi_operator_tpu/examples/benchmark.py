@@ -31,11 +31,13 @@ def run_benchmark(
     dtype_name: str = "bfloat16",
     num_slices: int = 1,
     learning_rate: float = 0.1,
+    data_dir: Optional[str] = None,
     log: Callable[[str], None] = print,
 ) -> Tuple[object, Dict[str, float]]:
     """Shared wiring for every benchmark surface (bench.py, the container
-    entrypoint, tests): mesh over all visible devices, synthetic data,
-    DP train loop. Returns (final_state, metrics)."""
+    entrypoint, tests): mesh over all visible devices, synthetic or on-disk
+    data (`data_dir` — npy shards, data/imagefolder.py), DP train loop.
+    Returns (final_state, metrics)."""
     import jax
     import jax.numpy as jnp
 
@@ -55,11 +57,21 @@ def run_benchmark(
                         learning_rate=learning_rate)
     trainer = Trainer(model, mesh, cfg)
     state = trainer.init_state(jax.random.PRNGKey(0))
-    dataset = SyntheticImageDataset(
-        global_batch, image_size=image_size, num_classes=1000,
-        dtype=dtype, sharding=batch_sharding(mesh))
-    return trainer.benchmark(state, dataset, num_steps=num_steps,
-                             warmup_steps=warmup_steps, log=log)
+    if data_dir is not None:
+        from ..data.imagefolder import NpyImageDataset
+        dataset = NpyImageDataset(
+            data_dir, global_batch, image_size=image_size, dtype=dtype,
+            sharding=batch_sharding(mesh))
+    else:
+        dataset = SyntheticImageDataset(
+            global_batch, image_size=image_size, num_classes=1000,
+            dtype=dtype, sharding=batch_sharding(mesh))
+    try:
+        return trainer.benchmark(state, dataset, num_steps=num_steps,
+                                 warmup_steps=warmup_steps, log=log)
+    finally:
+        if hasattr(dataset, "close"):
+            dataset.close()
 
 
 def print_banner(model: str, global_batch: int, per_device: int, n: int,
@@ -126,12 +138,18 @@ def main(argv=None) -> int:
             dtype_name=args.dtype,
             num_slices=info.num_slices,
             learning_rate=args.learning_rate,
+            data_dir=args.data_dir,
             log=print if info.is_coordinator else (lambda s: None))
 
-        if args.train_dir and info.is_coordinator:
+        if args.train_dir:
+            # EVERY process must enter the save: orbax's save is a collective
+            # over all JAX processes (it barriers internally); gating it on
+            # the coordinator deadlocks multi-host jobs. Orbax itself
+            # restricts the actual write to the primary host.
             from ..train.checkpoint import save_checkpoint
             save_checkpoint(args.train_dir, state)
-            print(f"checkpoint written to {args.train_dir}")
+            if info.is_coordinator:
+                print(f"checkpoint written to {args.train_dir}")
         exit_code = 0
         return 0
     finally:

@@ -1,2 +1,7 @@
 from . import resnet  # noqa: F401
 from .resnet import create_model  # noqa: F401
+from . import transformer  # noqa: F401,E402
+from .transformer import (  # noqa: F401,E402
+    CausalLM, MaskedLM, TransformerConfig, ViT, bert_config, create_lm,
+    create_vit, gpt2_config, vit_config,
+)

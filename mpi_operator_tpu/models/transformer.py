@@ -1,0 +1,373 @@
+"""Sharding-annotated Transformer backbone + the BASELINE model ladder.
+
+The reference's workload ladder (BASELINE.json configs) goes beyond its
+in-repo ResNet example: BERT-large pretraining, GPT-2-medium LM, and
+ViT-B/16 multi-slice. The reference would run these as opaque container
+images under mpirun (SURVEY.md §2.2 — all model code out-of-repo); here they
+are first-class JAX models built TPU-first:
+
+- bfloat16 compute / float32 params, matmuls shaped for the MXU
+  (head_dim and mlp dims multiples of 128),
+- every parameter annotated with *logical* axes
+  (`nn.with_logical_partitioning`) so tensor parallelism / FSDP are rule-table
+  choices (parallel/sharding.py), not model rewrites — the Megatron recipe
+  (column-parallel QKV+FFN-in, row-parallel proj+FFN-out) falls out of the
+  "mlp"/"heads" → tp rules with XLA inserting the collectives,
+- attention pluggable: dense, Pallas flash kernel (ops/attention.py), or
+  ring attention over the sp axis (parallel/ring_attention.py) for
+  long-context.
+
+One backbone serves three families:
+  CausalLM  — GPT-2 (learned positions, causal mask, tied LM head)
+  MaskedLM  — BERT (bidirectional, token-type embeddings, MLM head)
+  ViT       — patchify + [CLS] + encoder + classifier head
+"""
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Any, Callable, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+Dtype = Any
+
+kernel_init = nn.initializers.normal(stddev=0.02)   # GPT-2/BERT init
+
+
+@dataclasses.dataclass(frozen=True)
+class TransformerConfig:
+    vocab_size: int = 50257
+    max_len: int = 1024
+    num_layers: int = 12
+    num_heads: int = 12
+    embed_dim: int = 768
+    mlp_dim: int = 3072
+    dropout_rate: float = 0.0
+    causal: bool = True
+    use_token_types: bool = False      # BERT segment embeddings
+    dtype: Dtype = jnp.bfloat16
+    attention: str = "auto"            # auto | dense | flash | ring
+    remat: bool = False                # jax.checkpoint each block
+    # MoE: replace the FFN of every `moe_every`-th block with a mixture of
+    # experts (0 = dense FFN everywhere)
+    num_experts: int = 0
+    moe_every: int = 2
+    moe_top_k: int = 2
+
+    @property
+    def head_dim(self) -> int:
+        assert self.embed_dim % self.num_heads == 0
+        return self.embed_dim // self.num_heads
+
+
+def _dense(features, name, logical_axes, dtype):
+    return nn.Dense(
+        features, dtype=dtype, name=name,
+        kernel_init=nn.with_logical_partitioning(kernel_init, logical_axes),
+        bias_init=nn.with_logical_partitioning(
+            nn.initializers.zeros, (logical_axes[-1],)),
+    )
+
+
+class Attention(nn.Module):
+    """Multi-head self-attention, heads sharded over tp.
+
+    QKV projections are column-parallel ("embed" → "heads"/"kv"), the output
+    projection row-parallel ("heads" → "embed") — with params replicated this
+    reduces to plain MHA; with tp rules active XLA emits the Megatron
+    collective pair automatically.
+    """
+    config: TransformerConfig
+
+    @nn.compact
+    def __call__(self, x, mask=None):
+        cfg = self.config
+        B, S, E = x.shape
+        H, D = cfg.num_heads, cfg.head_dim
+        proj = partial(
+            nn.DenseGeneral, axis=-1, dtype=cfg.dtype,
+            features=(H, D),
+            kernel_init=nn.with_logical_partitioning(
+                kernel_init, ("embed", "heads", "kv")),
+            bias_init=nn.with_logical_partitioning(
+                nn.initializers.zeros, ("heads", "kv")),
+        )
+        q = proj(name="query")(x)
+        k = proj(name="key")(x)
+        v = proj(name="value")(x)
+
+        out = _attend(q, k, v, mask=mask, cfg=cfg)
+
+        out = nn.DenseGeneral(
+            features=E, axis=(-2, -1), dtype=cfg.dtype, name="out",
+            kernel_init=nn.with_logical_partitioning(
+                kernel_init, ("heads", "kv", "embed")),
+            bias_init=nn.with_logical_partitioning(
+                nn.initializers.zeros, ("embed",)),
+        )(out)
+        return out
+
+
+def _attend(q, k, v, mask, cfg: TransformerConfig):
+    """Dispatch to the configured attention implementation.
+    q/k/v: [B, S, H, D]; returns [B, S, H, D]."""
+    impl = cfg.attention
+    if impl == "auto":
+        # flash kernel only on TPU; dense elsewhere (CPU tests/simulation)
+        impl = "flash" if jax.default_backend() == "tpu" else "dense"
+    if impl == "flash":
+        from ..ops.attention import flash_attention
+        return flash_attention(q, k, v, causal=cfg.causal)
+    if impl == "ring":
+        from ..parallel.ring_attention import ring_attention_inner
+        # inside shard_map the seq dim is already the local shard
+        return ring_attention_inner(q, k, v, axis_name="sp",
+                                    causal=cfg.causal)
+    return dense_attention(q, k, v, mask=mask, causal=cfg.causal,
+                           dtype=cfg.dtype)
+
+
+def dense_attention(q, k, v, mask=None, causal=True, dtype=jnp.float32):
+    """Reference O(S²) attention. Softmax in f32 for stability."""
+    D = q.shape[-1]
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32)
+    logits = logits / jnp.sqrt(D).astype(jnp.float32)
+    if causal:
+        S_q, S_k = logits.shape[-2], logits.shape[-1]
+        causal_mask = jnp.tril(jnp.ones((S_q, S_k), bool))
+        logits = jnp.where(causal_mask[None, None], logits, -1e30)
+    if mask is not None:
+        # mask: [B, S_k] valid-token mask
+        logits = jnp.where(mask[:, None, None, :], logits, -1e30)
+    probs = jax.nn.softmax(logits, axis=-1).astype(dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+
+
+class Mlp(nn.Module):
+    """FFN: column-parallel in ("embed"→"mlp"), row-parallel out."""
+    config: TransformerConfig
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.config
+        h = _dense(cfg.mlp_dim, "fc_in", ("embed", "mlp"), cfg.dtype)(x)
+        h = nn.gelu(h)
+        return _dense(cfg.embed_dim, "fc_out", ("mlp", "embed"), cfg.dtype)(h)
+
+
+def _layer_norm(cfg, name):
+    return nn.LayerNorm(
+        dtype=cfg.dtype, name=name, epsilon=1e-5,
+        scale_init=nn.with_logical_partitioning(nn.initializers.ones,
+                                                ("norm",)),
+        bias_init=nn.with_logical_partitioning(nn.initializers.zeros,
+                                               ("norm",)))
+
+
+class Block(nn.Module):
+    """Pre-LN transformer block (GPT-2/ViT style)."""
+    config: TransformerConfig
+    use_moe: bool = False
+
+    @nn.compact
+    def __call__(self, x, mask=None):
+        cfg = self.config
+        y = _layer_norm(cfg, "ln_1")(x)
+        x = x + Attention(cfg, name="attn")(y, mask=mask)
+        y = _layer_norm(cfg, "ln_2")(x)
+        if self.use_moe:
+            from ..parallel.moe import MoeMlp
+            ff, aux = MoeMlp(
+                num_experts=cfg.num_experts, top_k=cfg.moe_top_k,
+                embed_dim=cfg.embed_dim, mlp_dim=cfg.mlp_dim,
+                dtype=cfg.dtype, name="moe")(y)
+            self.sow("intermediates", "moe_aux_loss", aux)
+        else:
+            ff = Mlp(cfg, name="mlp")(y)
+        return x + ff
+
+
+class Backbone(nn.Module):
+    """Stack of blocks over pre-embedded input."""
+    config: TransformerConfig
+
+    @nn.compact
+    def __call__(self, h, mask=None):
+        cfg = self.config
+        block = Block
+        if cfg.remat:
+            block = nn.remat(Block, static_argnums=())
+        for i in range(cfg.num_layers):
+            use_moe = (cfg.num_experts > 0
+                       and i % cfg.moe_every == cfg.moe_every - 1)
+            h = block(cfg, use_moe=use_moe, name=f"block_{i}")(h, mask=mask)
+        return _layer_norm(cfg, "ln_f")(h)
+
+
+def _embed(cfg, num, features, name, logical0):
+    return nn.Embed(
+        num, features, dtype=cfg.dtype, name=name,
+        embedding_init=nn.with_logical_partitioning(
+            kernel_init, (logical0, "embed")))
+
+
+class CausalLM(nn.Module):
+    """GPT-2-style decoder LM: learned positions, tied LM head
+    (reference capability: "GPT-2 medium JAX data-parallel MPIJob",
+    BASELINE.json configs[3])."""
+    config: TransformerConfig
+
+    @nn.compact
+    def __call__(self, tokens):
+        cfg = self.config
+        B, S = tokens.shape
+        wte = _embed(cfg, cfg.vocab_size, cfg.embed_dim, "wte", "vocab")
+        wpe = _embed(cfg, cfg.max_len, cfg.embed_dim, "wpe", None)
+        h = wte(tokens) + wpe(jnp.arange(S)[None])
+        h = Backbone(cfg, name="backbone")(h)
+        # tied LM head; logits in f32 for a stable softmax-xent
+        logits = wte.attend(h.astype(jnp.float32))
+        return logits
+
+
+class MaskedLM(nn.Module):
+    """BERT-style bidirectional encoder + MLM head
+    (reference capability: "BERT-large pretraining MPIJob",
+    BASELINE.json configs[2])."""
+    config: TransformerConfig
+
+    @nn.compact
+    def __call__(self, tokens, token_types=None, attention_mask=None):
+        cfg = self.config
+        assert not cfg.causal, "MaskedLM needs causal=False"
+        B, S = tokens.shape
+        wte = _embed(cfg, cfg.vocab_size, cfg.embed_dim, "wte", "vocab")
+        h = wte(tokens) + _embed(cfg, cfg.max_len, cfg.embed_dim, "wpe",
+                                 None)(jnp.arange(S)[None])
+        if cfg.use_token_types:
+            if token_types is None:
+                token_types = jnp.zeros_like(tokens)
+            h = h + _embed(cfg, 2, cfg.embed_dim, "wtte", None)(token_types)
+        h = _layer_norm(cfg, "ln_emb")(h)
+        h = Backbone(cfg, name="backbone")(h, mask=attention_mask)
+        # MLM transform head (dense + gelu + LN), then tied decoder
+        h = _dense(cfg.embed_dim, "mlm_dense", ("embed", "embed"),
+                   cfg.dtype)(h)
+        h = nn.gelu(h)
+        h = _layer_norm(cfg, "mlm_ln")(h)
+        logits = wte.attend(h.astype(jnp.float32))
+        logits = logits + self.param(
+            "mlm_bias",
+            nn.with_logical_partitioning(nn.initializers.zeros, ("vocab",)),
+            (cfg.vocab_size,), jnp.float32)
+        return logits
+
+
+class ViT(nn.Module):
+    """ViT-B/16-style image classifier
+    (reference capability: "ViT-B/16 multi-slice MPIJob",
+    BASELINE.json configs[4])."""
+    config: TransformerConfig
+    num_classes: int = 1000
+    patch_size: int = 16
+
+    @nn.compact
+    def __call__(self, images, train: bool = True):
+        del train   # no dropout by default; signature-compatible w/ ResNet
+        cfg = self.config
+        p = self.patch_size
+        B, H, W, C = images.shape
+        x = nn.Conv(
+            cfg.embed_dim, (p, p), strides=(p, p), dtype=cfg.dtype,
+            name="patch_embed",
+            kernel_init=nn.with_logical_partitioning(
+                kernel_init, (None, None, None, "embed")),
+            bias_init=nn.with_logical_partitioning(
+                nn.initializers.zeros, ("embed",)),
+        )(images.astype(cfg.dtype))
+        x = x.reshape(B, -1, cfg.embed_dim)
+        cls = self.param(
+            "cls",
+            nn.with_logical_partitioning(nn.initializers.zeros,
+                                         (None, None, "embed")),
+            (1, 1, cfg.embed_dim), jnp.float32)
+        x = jnp.concatenate(
+            [jnp.broadcast_to(cls, (B, 1, cfg.embed_dim)).astype(cfg.dtype),
+             x], axis=1)
+        x = x + _embed(cfg, x.shape[1], cfg.embed_dim, "pos",
+                       None)(jnp.arange(x.shape[1])[None])
+        x = Backbone(cfg, name="backbone")(x)
+        return _dense(self.num_classes, "head", ("embed", "vocab"),
+                      jnp.float32)(x[:, 0].astype(jnp.float32))
+
+
+# ---------------------------------------------------------------------------
+# The BASELINE.json ladder presets
+# ---------------------------------------------------------------------------
+
+def gpt2_config(size: str = "medium", **overrides) -> TransformerConfig:
+    dims = {
+        "small": (12, 12, 768),
+        "medium": (24, 16, 1024),        # the BASELINE config
+        "large": (36, 20, 1280),
+        "xl": (48, 25, 1600),
+        "test": (2, 4, 128),
+    }[size]
+    L, H, E = dims
+    base = dict(vocab_size=50257, max_len=1024, num_layers=L, num_heads=H,
+                embed_dim=E, mlp_dim=4 * E, causal=True)
+    base.update(overrides)
+    return TransformerConfig(**base)
+
+
+def bert_config(size: str = "large", **overrides) -> TransformerConfig:
+    dims = {
+        "base": (12, 12, 768),
+        "large": (24, 16, 1024),         # the BASELINE config
+        "test": (2, 4, 128),
+    }[size]
+    L, H, E = dims
+    base = dict(vocab_size=30522, max_len=512, num_layers=L, num_heads=H,
+                embed_dim=E, mlp_dim=4 * E, causal=False,
+                use_token_types=True)
+    base.update(overrides)
+    return TransformerConfig(**base)
+
+
+def vit_config(size: str = "b16", **overrides) -> TransformerConfig:
+    dims = {
+        "b16": (12, 12, 768, 3072),      # the BASELINE config (ViT-B/16)
+        "l16": (24, 16, 1024, 4096),
+        "test": (2, 4, 128, 256),
+    }[size]
+    L, H, E, M = dims
+    base = dict(vocab_size=1, max_len=2048, num_layers=L, num_heads=H,
+                embed_dim=E, mlp_dim=M, causal=False)
+    base.update(overrides)
+    return TransformerConfig(**base)
+
+
+def create_lm(name: str = "gpt2-medium", **overrides):
+    """Factory mirroring models.resnet.create_model."""
+    family, _, size = name.partition("-")
+    size = size or None
+    if family == "gpt2":
+        return CausalLM(gpt2_config(size or "medium", **overrides))
+    if family == "bert":
+        return MaskedLM(bert_config(size or "large", **overrides))
+    raise ValueError(f"unknown LM {name!r}")
+
+
+def create_vit(name: str = "vit-b16", num_classes: int = 1000, **overrides):
+    size = name.split("-", 1)[1] if "-" in name else "b16"
+    return ViT(vit_config(size, **overrides), num_classes=num_classes)
+
+
+__all__ = [
+    "TransformerConfig", "Attention", "Mlp", "Block", "Backbone",
+    "CausalLM", "MaskedLM", "ViT", "dense_attention",
+    "gpt2_config", "bert_config", "vit_config", "create_lm", "create_vit",
+]

@@ -1,0 +1,217 @@
+"""Flash attention — Pallas TPU kernel for the hot op.
+
+The reference delegates all device compute to out-of-repo CUDA libraries
+(SURVEY.md §2.2); this is the TPU-native hot-path kernel built per
+/opt/skills/guides/pallas_guide.md: the attention score matrix never
+materializes in HBM. Grid = (batch×heads, q_blocks, k_blocks) with the
+k-block loop innermost; VMEM scratch carries the online-softmax state
+(running max m, running sum l, f32 accumulator) across k iterations, and the
+output block is written once on the last k step. Matmuls are MXU-shaped
+([block, head_dim] × [head_dim, block], preferred_element_type=f32);
+block sizes default to 128 lanes.
+
+Causal jobs skip fully-masked k-blocks (predicated with @pl.when, so the
+MXU never sees them) and apply a triangular mask only on diagonal blocks.
+
+Backward pass: custom_vjp with residuals (q, k, v, out, lse). Gradients are
+computed blockwise over k with `lax.scan` in plain JAX — the same
+flash recurrence (never materializing [S, S] for all heads at once), fused
+by XLA; a dedicated Pallas bwd kernel is a later optimization.
+
+On CPU (tests, simulation) the identical kernel runs in interpret mode.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e30
+
+
+# ---------------------------------------------------------------------------
+# Forward Pallas kernel
+# ---------------------------------------------------------------------------
+
+def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
+                      acc_ref, m_ref, l_ref, *, sm_scale: float,
+                      causal: bool, block_q: int, block_k: int):
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+    nk = pl.num_programs(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+        m_ref[:] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+
+    # causal: k-block strictly above the diagonal touches nothing
+    run = True
+    if causal:
+        run = ki * block_k <= qi * block_q + (block_q - 1)
+
+    @pl.when(run)
+    def _attend():
+        q = q_ref[0]                              # [block_q, d]
+        k = k_ref[0]                              # [block_k, d]
+        v = v_ref[0]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * sm_scale
+        if causal:
+            rows = jax.lax.broadcasted_iota(jnp.int32,
+                                            (block_q, block_k), 0)
+            cols = jax.lax.broadcasted_iota(jnp.int32,
+                                            (block_q, block_k), 1)
+            mask = (qi * block_q + rows) >= (ki * block_k + cols)
+            s = jnp.where(mask, s, NEG_INF)
+
+        m_prev = m_ref[:, :1]                     # [block_q, 1]
+        l_prev = l_ref[:, :1]
+        m_cur = jnp.max(s, axis=-1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        alpha = jnp.exp(m_prev - m_new)
+        p = jnp.exp(s - m_new)                    # [block_q, block_k]
+        l_new = l_prev * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        acc_ref[:] = acc_ref[:] * alpha + jax.lax.dot_general(
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        m_ref[:, :1] = m_new
+        l_ref[:, :1] = l_new
+
+    @pl.when(ki == nk - 1)
+    def _finalize():
+        l = jnp.maximum(l_ref[:, :1], 1e-30)
+        o_ref[0] = (acc_ref[:] / l).astype(o_ref.dtype)
+        lse_ref[0] = (m_ref[:, :1] + jnp.log(l))[:, 0]
+
+
+def _flash_fwd(q, k, v, sm_scale, causal, block_q, block_k, interpret):
+    """q/k/v: [BH, S, D] -> (out [BH, S, D], lse [BH, S])."""
+    BH, S, D = q.shape
+    nq = S // block_q
+    nk = S // block_k
+    grid = (BH, nq, nk)
+    kern = functools.partial(
+        _flash_fwd_kernel, sm_scale=sm_scale, causal=causal,
+        block_q=block_q, block_k=block_k)
+    out, lse = pl.pallas_call(
+        kern,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_k, D), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_k, D), lambda b, i, j: (b, j, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_q), lambda b, i, j: (b, i)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((BH, S, D), q.dtype),
+            jax.ShapeDtypeStruct((BH, S), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_q, D), jnp.float32),     # acc
+            pltpu.VMEM((block_q, 128), jnp.float32),   # running max m
+            pltpu.VMEM((block_q, 128), jnp.float32),   # running sum l
+        ],
+        interpret=interpret,
+    )(q, k, v)
+    return out, lse
+
+
+# ---------------------------------------------------------------------------
+# Backward (blockwise flash recurrence, plain JAX + lax.scan)
+# ---------------------------------------------------------------------------
+
+def _flash_bwd(sm_scale, causal, block_q, block_k, interpret, res, do):
+    q, k, v, out, lse = res
+    BH, S, D = q.shape
+    qf = q.astype(jnp.float32)
+    kf = k.astype(jnp.float32)
+    vf = v.astype(jnp.float32)
+    dof = do.astype(jnp.float32)
+    # D_i = rowsum(dO * O)
+    delta = jnp.sum(dof * out.astype(jnp.float32), axis=-1)     # [BH, S]
+
+    nk = S // block_k
+    ks = kf.reshape(BH, nk, block_k, D).transpose(1, 0, 2, 3)
+    vs = vf.reshape(BH, nk, block_k, D).transpose(1, 0, 2, 3)
+
+    rows = jnp.arange(S)
+
+    def kblock(dq, blk):
+        j, k_j, v_j = blk
+        cols = j * block_k + jnp.arange(block_k)
+        s = jnp.einsum("bqd,bkd->bqk", qf, k_j) * sm_scale
+        if causal:
+            mask = rows[:, None] >= cols[None, :]
+            s = jnp.where(mask[None], s, NEG_INF)
+        p = jnp.exp(s - lse[..., None])                          # [BH,S,bk]
+        dp = jnp.einsum("bqd,bkd->bqk", dof, v_j)
+        ds = p * (dp - delta[..., None]) * sm_scale
+        dq = dq + jnp.einsum("bqk,bkd->bqd", ds, k_j)
+        dk_j = jnp.einsum("bqk,bqd->bkd", ds, qf)
+        dv_j = jnp.einsum("bqk,bqd->bkd", p, dof)
+        return dq, (dk_j, dv_j)
+
+    dq0 = jnp.zeros_like(qf)
+    dq, (dk_blocks, dv_blocks) = lax.scan(
+        kblock, dq0, (jnp.arange(nk), ks, vs))
+    dk = dk_blocks.transpose(1, 0, 2, 3).reshape(BH, S, D)
+    dv = dv_blocks.transpose(1, 0, 2, 3).reshape(BH, S, D)
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+# ---------------------------------------------------------------------------
+# Public API
+# ---------------------------------------------------------------------------
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash_core(q, k, v, sm_scale, causal, block_q, block_k, interpret):
+    out, _ = _flash_fwd(q, k, v, sm_scale, causal, block_q, block_k,
+                        interpret)
+    return out
+
+
+def _flash_core_fwd(q, k, v, sm_scale, causal, block_q, block_k, interpret):
+    out, lse = _flash_fwd(q, k, v, sm_scale, causal, block_q, block_k,
+                          interpret)
+    return out, (q, k, v, out, lse)
+
+
+_flash_core.defvjp(_flash_core_fwd, _flash_bwd)
+
+
+def flash_attention(q, k, v, causal: bool = True,
+                    block_q: int = 128, block_k: int = 128,
+                    interpret: Optional[bool] = None):
+    """Flash attention over [B, S, H, D] tensors (layout matches
+    models.transformer). Falls back to dense attention when S doesn't tile.
+    """
+    B, S, H, D = q.shape
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    block_q = min(block_q, S)
+    block_k = min(block_k, S)
+    if S % block_q or S % block_k:
+        from ..models.transformer import dense_attention
+        return dense_attention(q, k, v, causal=causal, dtype=q.dtype)
+
+    def to_bh(x):
+        return x.transpose(0, 2, 1, 3).reshape(B * H, S, D)
+
+    sm_scale = 1.0 / (D ** 0.5)
+    out = _flash_core(to_bh(q), to_bh(k), to_bh(v), sm_scale, causal,
+                      block_q, block_k, interpret)
+    return out.reshape(B, H, S, D).transpose(0, 2, 1, 3)
+
+
+__all__ = ["flash_attention"]

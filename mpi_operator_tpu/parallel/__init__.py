@@ -3,3 +3,10 @@ from .mesh import (  # noqa: F401
     local_batch_size, make_mesh, replicated_sharding, replicated_spec,
 )
 from . import collectives  # noqa: F401
+from .sharding import (  # noqa: F401
+    DEFAULT_RULES, logical_sharding, logical_to_spec, param_shardings,
+    shard_init,
+)
+from .ring_attention import ring_attention, ring_attention_inner  # noqa: F401
+from .pipeline import pipeline_apply, stack_stage_params  # noqa: F401
+from .moe import MoeMlp  # noqa: F401

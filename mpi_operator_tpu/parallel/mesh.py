@@ -12,6 +12,7 @@ Axis vocabulary (scaling-book conventions):
   tp    — tensor/model parallel (contracting-dim sharding; rides ICI)
   sp    — sequence/context parallel (ring attention; rides ICI neighbors)
   ep    — expert parallel (MoE all-to-all)
+  pp    — pipeline parallel (stage-sharded layers; neighbor ppermute traffic)
   dcn   — the inter-slice axis for multi-slice jobs (data parallel over DCN,
           hierarchical allreduce for free from GSPMD)
 """
@@ -28,7 +29,10 @@ from jax.experimental import mesh_utils
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 # Canonical axis order: outermost (slowest-varying, cross-slice first).
-AXIS_ORDER = ("dcn", "dp", "fsdp", "ep", "sp", "tp")
+# pp sits outside dp: pipeline traffic is thin neighbor ppermute, so it can
+# afford the outer (slower-link) placement; tp stays innermost on the
+# fastest ICI links.
+AXIS_ORDER = ("dcn", "pp", "dp", "fsdp", "ep", "sp", "tp")
 
 
 @dataclass
@@ -40,11 +44,13 @@ class MeshConfig:
     tp: int = 1
     sp: int = 1
     ep: int = 1
+    pp: int = 1       # pipeline stages
     dcn: int = 1      # number of slices (multi-slice data parallelism)
 
     def axis_sizes(self) -> Dict[str, int]:
-        return {"dcn": self.dcn, "dp": self.dp, "fsdp": self.fsdp,
-                "ep": self.ep, "sp": self.sp, "tp": self.tp}
+        return {"dcn": self.dcn, "pp": self.pp, "dp": self.dp,
+                "fsdp": self.fsdp, "ep": self.ep, "sp": self.sp,
+                "tp": self.tp}
 
     @property
     def num_devices(self) -> int:

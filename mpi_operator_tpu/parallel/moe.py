@@ -1,0 +1,123 @@
+"""Mixture-of-Experts with expert parallelism over the `ep` mesh axis.
+
+Absent from the reference (SURVEY.md §2.3: EP/MoE — NO); first-class here.
+Design is the TPU-canonical dense-dispatch MoE (Switch/GShard style):
+
+- top-k gating with a load-balancing auxiliary loss,
+- capacity-factor token budget per expert — tokens over capacity are
+  dropped (their residual branch contributes zero), keeping every shape
+  STATIC so XLA can tile the expert matmuls onto the MXU,
+- dispatch/combine as einsums with a one-hot dispatch tensor; when the
+  "expert" logical axis is sharded over `ep`, GSPMD turns those einsums
+  into the all-to-all exchange GShard hand-codes — no explicit collective
+  calls in model code.
+
+The expert FFN weights carry logical axes ("expert", "embed", "expert_mlp")
+so ep×tp composes: experts sharded over ep, each expert's mlp dim over tp.
+"""
+from __future__ import annotations
+
+from typing import Any, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+kernel_init = nn.initializers.normal(stddev=0.02)
+
+
+class MoeMlp(nn.Module):
+    """Drop-in replacement for a dense FFN block: [B, S, E] -> [B, S, E].
+
+    Returns (output, aux_loss); callers add `aux_loss` (load-balance term,
+    Switch Transformer eq. 4) to the training objective.
+    """
+    num_experts: int
+    embed_dim: int
+    mlp_dim: int
+    top_k: int = 2
+    capacity_factor: float = 1.25
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x) -> Tuple[jax.Array, jax.Array]:
+        B, S, E = x.shape
+        N = B * S
+        e = self.num_experts
+        k = min(self.top_k, e)
+        # static per-expert token budget
+        capacity = max(1, int(self.capacity_factor * N * k / e))
+
+        tokens = x.reshape(N, E)
+
+        # --- gating (router in f32: tiny matmul, stability matters) -------
+        router = nn.Dense(
+            e, dtype=jnp.float32, name="router",
+            kernel_init=nn.with_logical_partitioning(
+                kernel_init, ("embed", "expert")),
+            use_bias=False,
+        )
+        logits = router(tokens.astype(jnp.float32))          # [N, e]
+        probs = jax.nn.softmax(logits, axis=-1)
+
+        gate_vals, gate_idx = jax.lax.top_k(probs, k)        # [N, k]
+        # renormalize the selected gates
+        gate_vals = gate_vals / jnp.maximum(
+            gate_vals.sum(-1, keepdims=True), 1e-9)
+
+        # --- capacity assignment ------------------------------------------
+        # position of each (token, choice) within its expert's queue
+        onehot = jax.nn.one_hot(gate_idx, e, dtype=jnp.int32)   # [N, k, e]
+        flat_choice = onehot.reshape(N * k, e)
+        pos_in_expert = jnp.cumsum(flat_choice, axis=0) * flat_choice
+        pos_in_expert = (pos_in_expert.reshape(N, k, e).sum(-1) - 1)  # [N,k]
+        keep = (pos_in_expert >= 0) & (pos_in_expert < capacity)
+        gate_vals = gate_vals * keep
+
+        # dispatch tensor [N, e, capacity] (one-hot over expert & slot)
+        dispatch = (
+            jax.nn.one_hot(gate_idx, e, dtype=self.dtype)[..., None]
+            * jax.nn.one_hot(jnp.where(keep, pos_in_expert, -1), capacity,
+                             dtype=self.dtype)[:, :, None, :]
+        ).sum(1)                                              # [N, e, cap]
+        combine = (
+            gate_vals.astype(jnp.float32)[..., None, None]
+            * jax.nn.one_hot(gate_idx, e, dtype=jnp.float32)[..., None]
+            * jax.nn.one_hot(jnp.where(keep, pos_in_expert, -1), capacity,
+                             dtype=jnp.float32)[:, :, None, :]
+        ).sum(1)                                              # [N, e, cap]
+
+        # --- expert compute (ep-sharded batched matmul) -------------------
+        # GSPMD: dispatch einsum becomes the all-to-all when "expert" ↦ ep
+        expert_in = jnp.einsum("nd,nec->ecd", tokens.astype(self.dtype),
+                               dispatch)
+
+        w_in = self.param(
+            "w_in",
+            nn.with_logical_partitioning(
+                kernel_init, ("expert", "embed", "expert_mlp")),
+            (e, E, self.mlp_dim), jnp.float32)
+        w_out = self.param(
+            "w_out",
+            nn.with_logical_partitioning(
+                kernel_init, ("expert", "expert_mlp", "embed")),
+            (e, self.mlp_dim, E), jnp.float32)
+
+        h = jnp.einsum("ecd,edm->ecm", expert_in, w_in.astype(self.dtype))
+        h = nn.gelu(h)
+        expert_out = jnp.einsum("ecm,emd->ecd", h, w_out.astype(self.dtype))
+
+        out = jnp.einsum("ecd,nec->nd", expert_out.astype(jnp.float32),
+                         combine)
+
+        # --- load-balancing aux loss (Switch eq. 4) -----------------------
+        # fraction of tokens routed to each expert (top-1 route) × mean prob
+        top1 = jax.nn.one_hot(gate_idx[:, 0], e, dtype=jnp.float32)
+        frac_tokens = top1.mean(0)
+        frac_probs = probs.mean(0)
+        aux_loss = e * jnp.sum(frac_tokens * frac_probs)
+
+        return out.reshape(B, S, E).astype(x.dtype), aux_loss
+
+
+__all__ = ["MoeMlp"]

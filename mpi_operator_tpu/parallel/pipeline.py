@@ -1,0 +1,105 @@
+"""Pipeline parallelism over the `pp` mesh axis (GPipe schedule).
+
+Absent from the reference (SURVEY.md §2.3: PP — NO); first-class here.
+TPU-native shape: stage parameters are *stacked* on a leading axis that is
+sharded over `pp` (logical axis "layers" → pp, parallel/sharding.py), the
+whole schedule lives inside one `shard_map`, and inter-stage transfers are
+single-neighbor `lax.ppermute` hops — thin point-to-point traffic that rides
+one ICI link, which is why pp sits on the outer (slower) mesh dimension
+(parallel/mesh.py AXIS_ORDER).
+
+Schedule: classic GPipe fill-drain over M microbatches and P stages
+(M + P - 1 ticks). Each tick every device runs its stage on its current
+activation and ppermutes the result one hop forward; autodiff through
+ppermute (its transpose is the reverse permute) gives the backward pipeline
+for free — no hand-written 1F1B needed for correctness, and XLA overlaps
+the permute with the next tick's compute.
+
+Bubble fraction is (P-1)/(M+P-1); callers pick M >= 4*P to keep it small.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax import shard_map
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def _pipeline_local(stage_fn: Callable, stage_params: Any, x, *,
+                    axis_name: str, num_microbatches: int):
+    """Body inside shard_map. stage_params: this stage's shard (leading
+    stacked-layer dim already local). x: full [M, mb, ...] microbatched
+    input, replicated over pp. Returns [M, mb, ...] outputs (valid on the
+    last stage, broadcast to all)."""
+    n_stages = lax.axis_size(axis_name)
+    stage_id = lax.axis_index(axis_name)
+    M = num_microbatches
+
+    def tick(t, carry):
+        act, outputs = carry
+        # stage 0 ingests microbatch t (dummy past the end, masked later);
+        # other stages consume the activation handed over last tick.
+        mb_idx = jnp.clip(t, 0, M - 1)
+        fed = lax.dynamic_index_in_dim(x, mb_idx, axis=0, keepdims=False)
+        cur = jnp.where(stage_id == 0, fed, act)
+        y = stage_fn(stage_params, cur)
+        # last stage banks microbatch t-(P-1) once the pipe is full
+        out_idx = jnp.clip(t - (n_stages - 1), 0, M - 1)
+        take = (stage_id == n_stages - 1) & (t >= n_stages - 1)
+        banked = lax.dynamic_index_in_dim(outputs, out_idx, axis=0,
+                                          keepdims=False)
+        outputs = lax.dynamic_update_index_in_dim(
+            outputs, jnp.where(take, y, banked), out_idx, axis=0)
+        # hand activations one hop forward around the ring
+        perm = [(j, (j + 1) % n_stages) for j in range(n_stages)]
+        act = lax.ppermute(y, axis_name, perm)
+        return act, outputs
+
+    # fresh zeros are "unvarying" under shard_map's VMA typing while the
+    # loop writes pp-varying values — inherit pp-variance from the params
+    zero = jax.tree.leaves(stage_params)[0].astype(x.dtype).sum() * 0
+    act0 = jnp.zeros_like(x[0]) + zero
+    outputs0 = jnp.zeros((M,) + x.shape[1:], x.dtype) + zero
+    _, outputs = lax.fori_loop(0, M + n_stages - 1, tick, (act0, outputs0),
+                               unroll=False)
+    # broadcast the last stage's banked outputs to every stage (psum of the
+    # masked buffer — only the last stage contributes) so the loss and its
+    # gradient are computed identically everywhere
+    mask = (stage_id == n_stages - 1).astype(outputs.dtype)
+    outputs = lax.psum(outputs * mask, axis_name)
+    return outputs
+
+
+def pipeline_apply(stage_fn: Callable, stage_params: Any, x,
+                   mesh: Mesh, num_microbatches: int,
+                   axis_name: str = "pp"):
+    """Run a GPipe pipeline over `mesh`'s pp axis.
+
+    stage_fn(params_shard, x_mb) -> y_mb — one stage's computation; its
+      params argument is the local shard of the stacked parameters.
+    stage_params — pytree whose leaves have leading dim == pp size
+      (stage-stacked), sharded over pp.
+    x — [M, microbatch, ...] microbatched global input.
+    """
+    p_spec = jax.tree.map(lambda _: P(axis_name), stage_params)
+    fn = shard_map(
+        functools.partial(_pipeline_local, stage_fn, axis_name=axis_name,
+                          num_microbatches=num_microbatches),
+        mesh=mesh,
+        in_specs=(p_spec, P()),
+        out_specs=P(),
+    )
+    return fn(stage_params, x)
+
+
+def stack_stage_params(per_stage_params):
+    """Stack a list of per-stage pytrees into one stage-stacked pytree
+    (leading dim = number of stages) ready for pp sharding."""
+    return jax.tree.map(lambda *xs: jnp.stack(xs), *per_stage_params)
+
+
+__all__ = ["pipeline_apply", "stack_stage_params"]

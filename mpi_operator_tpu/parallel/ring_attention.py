@@ -1,0 +1,120 @@
+"""Ring attention — sequence/context parallelism over the `sp` mesh axis.
+
+The reference has no long-context story (SURVEY.md §2.3: SP/CP absent); this
+is the TPU-native extension that makes it first-class. The sequence dimension
+is sharded over `sp`: each device holds a [B, S/n, H, D] slice of Q, K, V.
+K/V blocks rotate around the ring via `lax.ppermute` (neighbor hops on ICI)
+while each device accumulates its queries' attention over every block with a
+numerically-stable *online softmax* (running max + rescaled sums, the
+flash-attention recurrence). Compute on the current block overlaps with the
+ppermute of the next — XLA schedules the collective-permute concurrently
+with the einsums, which is what makes the ring bandwidth-latency optimal on
+a torus.
+
+Causal masking uses block-position arithmetic: ring step t gives device i
+the K/V block of device (i - t) mod n, so whole blocks are either fully
+visible (block index < mine), fully masked (>), or diagonal (==, apply the
+local triangular mask).
+"""
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+NEG_INF = -1e30
+
+
+def _block_attend(q, k, v, bias_mask, prev):
+    """One flash-style accumulation step.
+
+    q: [B, Sq, H, D]; k/v: [B, Sk, H, D]; bias_mask: [Sq, Sk] bool or None
+    prev = (acc [B,Sq,H,D] f32, row_max [B,H,Sq] f32, row_sum [B,H,Sq] f32)
+    """
+    acc, row_max, row_sum = prev
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32)
+    logits = logits / jnp.sqrt(q.shape[-1]).astype(jnp.float32)
+    if bias_mask is not None:
+        logits = jnp.where(bias_mask[None, None], logits, NEG_INF)
+    new_max = jnp.maximum(row_max, logits.max(axis=-1))
+    correction = jnp.exp(row_max - new_max)              # rescale old acc
+    probs = jnp.exp(logits - new_max[..., None])
+    new_sum = row_sum * correction + probs.sum(axis=-1)
+    pv = jnp.einsum("bhqk,bkhd->bqhd", probs, v.astype(jnp.float32))
+    new_acc = acc * correction.transpose(0, 2, 1)[..., None] + pv
+    return new_acc, new_max, new_sum
+
+
+def ring_attention_inner(q, k, v, axis_name: str = "sp", causal: bool = True):
+    """Ring attention body — call INSIDE shard_map/pmap over `axis_name`.
+
+    q/k/v: the local sequence shard [B, S_local, H, D].
+    Returns the local [B, S_local, H, D] attention output.
+    """
+    n = lax.axis_size(axis_name)
+    my_idx = lax.axis_index(axis_name)
+    B, S, H, D = q.shape
+
+    local_tri = jnp.tril(jnp.ones((S, S), bool))
+
+    def body(t, carry):
+        k_t, v_t, acc, row_max, row_sum = carry
+        # whose block am I looking at after t hops?
+        src = (my_idx - t) % n
+        if causal:
+            # full block if src < me; diagonal block if src == me; else skip.
+            diag = src == my_idx
+            visible = src < my_idx
+            mask = jnp.where(diag, local_tri, jnp.ones((S, S), bool))
+            skip = ~(diag | visible)
+            logits_mask = jnp.where(skip, jnp.zeros((S, S), bool), mask)
+        else:
+            logits_mask = None
+        acc, row_max, row_sum = _block_attend(
+            q, k_t, v_t, logits_mask, (acc, row_max, row_sum))
+        # rotate K/V one hop around the ring (device i -> i+1)
+        perm = [(j, (j + 1) % n) for j in range(n)]
+        k_next = lax.ppermute(k_t, axis_name, perm)
+        v_next = lax.ppermute(v_t, axis_name, perm)
+        return k_next, v_next, acc, row_max, row_sum
+
+    # fresh zeros are "unvarying" under shard_map's VMA typing while the
+    # loop outputs vary over the mesh — derive the carries from q so they
+    # inherit its varying axes
+    zero_bshd = (q * 0).astype(jnp.float32)
+    zero_bhs = zero_bshd.sum(-1).transpose(0, 2, 1)
+    init = (
+        k, v,
+        zero_bshd,
+        zero_bhs + NEG_INF,
+        zero_bhs,
+    )
+    _, _, acc, row_max, row_sum = lax.fori_loop(0, n, body, init)
+    # guard fully-masked rows (can't happen for causal self-attn, but keeps
+    # the kernel total)
+    denom = jnp.maximum(row_sum, 1e-30)
+    out = acc / denom.transpose(0, 2, 1)[..., None]
+    return out.astype(q.dtype)
+
+
+def ring_attention(q, k, v, mesh: Mesh, axis_name: str = "sp",
+                   causal: bool = True):
+    """shard_map wrapper: q/k/v are global [B, S, H, D] arrays (sharded or
+    not); the sequence dim is split over `axis_name` and attention runs as a
+    ring. Batch stays sharded over the data axes.
+    """
+    spec = P(("dcn", "dp", "fsdp"), axis_name, None, None)
+    fn = shard_map(
+        functools.partial(ring_attention_inner, axis_name=axis_name,
+                          causal=causal),
+        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+    )
+    return fn(q, k, v)
+
+
+__all__ = ["ring_attention", "ring_attention_inner"]

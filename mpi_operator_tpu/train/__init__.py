@@ -2,3 +2,6 @@ from .trainer import (  # noqa: F401
     TrainState, Trainer, TrainerConfig, cross_entropy_loss, make_sgd,
 )
 from .checkpoint import latest_checkpoint, restore_checkpoint, save_checkpoint  # noqa: F401,E402
+from .lm_trainer import (  # noqa: F401,E402
+    LMTrainer, LMTrainerConfig, LMTrainState, lm_loss, make_adamw,
+)

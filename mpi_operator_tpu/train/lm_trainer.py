@@ -1,0 +1,221 @@
+"""Language-model trainer — sharded-parameter training for the transformer
+ladder (GPT-2, BERT; BASELINE.json configs[2-3]).
+
+Where train.trainer.Trainer replicates parameters (the reference's
+Horovod-style DP, SURVEY.md §2.3), this trainer is the TPU-native
+generalization: parameters live in the layout given by the logical sharding
+rules (parallel/sharding.py) — fsdp-sharded storage, tp-sharded Megatron
+matmuls — and the batch is sharded over the data axes. The gradient
+collectives (allreduce over dp, reduce-scatter/all-gather over fsdp, the tp
+pair inside each layer) are all inserted by XLA from the sharding
+annotations; no hand-written communication.
+
+Remat: cfg.remat wraps each block in jax.checkpoint inside the model
+(models/transformer.py), trading FLOPs for HBM as SURVEY directs.
+"""
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+from flax import struct
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..parallel.mesh import batch_spec
+from ..parallel.sharding import shard_init
+
+
+class LMTrainState(struct.PyTreeNode):
+    step: jax.Array
+    params: Any
+    opt_state: Any
+    tx: optax.GradientTransformation = struct.field(pytree_node=False)
+    apply_fn: Callable = struct.field(pytree_node=False)
+
+    def apply_gradients(self, grads):
+        updates, new_opt = self.tx.update(grads, self.opt_state, self.params)
+        return self.replace(step=self.step + 1,
+                            params=optax.apply_updates(self.params, updates),
+                            opt_state=new_opt)
+
+
+@dataclass
+class LMTrainerConfig:
+    global_batch_size: int = 32
+    seq_len: int = 1024
+    learning_rate: float = 2.5e-4
+    weight_decay: float = 0.01
+    b1: float = 0.9
+    b2: float = 0.95
+    grad_clip: float = 1.0
+    warmup_steps: int = 100
+    moe_aux_weight: float = 0.01
+    masked_lm: bool = False        # BERT-style objective over masked slots
+    log_every: int = 10
+
+
+def make_adamw(cfg: LMTrainerConfig) -> optax.GradientTransformation:
+    sched = optax.linear_schedule(0.0, cfg.learning_rate,
+                                  max(1, cfg.warmup_steps))
+    return optax.chain(
+        optax.clip_by_global_norm(cfg.grad_clip),
+        optax.adamw(sched, b1=cfg.b1, b2=cfg.b2,
+                    weight_decay=cfg.weight_decay),
+    )
+
+
+def lm_loss(logits, targets, mask=None):
+    """Token-level softmax cross-entropy; mask selects scored positions
+    (next-token LM passes all-ones, MLM passes the masked slots)."""
+    losses = optax.softmax_cross_entropy_with_integer_labels(logits, targets)
+    if mask is None:
+        return losses.mean()
+    denom = jnp.maximum(mask.sum(), 1)
+    return (losses * mask).sum() / denom
+
+
+class LMTrainer:
+    """Sharded trainer over a Mesh. Params are created directly in their
+    ruled layout (shard_init), the optimizer state inherits it, and the jit
+    carries explicit in/out shardings so the step never re-lays-out state.
+    """
+
+    def __init__(self, model, mesh: Mesh,
+                 config: Optional[LMTrainerConfig] = None,
+                 tx: Optional[optax.GradientTransformation] = None):
+        self.model = model
+        self.mesh = mesh
+        self.config = config or LMTrainerConfig()
+        self.tx = tx or make_adamw(self.config)
+        self.batch_sharding = NamedSharding(mesh, batch_spec())
+        self.replicated = NamedSharding(mesh, P())
+        self._step = None
+        self._state_shardings = None
+
+    def init_state(self, rng: jax.Array) -> LMTrainState:
+        cfg = self.config
+        dummy = jnp.zeros((2, cfg.seq_len), jnp.int32)
+        variables, shardings = shard_init(self.model, self.mesh, rng, dummy)
+        params = variables["params"]
+        param_sh = shardings["params"]
+
+        def init_opt(p):
+            return self.tx.init(p)
+        # optimizer state shardings mirror the params they track
+        opt_abstract = jax.eval_shape(init_opt, params)
+        opt_sh = _opt_shardings(opt_abstract, params, param_sh,
+                                self.replicated)
+        opt_state = jax.jit(init_opt, out_shardings=opt_sh)(params)
+        state = LMTrainState(step=jnp.zeros((), jnp.int32), params=params,
+                             opt_state=opt_state, tx=self.tx,
+                             apply_fn=self.model.apply)
+        self._state_shardings = LMTrainState(
+            step=self.replicated, params=param_sh, opt_state=opt_sh,
+            tx=self.tx, apply_fn=self.model.apply)
+        return state
+
+    def _loss_fn(self, params, tokens, targets, mask):
+        logits, interm = self.model.apply(
+            {"params": params}, tokens, mutable=["intermediates"])
+        loss = lm_loss(logits, targets, mask)
+        aux = jax.tree.leaves(interm.get("intermediates", {}))
+        if aux:
+            loss = loss + self.config.moe_aux_weight * sum(
+                jnp.asarray(a).mean() for a in aux)
+        return loss, logits
+
+    def _step_fn(self, state: LMTrainState, tokens, targets, mask):
+        (loss, logits), grads = jax.value_and_grad(
+            self._loss_fn, has_aux=True)(state.params, tokens, targets, mask)
+        state = state.apply_gradients(grads)
+        acc = jnp.sum((jnp.argmax(logits, -1) == targets) * mask) \
+            / jnp.maximum(mask.sum(), 1)
+        return state, {"loss": loss, "accuracy": acc}
+
+    def compile_step(self):
+        if self._step is None:
+            assert self._state_shardings is not None, "call init_state first"
+            self._step = jax.jit(
+                self._step_fn,
+                in_shardings=(self._state_shardings, self.batch_sharding,
+                              self.batch_sharding, self.batch_sharding),
+                out_shardings=(self._state_shardings, self.replicated),
+                donate_argnums=(0,),
+            )
+        return self._step
+
+    def train_step(self, state, tokens, targets, mask=None):
+        if mask is None:
+            mask = jnp.ones_like(targets, jnp.float32)
+        mask = mask.astype(jnp.float32)
+        return self.compile_step()(state, tokens, targets, mask)
+
+    def benchmark(self, state, dataset, num_steps: int = 50,
+                  warmup_steps: int = 5, log: Callable[[str], None] = print,
+                  ) -> Tuple[LMTrainState, Dict[str, float]]:
+        """tokens/sec measurement, same windowed protocol as
+        train.trainer.Trainer.benchmark (ref README.md:113-131 format)."""
+        cfg = self.config
+        it = iter(dataset)
+        for _ in range(warmup_steps):
+            batch = next(it)
+            state, metrics = self.train_step(state, *batch)
+        if warmup_steps:
+            float(metrics["loss"])
+        tokens_per_step = cfg.global_batch_size * cfg.seq_len
+        log_every = max(1, min(cfg.log_every, num_steps))
+        windows = []
+        t0 = time.perf_counter()
+        wall0 = t0
+        for i in range(1, num_steps + 1):
+            batch = next(it)
+            state, metrics = self.train_step(state, *batch)
+            if i % log_every == 0:
+                loss = float(metrics["loss"])
+                t1 = time.perf_counter()
+                tps = tokens_per_step * log_every / (t1 - t0)
+                windows.append(tps)
+                log(f"{i}\ttokens/sec: {tps:.0f}\tloss: {loss:.3f}")
+                t0 = time.perf_counter()
+        steady = windows[1:] if len(windows) > 1 else windows
+        tps = sum(steady) / len(steady)
+        log("-" * 40)
+        log(f"total tokens/sec: {tps:.0f}")
+        log("-" * 40)
+        return state, {
+            "tokens_per_sec": tps,
+            "tokens_per_sec_per_device": tps / self.mesh.size,
+            "wall_seconds": time.perf_counter() - wall0,
+            "final_loss": float(metrics["loss"]),
+        }
+
+
+def _opt_shardings(opt_abstract, params, param_sh, replicated):
+    """Shard optimizer-state leaves that mirror a param (same shape) like
+    that param; everything else (counts, scalars) replicates."""
+    shape_to_sh = {}
+    flat_p = jax.tree_util.tree_flatten_with_path(params)[0]
+    flat_sh = jax.tree.leaves(param_sh)
+    for (path, leaf), sh in zip(flat_p, flat_sh):
+        shape_to_sh.setdefault(
+            tuple(path), (leaf.shape, sh))
+
+    def pick(path, leaf):
+        # match by trailing path (params appear nested inside opt state)
+        for ppath, (shape, sh) in shape_to_sh.items():
+            if len(path) >= len(ppath) and tuple(path[-len(ppath):]) == ppath \
+                    and leaf.shape == shape:
+                return sh
+        return replicated
+
+    flat_o = jax.tree_util.tree_flatten_with_path(opt_abstract)[0]
+    leaves = [pick(p, l) for p, l in flat_o]
+    return jax.tree.unflatten(jax.tree.structure(opt_abstract), leaves)
+
+
+__all__ = ["LMTrainer", "LMTrainerConfig", "LMTrainState", "make_adamw",
+           "lm_loss"]

@@ -80,15 +80,24 @@ def test_slots_interleave_global_rank():
 def test_launcher_never_joins_process_group():
     """The launcher must not call jax.distributed.initialize — rank 0 lives
     on worker-0 (rank-collision regression)."""
+    import types
+    import unittest.mock as mock
+
     import mpi_operator_tpu.bootstrap.bootstrap as bs
-    called = []
+
+    calls = []
+    sentinel_jax = types.ModuleType("jax")
+    sentinel_dist = types.ModuleType("jax.distributed")
+    sentinel_dist.initialize = lambda *a, **kw: calls.append((a, kw))
+    sentinel_jax.distributed = sentinel_dist
+
     # num_processes=4 would normally trigger distributed init
     env = _env(**{ENV_LAUNCHER: "1"})
-    import unittest.mock as mock
-    with mock.patch.dict("sys.modules"):
+    with mock.patch.dict("sys.modules", {"jax": sentinel_jax,
+                                         "jax.distributed": sentinel_dist}):
         info = bs.initialize(env=env, hostname="anything")
     assert info.is_launcher and info.process_id == 0
-    del called
+    assert calls == [], "launcher must never call jax.distributed.initialize"
 
 
 def test_status_channel_and_launcher_wait():

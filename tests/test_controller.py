@@ -102,6 +102,7 @@ def test_all_resources_created():
     actions = f.run("default/test")
     assert verbs(actions) == [
         ("create", "ConfigMap"),
+        ("create", "Service"),      # headless worker DNS (no ref equivalent)
         ("create", "ServiceAccount"),
         ("create", "Role"),
         ("create", "RoleBinding"),
@@ -122,6 +123,23 @@ def test_all_resources_created():
     )
     assert cm.data["coordinator-address"].startswith("test-worker-0.")
     assert cm.data["num-processes"] == "2"
+
+
+def test_worker_service_headless_and_selects_workers():
+    """The headless Service must exist (worker DNS backing) and its selector
+    must match the worker pod labels, or jax.distributed rendezvous gets
+    NXDOMAIN on a real cluster."""
+    f = Fixture()
+    job = f.seed(new_job(tpus=8))
+    f.run("default/test")
+    svc = f.api.get("Service", "default", "test" + WORKER_SUFFIX)
+    assert svc.cluster_ip == "None"                   # headless
+    assert svc.metadata.owner_references[0].uid == job.metadata.uid
+    sts = f.api.get("StatefulSet", "default", "test" + WORKER_SUFFIX)
+    assert sts.spec.service_name == svc.metadata.name
+    pod_labels = sts.spec.template.metadata.labels
+    for k, v in svc.selector.items():
+        assert pod_labels.get(k) == v, (k, v, pod_labels)
 
 
 def test_single_worker_when_total_below_per_worker():

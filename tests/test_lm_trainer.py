@@ -1,0 +1,87 @@
+"""LM trainer tests: sharded training convergence + objective math."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from mpi_operator_tpu.models.transformer import CausalLM, MaskedLM, \
+    bert_config, gpt2_config
+from mpi_operator_tpu.parallel import MeshConfig, make_mesh
+from mpi_operator_tpu.train.lm_trainer import (
+    LMTrainer, LMTrainerConfig, lm_loss)
+
+
+def _trainer(mesh_cfg, model_cfg_kw=None, **tcfg_kw):
+    cfg = gpt2_config("test", attention="dense", dtype=jnp.float32,
+                      vocab_size=128, max_len=64, **(model_cfg_kw or {}))
+    mesh = make_mesh(mesh_cfg)
+    tcfg = LMTrainerConfig(global_batch_size=8, seq_len=32, warmup_steps=2,
+                           **tcfg_kw)
+    tr = LMTrainer(CausalLM(cfg), mesh, tcfg)
+    return tr
+
+
+def _batch(tr, vocab=128):
+    toks = jax.random.randint(jax.random.PRNGKey(1), (8, 32), 0, vocab)
+    tgts = jnp.roll(toks, -1, axis=1)
+    return (jax.device_put(toks, tr.batch_sharding),
+            jax.device_put(tgts, tr.batch_sharding))
+
+
+def test_loss_decreases_dp_fsdp_tp():
+    tr = _trainer(MeshConfig(dp=2, fsdp=2, tp=2))
+    state = tr.init_state(jax.random.PRNGKey(0))
+    toks, tgts = _batch(tr)
+    losses = []
+    for _ in range(5):
+        state, m = tr.train_step(state, toks, tgts)
+        losses.append(float(m["loss"]))
+    assert losses[-1] < losses[0]
+    assert int(state.step) == 5
+
+
+def test_moe_variant_trains():
+    tr = _trainer(MeshConfig(dp=2, ep=2, tp=2),
+                  model_cfg_kw={"num_experts": 4, "moe_every": 2})
+    state = tr.init_state(jax.random.PRNGKey(0))
+    toks, tgts = _batch(tr)
+    state, m = tr.train_step(state, toks, tgts)
+    assert bool(jnp.isfinite(m["loss"]))
+
+
+def test_masked_lm_objective():
+    """BERT path: only masked positions are scored."""
+    cfg = bert_config("test", attention="dense", dtype=jnp.float32,
+                      vocab_size=128, max_len=64)
+    mesh = make_mesh(MeshConfig(dp=8))
+    tcfg = LMTrainerConfig(global_batch_size=8, seq_len=32, masked_lm=True)
+    tr = LMTrainer(MaskedLM(cfg), mesh, tcfg)
+    state = tr.init_state(jax.random.PRNGKey(0))
+    toks = jax.random.randint(jax.random.PRNGKey(1), (8, 32), 0, 128)
+    tgts = toks
+    mask = jnp.zeros((8, 32)).at[:, ::4].set(1.0)   # 25% masked slots
+    state, m = tr.train_step(
+        state, jax.device_put(toks, tr.batch_sharding),
+        jax.device_put(tgts, tr.batch_sharding),
+        jax.device_put(mask, tr.batch_sharding))
+    assert bool(jnp.isfinite(m["loss"]))
+
+
+def test_lm_loss_mask_math():
+    logits = jnp.zeros((1, 4, 8))
+    targets = jnp.zeros((1, 4), jnp.int32)
+    full = lm_loss(logits, targets)
+    half = lm_loss(logits, targets, jnp.array([[1.0, 1.0, 0.0, 0.0]]))
+    # uniform logits → loss = log(8) regardless of which slots are scored
+    np.testing.assert_allclose(float(full), float(jnp.log(8.0)), rtol=1e-6)
+    np.testing.assert_allclose(float(half), float(jnp.log(8.0)), rtol=1e-6)
+
+
+def test_optimizer_state_sharded_like_params():
+    tr = _trainer(MeshConfig(tp=8))
+    state = tr.init_state(jax.random.PRNGKey(0))
+    p = state.params["backbone"]["block_0"]["mlp"]["fc_in"]["kernel"]
+    # find the matching adam mu leaf
+    mus = [l for l in jax.tree.leaves(state.opt_state)
+           if hasattr(l, "shape") and l.shape == p.shape]
+    assert mus, "no optimizer moment matching the param"
+    assert mus[0].sharding == p.sharding

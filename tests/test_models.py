@@ -1,0 +1,125 @@
+"""Model-family tests: GPT-2 / BERT / ViT forward + gradient sanity.
+
+The reference ships its models as opaque container images (SURVEY.md §2.2);
+we own them, so they get direct unit coverage on tiny configs.
+"""
+import jax
+import jax.numpy as jnp
+import pytest
+from flax.core import meta
+
+from mpi_operator_tpu.models.transformer import (
+    CausalLM, MaskedLM, ViT, bert_config, create_lm, create_vit,
+    dense_attention, gpt2_config, vit_config)
+
+
+def unboxed_init(model, rng, *args, **kw):
+    return meta.unbox(model.init(rng, *args, **kw))
+
+
+def test_gpt2_forward_shapes():
+    cfg = gpt2_config("test", attention="dense", dtype=jnp.float32,
+                      vocab_size=256, max_len=64)
+    model = CausalLM(cfg)
+    toks = jnp.zeros((2, 16), jnp.int32)
+    vs = unboxed_init(model, jax.random.PRNGKey(0), toks)
+    logits = model.apply(vs, toks)
+    assert logits.shape == (2, 16, 256)
+    assert logits.dtype == jnp.float32        # f32 head for stable loss
+
+
+def test_gpt2_causality():
+    """Changing a future token must not change past logits."""
+    cfg = gpt2_config("test", attention="dense", dtype=jnp.float32,
+                      vocab_size=64, max_len=32)
+    model = CausalLM(cfg)
+    rng = jax.random.PRNGKey(0)
+    t1 = jax.random.randint(rng, (1, 16), 0, 64)
+    t2 = t1.at[0, -1].set((t1[0, -1] + 1) % 64)
+    vs = unboxed_init(model, rng, t1)
+    l1 = model.apply(vs, t1)
+    l2 = model.apply(vs, t2)
+    assert jnp.allclose(l1[0, :-1], l2[0, :-1], atol=1e-5)
+    assert not jnp.allclose(l1[0, -1], l2[0, -1], atol=1e-5)
+
+
+def test_bert_bidirectional():
+    """BERT is NOT causal: early logits must see late tokens."""
+    cfg = bert_config("test", attention="dense", dtype=jnp.float32,
+                      vocab_size=64, max_len=32)
+    model = MaskedLM(cfg)
+    rng = jax.random.PRNGKey(0)
+    t1 = jax.random.randint(rng, (1, 16), 0, 64)
+    t2 = t1.at[0, -1].set((t1[0, -1] + 1) % 64)
+    vs = unboxed_init(model, rng, t1)
+    l1 = model.apply(vs, t1)
+    l2 = model.apply(vs, t2)
+    assert not jnp.allclose(l1[0, 0], l2[0, 0], atol=1e-6)
+
+
+def test_bert_attention_mask():
+    cfg = bert_config("test", attention="dense", dtype=jnp.float32,
+                      vocab_size=64, max_len=32)
+    model = MaskedLM(cfg)
+    toks = jnp.zeros((2, 8), jnp.int32)
+    vs = unboxed_init(model, jax.random.PRNGKey(0), toks)
+    mask = jnp.ones((2, 8), bool).at[:, 4:].set(False)
+    out = model.apply(vs, toks, attention_mask=mask)
+    assert out.shape == (2, 8, 64)
+    assert bool(jnp.isfinite(out).all())
+
+
+def test_vit_forward():
+    cfg = vit_config("test", attention="dense", dtype=jnp.float32)
+    model = ViT(cfg, num_classes=10, patch_size=4)
+    imgs = jnp.zeros((2, 32, 32, 3))
+    vs = unboxed_init(model, jax.random.PRNGKey(0), imgs)
+    logits = model.apply(vs, imgs)
+    assert logits.shape == (2, 10)
+
+
+def test_moe_transformer_forward_and_aux():
+    cfg = gpt2_config("test", attention="dense", dtype=jnp.float32,
+                      vocab_size=64, max_len=32, num_experts=4, moe_every=2)
+    model = CausalLM(cfg)
+    toks = jnp.zeros((2, 8), jnp.int32)
+    vs = unboxed_init(model, jax.random.PRNGKey(0), toks)
+    logits, interm = model.apply(vs, toks, mutable=["intermediates"])
+    aux = jax.tree.leaves(interm["intermediates"])
+    assert logits.shape == (2, 8, 64)
+    assert len(aux) == 1          # one MoE block in a 2-layer moe_every=2 net
+
+
+def test_factories():
+    assert isinstance(create_lm("gpt2-test"), CausalLM)
+    assert isinstance(create_lm("bert-test"), MaskedLM)
+    assert isinstance(create_vit("vit-test"), ViT)
+    with pytest.raises(ValueError):
+        create_lm("nope-test")
+
+
+def test_baseline_ladder_configs():
+    """The BASELINE.json shapes: GPT-2 medium, BERT large, ViT-B/16."""
+    g = gpt2_config("medium")
+    assert (g.num_layers, g.num_heads, g.embed_dim) == (24, 16, 1024)
+    b = bert_config("large")
+    assert (b.num_layers, b.embed_dim) == (24, 1024)
+    assert b.use_token_types and not b.causal
+    v = vit_config("b16")
+    assert (v.num_layers, v.embed_dim, v.mlp_dim) == (12, 768, 3072)
+
+
+def test_gradients_flow():
+    cfg = gpt2_config("test", attention="dense", dtype=jnp.float32,
+                      vocab_size=64, max_len=32)
+    model = CausalLM(cfg)
+    toks = jax.random.randint(jax.random.PRNGKey(0), (2, 8), 0, 64)
+    vs = unboxed_init(model, jax.random.PRNGKey(0), toks)
+
+    def loss(p):
+        return model.apply(p, toks).sum()
+
+    grads = jax.grad(loss)(vs)
+    norms = [float(jnp.abs(g).sum()) for g in jax.tree.leaves(grads)]
+    assert all(jnp.isfinite(jnp.asarray(norms)))
+    assert sum(n > 0 for n in norms) > len(norms) // 2
