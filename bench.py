@@ -22,6 +22,10 @@ REFERENCE_PER_DEVICE_IPS = 132.1      # ref README.md:113-125
 
 def main() -> None:
     parser = argparse.ArgumentParser()
+    parser.add_argument("--workload", default="resnet",
+                        choices=["resnet", "gpt2", "bert", "vit"],
+                        help="resnet = the reference's headline benchmark; "
+                             "gpt2/bert/vit = the BASELINE ladder")
     parser.add_argument("--model", default="resnet101")
     parser.add_argument("--batch-per-device", type=int, default=64)
     parser.add_argument("--steps", type=int, default=100)     # ref README.md:89
@@ -47,6 +51,38 @@ def main() -> None:
         args.steps = 4
         args.warmup = 1
         args.image_size = 64
+
+    if args.workload in ("gpt2", "bert"):
+        from mpi_operator_tpu.examples.lm_benchmark import run_lm_benchmark
+        size = "test" if args.smoke else None
+        _state, metrics = run_lm_benchmark(
+            workload=args.workload, size=size,
+            batch_per_device=2 if args.smoke else args.batch_per_device,
+            seq_len=32 if args.smoke else 512,
+            num_steps=args.steps, warmup_steps=args.warmup,
+            dtype_name=args.dtype, log=lambda s: print(s, file=sys.stderr))
+        print(json.dumps({
+            "metric": f"{args.workload}_tokens_per_sec",
+            "value": round(metrics["tokens_per_sec"], 0),
+            "unit": "tokens/sec",
+            "vs_baseline": 0.0,     # reference publishes no LM numbers
+        }))
+        return
+    if args.workload == "vit":
+        from mpi_operator_tpu.examples.lm_benchmark import run_vit_benchmark
+        _state, metrics = run_vit_benchmark(
+            size="test" if args.smoke else "b16",
+            batch_per_device=args.batch_per_device if not args.smoke else 2,
+            image_size=args.image_size if not args.smoke else 32,
+            num_steps=args.steps, warmup_steps=args.warmup,
+            dtype_name=args.dtype, log=lambda s: print(s, file=sys.stderr))
+        print(json.dumps({
+            "metric": "vit_images_per_sec",
+            "value": round(metrics["images_per_sec"], 2),
+            "unit": "images/sec",
+            "vs_baseline": 0.0,     # reference publishes no ViT numbers
+        }))
+        return
 
     from mpi_operator_tpu.examples.benchmark import run_benchmark
 

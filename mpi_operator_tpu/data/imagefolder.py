@@ -53,6 +53,14 @@ class NpyImageDataset:
         self.dtype = dtype
         self._sharding = sharding
         self._shards = discover_shards(data_dir)
+        # fail fast instead of a silent empty-queue hang: at least one shard
+        # must be able to cut a full batch (mmap header read only)
+        max_rows = max(
+            np.load(img, mmap_mode="r").shape[0] for img, _ in self._shards)
+        if max_rows < batch_size:
+            raise ValueError(
+                f"every shard is smaller ({max_rows} rows) than the batch "
+                f"size ({batch_size}); no batch can ever be produced")
         self._seed = seed
         self._queue: Queue = Queue(maxsize=prefetch)
         self._stop = threading.Event()
@@ -75,17 +83,33 @@ class NpyImageDataset:
                     yield (np.asarray(images[lo:lo + self.batch_size]),
                            np.asarray(labels[lo:lo + self.batch_size]))
 
+    def _put(self, item) -> bool:
+        """put that stays responsive to close(); False once stopped."""
+        from queue import Full
+        while not self._stop.is_set():
+            try:
+                self._queue.put(item, timeout=0.2)
+                return True
+            except Full:
+                continue
+        return False
+
     def _feeder(self):
-        for raw_images, raw_labels in self._host_batches():
-            if self._stop.is_set():
-                return
-            x = (raw_images.astype(np.float32) - _MEAN) / _STD
-            batch = (
-                jax.device_put(x.astype(np.dtype(self.dtype)),
-                               self._sharding),
-                jax.device_put(raw_labels.astype(np.int32), self._sharding),
-            )
-            self._queue.put(batch)
+        try:
+            for raw_images, raw_labels in self._host_batches():
+                if self._stop.is_set():
+                    return
+                x = (raw_images.astype(np.float32) - _MEAN) / _STD
+                batch = (
+                    jax.device_put(x.astype(np.dtype(self.dtype)),
+                                   self._sharding),
+                    jax.device_put(raw_labels.astype(np.int32),
+                                   self._sharding),
+                )
+                if not self._put(batch):
+                    return
+        except BaseException as e:          # surface in __next__, don't hang
+            self._put(e)
 
     # -- iterator ----------------------------------------------------------
 
@@ -93,10 +117,20 @@ class NpyImageDataset:
         return self
 
     def __next__(self) -> Tuple[jax.Array, jax.Array]:
-        return self._queue.get()
+        item = self._queue.get()
+        if isinstance(item, BaseException):
+            raise RuntimeError("data feeder thread failed") from item
+        return item
 
     def close(self):
         self._stop.set()
+        # unblock a feeder stuck in put() and let the thread exit
+        try:
+            while True:
+                self._queue.get_nowait()
+        except Exception:
+            pass
+        self._thread.join(timeout=2.0)
 
 
 def write_npy_shard(data_dir: str, stem: str, images: np.ndarray,

@@ -1,0 +1,232 @@
+"""Transformer-ladder benchmark workload — the remaining BASELINE configs.
+
+The reference ladder (BASELINE.json configs[2-4]) extends its in-repo ResNet
+example with BERT-large pretraining, GPT-2-medium LM, and multi-slice
+ViT-B/16 — workloads the reference would ship as opaque Horovod images
+(SURVEY.md §2.2). This is the TPU-native entrypoint for all three:
+
+  gpt2 / bert — LMTrainer over a dp×fsdp×tp mesh, synthetic token stream,
+                tokens/sec reported;
+  vit         — image Trainer over a dcn×dp mesh (multi-slice via
+                --num-slices: the dcn axis carries the cross-slice gradient
+                allreduce hierarchically), images/sec reported.
+
+Same process contract as examples.benchmark: launcher polls rank-0's status
+channel; workers train; --train-dir checkpoints and RESUMES (the gang-
+restart story: on pod restart the whole gang relaunches and picks up from
+the latest step).
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import Callable, Dict, Optional, Tuple
+
+
+def _lm_mesh_shape(n: int, tp: int, num_slices: int):
+    """dp fills whatever tp and dcn leave over."""
+    if n % (tp * num_slices):
+        raise ValueError(f"{n} devices not divisible by tp={tp} × "
+                         f"slices={num_slices}")
+    return n // (tp * num_slices), tp
+
+
+def run_lm_benchmark(
+    workload: str = "gpt2",
+    size: Optional[str] = None,
+    batch_per_device: int = 8,
+    seq_len: int = 512,
+    num_steps: int = 50,
+    warmup_steps: int = 5,
+    dtype_name: str = "bfloat16",
+    tp: int = 1,
+    num_slices: int = 1,
+    attention: str = "auto",
+    remat: bool = False,
+    train_dir: Optional[str] = None,
+    log: Callable[[str], None] = print,
+) -> Tuple[object, Dict[str, float]]:
+    """GPT-2 / BERT token-stream benchmark on a dcn×dp×fsdp×tp mesh."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..data.synthetic import synthetic_token_batch
+    from ..models.transformer import create_lm
+    from ..parallel import MeshConfig, make_mesh
+    from ..train.lm_trainer import LMTrainer, LMTrainerConfig
+
+    n = jax.device_count()
+    dp, tp = _lm_mesh_shape(n, tp, num_slices)
+    mesh = make_mesh(MeshConfig(dp=dp, tp=tp, dcn=num_slices))
+    dtype = jnp.bfloat16 if dtype_name == "bfloat16" else jnp.float32
+
+    name = f"{workload}-{size}" if size else workload
+    model = create_lm(name, dtype=dtype, attention=attention, remat=remat,
+                      max_len=max(seq_len, 32))
+    cfg_vocab = model.config.vocab_size
+    masked = workload == "bert"
+
+    global_batch = batch_per_device * n
+    tcfg = LMTrainerConfig(global_batch_size=global_batch, seq_len=seq_len,
+                           masked_lm=masked)
+    trainer = LMTrainer(model, mesh, tcfg)
+    state = trainer.init_state(jax.random.PRNGKey(0))
+
+    if train_dir:
+        from ..train.checkpoint import latest_checkpoint, restore_checkpoint
+        latest = latest_checkpoint(train_dir)
+        if latest is not None:
+            state = restore_checkpoint(latest, state)
+            log(f"resumed from {latest} (step {int(state.step)})")
+
+    class TokenStream:
+        def __init__(self):
+            self._rng = jax.random.PRNGKey(1)
+
+        def __iter__(self):
+            return self
+
+        def __next__(self):
+            self._rng, sub = jax.random.split(self._rng)
+            toks, tgts = synthetic_token_batch(sub, global_batch, seq_len,
+                                               cfg_vocab)
+            toks = jax.device_put(toks, trainer.batch_sharding)
+            tgts = jax.device_put(tgts, trainer.batch_sharding)
+            if masked:
+                # BERT: score a 15% random slot mask
+                self._rng, msub = jax.random.split(self._rng)
+                mask = (jax.random.uniform(msub, tgts.shape) < 0.15)
+                return toks, tgts, jax.device_put(
+                    mask.astype(jnp.float32), trainer.batch_sharding)
+            return toks, tgts
+
+        def close(self):
+            pass
+
+    state, metrics = trainer.benchmark(
+        state, TokenStream(), num_steps=num_steps,
+        warmup_steps=warmup_steps, log=log)
+    if train_dir:
+        from ..train.checkpoint import save_checkpoint
+        save_checkpoint(train_dir, state)
+    return state, metrics
+
+
+def run_vit_benchmark(
+    size: str = "b16",
+    batch_per_device: int = 32,
+    image_size: int = 224,
+    num_steps: int = 50,
+    warmup_steps: int = 5,
+    dtype_name: str = "bfloat16",
+    num_slices: int = 1,
+    train_dir: Optional[str] = None,
+    log: Callable[[str], None] = print,
+) -> Tuple[object, Dict[str, float]]:
+    """ViT-B/16 image benchmark; --num-slices 2 is the BASELINE multi-slice
+    config (hierarchical allreduce across the dcn axis)."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..data import SyntheticImageDataset
+    from ..models.transformer import create_vit
+    from ..parallel import MeshConfig, batch_sharding, make_mesh
+    from ..train import Trainer, TrainerConfig
+
+    n = jax.device_count()
+    mesh = make_mesh(MeshConfig.data_parallel(n, num_slices=num_slices))
+    dtype = jnp.bfloat16 if dtype_name == "bfloat16" else jnp.float32
+    global_batch = batch_per_device * n
+
+    model = create_vit(f"vit-{size}", num_classes=1000, dtype=dtype)
+    cfg = TrainerConfig(global_batch_size=global_batch,
+                        image_size=image_size, num_classes=1000)
+    trainer = Trainer(model, mesh, cfg)
+    state = trainer.init_state(jax.random.PRNGKey(0))
+    if train_dir:
+        from ..train.checkpoint import latest_checkpoint, restore_checkpoint
+        latest = latest_checkpoint(train_dir)
+        if latest is not None:
+            state = restore_checkpoint(latest, state)
+            log(f"resumed from {latest} (step {int(state.step)})")
+    dataset = SyntheticImageDataset(
+        global_batch, image_size=image_size, num_classes=1000,
+        dtype=dtype, sharding=batch_sharding(mesh))
+    state, metrics = trainer.benchmark(
+        state, dataset, num_steps=num_steps, warmup_steps=warmup_steps,
+        log=log)
+    if train_dir:
+        from ..train.checkpoint import save_checkpoint
+        save_checkpoint(train_dir, state)
+    return state, metrics
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(prog="tpu-lm-benchmarks")
+    parser.add_argument("--workload", default="gpt2",
+                        choices=["gpt2", "bert", "vit"])
+    parser.add_argument("--size", default=None,
+                        help="gpt2: small|medium|large|xl; bert: base|large; "
+                             "vit: b16|l16 (defaults = BASELINE configs)")
+    parser.add_argument("--batch-per-device", type=int, default=None)
+    parser.add_argument("--seq-len", type=int, default=512)
+    parser.add_argument("--image-size", type=int, default=224)
+    parser.add_argument("--num-steps", type=int, default=50)
+    parser.add_argument("--warmup-steps", type=int, default=5)
+    parser.add_argument("--dtype", default="bfloat16",
+                        choices=["bfloat16", "float32"])
+    parser.add_argument("--tp", type=int, default=1)
+    parser.add_argument("--attention", default="auto",
+                        choices=["auto", "dense", "flash"])
+    parser.add_argument("--remat", action="store_true")
+    parser.add_argument("--train-dir", default=None)
+    args = parser.parse_args(argv)
+
+    from ..bootstrap import initialize
+    from ..bootstrap.bootstrap import StatusServer, launcher_wait
+
+    info = initialize()
+    if info.is_launcher:
+        return launcher_wait(info)
+
+    status = StatusServer() if info.is_coordinator else None
+    exit_code = 1
+    log = print if info.is_coordinator else (lambda s: None)
+    try:
+        if args.workload == "vit":
+            _state, metrics = run_vit_benchmark(
+                size=args.size or "b16",
+                batch_per_device=args.batch_per_device or 32,
+                image_size=args.image_size, num_steps=args.num_steps,
+                warmup_steps=args.warmup_steps, dtype_name=args.dtype,
+                num_slices=info.num_slices, train_dir=args.train_dir,
+                log=log)
+            headline = {"metric": "vit_images_per_sec",
+                        "value": round(metrics["images_per_sec"], 2),
+                        "unit": "images/sec"}
+        else:
+            _state, metrics = run_lm_benchmark(
+                workload=args.workload, size=args.size,
+                batch_per_device=args.batch_per_device or 8,
+                seq_len=args.seq_len, num_steps=args.num_steps,
+                warmup_steps=args.warmup_steps, dtype_name=args.dtype,
+                tp=args.tp, num_slices=info.num_slices,
+                attention=args.attention, remat=args.remat,
+                train_dir=args.train_dir, log=log)
+            headline = {"metric": f"{args.workload}_tokens_per_sec",
+                        "value": round(metrics["tokens_per_sec"], 0),
+                        "unit": "tokens/sec"}
+        if info.is_coordinator:
+            print(json.dumps(headline))
+        exit_code = 0
+        return 0
+    finally:
+        if status is not None:
+            status.set_done(exit_code)
+            status.close()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

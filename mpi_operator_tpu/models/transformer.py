@@ -113,11 +113,17 @@ class Attention(nn.Module):
 
 def _attend(q, k, v, mask, cfg: TransformerConfig):
     """Dispatch to the configured attention implementation.
-    q/k/v: [B, S, H, D]; returns [B, S, H, D]."""
+    q/k/v: [B, S, H, D]; returns [B, S, H, D].
+
+    A padding `mask` forces the dense path: neither the flash kernel nor the
+    ring schedule implements key-padding masks yet, and silently ignoring
+    the mask would attend to padding (wrong logits, no error)."""
     impl = cfg.attention
     if impl == "auto":
         # flash kernel only on TPU; dense elsewhere (CPU tests/simulation)
         impl = "flash" if jax.default_backend() == "tpu" else "dense"
+    if mask is not None:
+        impl = "dense"
     if impl == "flash":
         from ..ops.attention import flash_attention
         return flash_attention(q, k, v, causal=cfg.causal)

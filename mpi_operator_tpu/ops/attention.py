@@ -201,7 +201,13 @@ def flash_attention(q, k, v, causal: bool = True,
         interpret = jax.default_backend() != "tpu"
     block_q = min(block_q, S)
     block_k = min(block_k, S)
-    if S % block_q or S % block_k:
+    # Fallback to dense when S doesn't tile — and, on real hardware, when
+    # blocks aren't sublane-aligned (Mosaic pads the 128-lane minor dim
+    # itself — validated on v5e with D=64/bf16 — but sub-8 sublane blocks
+    # are not guaranteed to lower; interpret mode has no constraint).
+    unaligned = (S % block_q or S % block_k
+                 or (not interpret and (block_q % 8 or block_k % 8)))
+    if unaligned:
         from ..models.transformer import dense_attention
         return dense_attention(q, k, v, causal=causal, dtype=q.dtype)
 

@@ -19,19 +19,21 @@ import orbax.checkpoint as ocp
 from .trainer import TrainState
 
 
-def _state_payload(state: TrainState):
+def _state_payload(state):
     """Only the array pytree is persisted; tx/apply_fn are static config
-    reconstructed by the caller."""
-    return {
+    reconstructed by the caller. Works for both TrainState (has
+    batch_stats) and LMTrainState (doesn't)."""
+    payload = {
         "step": state.step,
         "params": state.params,
-        "batch_stats": state.batch_stats,
         "opt_state": state.opt_state,
     }
+    if hasattr(state, "batch_stats"):
+        payload["batch_stats"] = state.batch_stats
+    return payload
 
 
-def save_checkpoint(directory: str, state: TrainState,
-                    step: Optional[int] = None) -> str:
+def save_checkpoint(directory: str, state, step: Optional[int] = None) -> str:
     """Write a checkpoint under `directory/step_<n>`; returns the path."""
     step = int(state.step) if step is None else step
     path = os.path.join(os.path.abspath(directory), f"step_{step}")
@@ -54,9 +56,10 @@ def latest_checkpoint(directory: str) -> Optional[str]:
     return os.path.join(directory, f"step_{max(steps)}")
 
 
-def restore_checkpoint(directory_or_path: str, state: TrainState) -> TrainState:
-    """Restore into the structure (and shardings) of `state`. Accepts either
-    a checkpoint path or a directory of step_N checkpoints (takes latest)."""
+def restore_checkpoint(directory_or_path: str, state):
+    """Restore into the structure (and shardings) of `state` — sharded
+    arrays land back on the mesh in their recorded layout. Accepts either a
+    checkpoint path or a directory of step_N checkpoints (takes latest)."""
     path = directory_or_path
     if not os.path.basename(path).startswith("step_"):
         latest = latest_checkpoint(path)
@@ -66,12 +69,10 @@ def restore_checkpoint(directory_or_path: str, state: TrainState) -> TrainState:
     ckptr = ocp.StandardCheckpointer()
     target = jax.tree.map(ocp.utils.to_shape_dtype_struct, _state_payload(state))
     restored = ckptr.restore(path, target)
-    return state.replace(
-        step=restored["step"],
-        params=restored["params"],
-        batch_stats=restored["batch_stats"],
-        opt_state=restored["opt_state"],
-    )
+    fields = {k: restored[k] for k in ("step", "params", "opt_state")}
+    if hasattr(state, "batch_stats"):
+        fields["batch_stats"] = restored["batch_stats"]
+    return state.replace(**fields)
 
 
 __all__ = ["save_checkpoint", "restore_checkpoint", "latest_checkpoint"]

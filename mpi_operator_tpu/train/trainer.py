@@ -91,11 +91,16 @@ class Trainer:
     # -- initialization -----------------------------------------------------
 
     def init_state(self, rng: jax.Array) -> TrainState:
+        from flax.core import meta
+
         dummy = jnp.zeros(
             (2, self.config.image_size, self.config.image_size, 3),
             jnp.float32,
         )
         variables = jax.jit(partial(self.model.init, train=False))(rng, dummy)
+        # models annotated with logical partitioning (ViT) come back boxed;
+        # unbox is a no-op for plain arrays (ResNet)
+        variables = meta.unbox(variables)
         params = variables["params"]
         batch_stats = variables.get("batch_stats", FrozenDict())
         state = TrainState(
@@ -118,7 +123,8 @@ class Trainer:
                 images, train=True, mutable=["batch_stats"],
             )
             loss = cross_entropy_loss(logits, labels, self.config.num_classes)
-            return loss, (logits, mutated["batch_stats"])
+            # LayerNorm-only models (ViT) have no batch_stats collection
+            return loss, (logits, mutated.get("batch_stats", state.batch_stats))
 
         (loss, (logits, new_stats)), grads = jax.value_and_grad(
             loss_fn, has_aux=True)(state.params)

@@ -62,3 +62,25 @@ def test_benchmark_with_data_dir(tmp_path):
         data_dir=str(tmp_path), log=lambda s: None)
     assert metrics["steps"] == 2
     assert np.isfinite(metrics["final_loss"])
+
+
+def test_npy_dataset_rejects_undersized_shards(tmp_path):
+    import pytest
+    rng = np.random.RandomState(0)
+    write_npy_shard(str(tmp_path), "tiny",
+                    rng.randint(0, 255, (3, 8, 8, 3), np.uint8),
+                    rng.randint(0, 10, (3,), np.int64))
+    with pytest.raises(ValueError, match="smaller"):
+        NpyImageDataset(str(tmp_path), batch_size=8, image_size=8)
+
+
+def test_npy_dataset_close_stops_feeder(tmp_path):
+    rng = np.random.RandomState(0)
+    write_npy_shard(str(tmp_path), "s",
+                    rng.randint(0, 255, (32, 8, 8, 3), np.uint8),
+                    rng.randint(0, 10, (32,), np.int64))
+    ds = NpyImageDataset(str(tmp_path), batch_size=4, image_size=8,
+                         dtype=jnp.float32, prefetch=1)
+    next(ds)
+    ds.close()
+    assert not ds._thread.is_alive()

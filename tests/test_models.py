@@ -123,3 +123,30 @@ def test_gradients_flow():
     norms = [float(jnp.abs(g).sum()) for g in jax.tree.leaves(grads)]
     assert all(jnp.isfinite(jnp.asarray(norms)))
     assert sum(n > 0 for n in norms) > len(norms) // 2
+
+
+def test_padding_mask_forces_dense_path(monkeypatch):
+    """A padding mask must never be silently dropped: flash/ring configs
+    fall back to dense when a mask is present."""
+    from mpi_operator_tpu.models import transformer as tr
+
+    cfg = tr.bert_config("test", attention="flash", dtype=jnp.float32,
+                         vocab_size=64, max_len=32)
+    model = tr.MaskedLM(cfg)
+    toks = jnp.zeros((1, 8), jnp.int32)
+    vs = unboxed_init(model, jax.random.PRNGKey(0), toks)
+
+    called = {"flash": 0}
+    def boom(*a, **kw):
+        called["flash"] += 1
+        raise AssertionError("flash must not run with a mask")
+    import mpi_operator_tpu.ops.attention as opsattn
+    monkeypatch.setattr(opsattn, "flash_attention", boom)
+
+    mask = jnp.ones((1, 8), bool).at[:, 4:].set(False)
+    out = model.apply(vs, toks, attention_mask=mask)   # uses dense path
+    assert out.shape == (1, 8, 64)
+    # and with no mask the flash path IS selected (and our stub trips)
+    import pytest
+    with pytest.raises(AssertionError):
+        model.apply(vs, toks)
