@@ -188,7 +188,18 @@ class TPUJobSpec:
     # clean-pod policy from v1alpha2 (ref v1alpha2/types.go:55-66):
     # "Running" | "All" | "None". The v1alpha1 controller behaves like
     # "Running" (workers scaled to 0 on done, mpi_job_controller.go:594-596).
+    # "All" additionally deletes the finished launcher Job; "None" keeps
+    # the worker set running after completion.
     clean_pod_policy: str = "Running"
+
+    # gang-restart policy for a FAILED launcher (v1alpha2 RestartPolicy,
+    # ref common_types.go:131-156 — specified there, implemented nowhere):
+    #   "Never"     — a failed launcher Job is terminal (v1alpha1 behavior;
+    #                 the Job's own backoffLimit already retried in place)
+    #   "OnFailure" — always recreate the launcher (the gang restarts)
+    #   "ExitCode"  — recreate only for retryable codes (128-255, e.g.
+    #                 SIGKILL'd / infra loss); 1-127 are permanent failures
+    restart_policy: str = "Never"
 
 
 # ---------------------------------------------------------------------------
@@ -237,6 +248,8 @@ class TPUJobStatus:
     replica_statuses: Dict[str, ReplicaStatus] = field(default_factory=dict)
     start_time: Optional[float] = None
     completion_time: Optional[float] = None
+    # controller-level gang restarts performed (restart_policy != "Never")
+    restart_count: int = 0
 
     # -- condition helpers (ref: v1alpha2 intent; pkg has no impl) ----------
     def get_condition(self, cond_type: str) -> Optional[JobCondition]:

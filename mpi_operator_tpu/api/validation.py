@@ -138,6 +138,14 @@ def validate_spec(spec: TPUJobSpec) -> None:
             f"{spec.clean_pod_policy!r}"
         )
 
+    if spec.restart_policy not in ("Never", "OnFailure", "ExitCode"):
+        # ref: v1alpha2 RestartPolicy (common_types.go:131-156); "Always" is
+        # rejected for the launcher — a completion signal must terminate
+        errs.append(
+            f"spec.restartPolicy must be Never|OnFailure|ExitCode, got "
+            f"{spec.restart_policy!r}"
+        )
+
     if errs:
         raise ValidationError(errs)
 

@@ -119,6 +119,9 @@ class JobStatus:
     failed: int = 0
     start_time: Optional[float] = None
     completion_time: Optional[float] = None
+    # main-container exit code of the (last) failed pod; feeds the
+    # ExitCode restart policy (v1alpha2 common_types.go:150-155)
+    exit_code: Optional[int] = None
 
 
 @dataclass

@@ -271,11 +271,44 @@ class TPUJobController:
             return
 
         launcher = self.get_launcher_job(job)                  # ref :440, :522-544
-        done = launcher is not None and (
-            launcher.succeeded() or launcher.failed()          # ref :445
+
+        # terminal state persists in conditions — the launcher Job object
+        # may be gone afterwards (CleanPodPolicy "All")
+        terminal = (
+            job.status.get_condition(api.COND_SUCCEEDED) is not None
+            or job.status.get_condition(api.COND_FAILED) is not None
         )
 
-        alloc = self.allocate_processing_units(job, done)      # ref :462, :547-598
+        # gang restart (v1alpha2 RestartPolicy, common_types.go:131-156):
+        # a failed launcher is recreated when the policy allows it and the
+        # backoff budget isn't exhausted; workers stay up (kubelet restarts
+        # their processes), so the whole gang relaunches from the latest
+        # checkpoint.
+        if (launcher is not None and launcher.failed() and not terminal
+                and self._should_restart(job, launcher)):
+            self.api.delete("Job", launcher.metadata.namespace,
+                            launcher.metadata.name)
+            job.status.restart_count += 1
+            job.status.set_condition(api.JobCondition(
+                api.COND_RESTARTING, "True", "TPUJobRestarting",
+                f"launcher failed (exit_code="
+                f"{launcher.status.exit_code}); restart "
+                f"{job.status.restart_count}"))
+            self.api.update(job)
+            self.recorder.event(
+                job, "Normal", "TPUJobRestarting",
+                f"gang restart {job.status.restart_count}")
+            launcher = None
+
+        done = terminal or (launcher is not None and (
+            launcher.succeeded() or launcher.failed()          # ref :445
+        ))
+
+        # CleanPodPolicy "None" keeps the worker set after completion
+        # (v1alpha2 types.go:55-66); "Running"/"All" scale it to 0 (the
+        # v1alpha1 behavior, ref :594-596)
+        scale_down = done and job.spec.clean_pod_policy != "None"
+        alloc = self.allocate_processing_units(job, scale_down)  # ref :462, :547-598
 
         if not done:
             self.get_or_create_config_map(job, alloc)          # ref :470
@@ -303,7 +336,38 @@ class TPUJobController:
             launcher = self.api.create(self.new_launcher(job, alloc))
 
         self.update_tpu_job_status(job, launcher, worker)      # ref :513, :761-791
+
+        # CleanPodPolicy "All": drop the finished launcher Job too — the
+        # terminal state was just recorded in conditions, so `done` survives
+        # the launcher's disappearance on later reconciles
+        if (done and job.spec.clean_pod_policy == "All"
+                and launcher is not None
+                and (launcher.succeeded() or launcher.failed())):
+            self.api.delete("Job", launcher.metadata.namespace,
+                            launcher.metadata.name)
+
         self.recorder.event(job, "Normal", "Synced", "TPUJob synced successfully")
+
+    # ------------------------------------------------------------------
+    # gang-restart decision (v1alpha2 RestartPolicy, common_types.go:131-156)
+    # ------------------------------------------------------------------
+
+    def _should_restart(self, job: TPUJob, launcher: Job) -> bool:
+        policy = job.spec.restart_policy
+        cap = (job.spec.backoff_limit
+               if job.spec.backoff_limit is not None
+               else api.DEFAULT_BACKOFF_LIMIT)
+        if job.status.restart_count >= cap:
+            return False
+        if policy == "OnFailure":
+            return True
+        if policy == "ExitCode":
+            code = launcher.status.exit_code
+            # 1-127 = permanent application failure; 128-255 = retryable
+            # (signal-killed / infra loss, incl. LAUNCHER_LOST_EXIT); an
+            # unknown code means the pod vanished — treat as retryable
+            return code is None or code >= 128
+        return False          # "Never" (v1alpha1 behavior)
 
     # ------------------------------------------------------------------
     # launcher lookup (ref getLauncherJob :522-544)

@@ -472,3 +472,115 @@ def test_cascade_delete_on_owner():
     assert {k for k, _, _ in doomed} >= {
         "ConfigMap", "ServiceAccount", "Role", "RoleBinding", "StatefulSet",
     }
+
+
+# ---------------------------------------------------------------------------
+# gang restart (v1alpha2 RestartPolicy, common_types.go:131-156) and
+# CleanPodPolicy (v1alpha2 types.go:55-66)
+# ---------------------------------------------------------------------------
+
+def _seed_failed_launcher(f, job, exit_code=None):
+    alloc = f.controller.allocate_processing_units(job, False)
+    launcher = f.controller.new_launcher(job, alloc)
+    launcher.status = JobStatus(failed=1, completion_time=123.0,
+                                exit_code=exit_code)
+    return f.seed(launcher)
+
+
+def test_restart_policy_never_is_terminal():
+    """Default (v1alpha1 behavior): a failed launcher ends the job."""
+    f = Fixture()
+    job = f.seed(new_job(tpus=8))
+    _seed_failed_launcher(f, job)
+    f.run("default/test")
+    updated = f.api.get(api.KIND, "default", "test")
+    assert updated.status.get_condition(api.COND_FAILED) is not None
+    assert updated.status.restart_count == 0
+
+
+def test_restart_policy_onfailure_recreates_launcher():
+    f = Fixture()
+    job = f.seed(new_job(tpus=8, restart_policy="OnFailure"))
+    _seed_workers(f, job, replicas=2, ready=2)
+    _seed_failed_launcher(f, job, exit_code=1)
+    f.run("default/test")
+    updated = f.api.get(api.KIND, "default", "test")
+    assert updated.status.restart_count == 1
+    assert updated.status.get_condition(api.COND_RESTARTING) is not None
+    assert updated.status.get_condition(api.COND_FAILED) is None
+    # the launcher was recreated fresh (workers were ready)
+    fresh = f.api.get("Job", "default", "test" + LAUNCHER_SUFFIX)
+    assert fresh.status.failed == 0
+
+
+def test_restart_policy_exitcode_distinguishes_permanent_and_retryable():
+    # retryable (>=128): restart
+    f = Fixture()
+    job = f.seed(new_job(tpus=8, restart_policy="ExitCode"))
+    _seed_workers(f, job, replicas=2, ready=2)
+    _seed_failed_launcher(f, job, exit_code=213)     # LAUNCHER_LOST_EXIT
+    f.run("default/test")
+    assert f.api.get(api.KIND, "default", "test").status.restart_count == 1
+
+    # permanent (1-127): terminal
+    f2 = Fixture()
+    job2 = f2.seed(new_job(tpus=8, restart_policy="ExitCode"))
+    _seed_failed_launcher(f2, job2, exit_code=2)
+    f2.run("default/test")
+    updated = f2.api.get(api.KIND, "default", "test")
+    assert updated.status.restart_count == 0
+    assert updated.status.get_condition(api.COND_FAILED) is not None
+
+
+def test_restart_budget_exhaustion_fails_job():
+    f = Fixture()
+    job = new_job(tpus=8, restart_policy="OnFailure", backoff_limit=1)
+    job = f.seed(job)
+    _seed_workers(f, job, replicas=2, ready=2)
+    _seed_failed_launcher(f, job, exit_code=137)
+    f.run("default/test")          # restart 1/1
+    assert f.api.get(api.KIND, "default", "test").status.restart_count == 1
+    # fail the recreated launcher too
+    relaunched = f.api.get("Job", "default", "test" + LAUNCHER_SUFFIX)
+    relaunched.status = JobStatus(failed=1, exit_code=137)
+    f.api.update(relaunched)
+    f.run("default/test")          # budget exhausted → terminal
+    updated = f.api.get(api.KIND, "default", "test")
+    assert updated.status.restart_count == 1
+    assert updated.status.get_condition(api.COND_FAILED) is not None
+
+
+def test_clean_pod_policy_none_keeps_workers():
+    f = Fixture()
+    job = f.seed(new_job(tpus=8, clean_pod_policy="None"))
+    _seed_workers(f, job, replicas=2, ready=2)
+    _seed_finished_launcher(f, job, succeeded=True)
+    f.run("default/test")
+    sts = f.api.get("StatefulSet", "default", "test" + WORKER_SUFFIX)
+    assert sts.spec.replicas == 2          # NOT scaled down
+    assert f.api.get(api.KIND, "default",
+                     "test").status.get_condition(api.COND_SUCCEEDED)
+
+
+def test_clean_pod_policy_all_deletes_launcher_and_stays_done():
+    from mpi_operator_tpu.cluster.apiserver import NotFoundError
+    f = Fixture()
+    job = f.seed(new_job(tpus=8, clean_pod_policy="All"))
+    _seed_workers(f, job, replicas=2, ready=2)
+    _seed_finished_launcher(f, job, succeeded=True)
+    f.run("default/test")
+    with pytest.raises(NotFoundError):
+        f.api.get("Job", "default", "test" + LAUNCHER_SUFFIX)
+    # level-triggered: a later reconcile must NOT recreate the launcher
+    # (terminal state lives in conditions now)
+    f.run("default/test")
+    with pytest.raises(NotFoundError):
+        f.api.get("Job", "default", "test" + LAUNCHER_SUFFIX)
+    sts = f.api.get("StatefulSet", "default", "test" + WORKER_SUFFIX)
+    assert sts.spec.replicas == 0
+
+
+def test_restart_policy_validation():
+    from mpi_operator_tpu.api.validation import ValidationError, validate_spec
+    with pytest.raises(ValidationError, match="restartPolicy"):
+        validate_spec(new_job(tpus=8, restart_policy="Always").spec)
