@@ -38,14 +38,11 @@ def main() -> None:
     args = parser.parse_args()
 
     if args.smoke:
-        flags = os.environ.get("XLA_FLAGS", "")
-        if "--xla_force_host_platform_device_count" not in flags:
-            os.environ["XLA_FLAGS"] = (
-                flags + " --xla_force_host_platform_device_count=8").strip()
+        from mpi_operator_tpu.utils.hostplatform import force_host_platform
+        force_host_platform(8)
 
     import jax
     if args.smoke:
-        jax.config.update("jax_platforms", "cpu")
         args.model = "resnet18"
         args.batch_per_device = 2
         args.steps = 4

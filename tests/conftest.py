@@ -13,14 +13,8 @@ jax.config BEFORE any backend is initialized is the reliable channel.
 import os
 import sys
 
-flags = os.environ.get("XLA_FLAGS", "")
-if "--xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (
-        flags + " --xla_force_host_platform_device_count=8"
-    ).strip()
-
-import jax  # noqa: E402
-
-jax.config.update("jax_platforms", "cpu")
-
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from mpi_operator_tpu.utils.hostplatform import force_host_platform  # noqa: E402
+
+force_host_platform(8)
