@@ -1,0 +1,32 @@
+# Operator image (ref: /root/reference/Dockerfile:1-25 — a 2-stage
+# golang-alpine build producing the mpi-operator binary). The TPU-native
+# operator is pure-stdlib Python (+PyYAML for kubeconfig parsing), so the
+# build stage byte-compiles and prunes instead of `go build`, and the
+# runtime stage is a slim image with only the operator package. Produces
+# the `tpu-operator:latest` image deploy/3-tpu-operator.yaml runs.
+#
+# Build: docker build -t tpu-operator:latest .
+# The training *workload* image (JAX/TPU data plane) is separate:
+# examples/Dockerfile.
+
+FROM python:3.12-slim AS build
+WORKDIR /src
+COPY mpi_operator_tpu/ mpi_operator_tpu/
+# the control plane must not drag the data plane (jax et al.) into the
+# operator image: fail the build if an operator-path module imports it
+RUN python - <<'EOF'
+import sys
+sys.modules['jax'] = None          # poison: import jax → TypeError
+import mpi_operator_tpu.__main__    # noqa: F401 — control plane only
+import mpi_operator_tpu.cluster.kubeclient  # noqa: F401
+import mpi_operator_tpu.controller  # noqa: F401
+print("operator imports are jax-free")
+EOF
+RUN python -m compileall -q mpi_operator_tpu
+
+FROM python:3.12-slim
+RUN pip install --no-cache-dir pyyaml && useradd -r -u 1001 operator
+COPY --from=build /src/mpi_operator_tpu /app/mpi_operator_tpu
+WORKDIR /app
+USER 1001
+ENTRYPOINT ["python", "-m", "mpi_operator_tpu"]

@@ -1,11 +1,12 @@
 """Operator process entry point — `python -m mpi_operator_tpu`.
 
 ref: cmd/mpi-operator/main.go:42-115. Flags mirror the reference's
-(--gpus-per-node → --tpus-per-worker etc.); `--kube-config`/`--master`
-select a real cluster backend, which this build gates behind the optional
-`kubernetes` package (not bundled); without it, `--demo` runs the full
-reconcile lifecycle against the in-memory API server so the operator is
-drivable end-to-end on a laptop.
+(--gpus-per-node → --tpus-per-worker etc.). Default mode converges a REAL
+cluster: `--kube-config`/`--master` (or the in-cluster service-account
+mount) select the `KubeAPIServer` backend — a zero-dependency typed REST
+client (cluster/kubeclient.py), the analogue of the reference's clientsets
+(main.go:42-96). `--demo` instead runs the full reconcile lifecycle against
+the in-memory API server so the operator is drivable end-to-end on a laptop.
 """
 from __future__ import annotations
 
@@ -28,8 +29,9 @@ def build_parser() -> argparse.ArgumentParser:
     )
     # ref main.go:98-115
     p.add_argument("--kube-config", default="",
-                   help="path to a kubeconfig (requires the kubernetes "
-                        "package; out-of-cluster operation)")
+                   help="path to a kubeconfig (out-of-cluster operation); "
+                        "omit both this and --master to use the in-cluster "
+                        "service-account config")
     p.add_argument("--master", default="",
                    help="Kubernetes API server address (overrides kubeconfig)")
     p.add_argument("--tpus-per-worker", type=int, default=4,
@@ -89,7 +91,7 @@ def run_demo(controller: TPUJobController, api: InMemoryAPIServer) -> int:
     return 0
 
 
-def main(argv=None) -> int:
+def main(argv=None, stop_event=None) -> int:
     args = build_parser().parse_args(argv)
     logging.basicConfig(
         level=logging.INFO,
@@ -104,27 +106,48 @@ def main(argv=None) -> int:
         discovery_image=args.discovery_image,
     )
 
-    if not args.demo:
-        # Real-cluster backend requires the kubernetes client package, which
-        # this environment does not bundle; the adapter seam is
-        # InMemoryAPIServer's interface (create/update/get/list/watch).
-        print(
-            "error: real-cluster mode needs the `kubernetes` package "
-            "(not installed). Run with --demo for the in-memory lifecycle.",
-            file=sys.stderr)
-        return 2
+    stop = stop_event or threading.Event()
+    if stop_event is None:                                 # ref main.go:46
+        signal.signal(signal.SIGTERM, lambda *_: stop.set())
+        signal.signal(signal.SIGINT, lambda *_: stop.set())
 
-    api = InMemoryAPIServer()
+    if args.demo:
+        api = InMemoryAPIServer()
+        controller = TPUJobController(api, config=config)
+        controller.run(threadiness=args.threadiness, stop_event=stop)
+        try:
+            return run_demo(controller, api)
+        finally:
+            stop.set()
+            controller.queue.shut_down()
+
+    # Real-cluster mode (ref main.go:42-96): kubeconfig / --master /
+    # in-cluster, then run until signaled.
+    from .cluster.kubeclient import KubeAPIServer, KubeConfig, KubeConfigError
+    try:
+        kube_config = KubeConfig.load(kubeconfig=args.kube_config,
+                                      master=args.master)
+    except (KubeConfigError, OSError) as exc:
+        print(f"error building kube client config: {exc}", file=sys.stderr)
+        return 2
+    if config.namespace is None:
+        # a namespaced in-cluster deployment defaults to its own namespace
+        config.namespace = (KubeConfig.namespace_in_cluster()
+                            if not args.kube_config and not args.master
+                            else None)
+    api = KubeAPIServer(kube_config)
     controller = TPUJobController(api, config=config)
-    stop = threading.Event()
-    signal.signal(signal.SIGTERM, lambda *_: stop.set())   # ref main.go:46
-    signal.signal(signal.SIGINT, lambda *_: stop.set())
+    logging.getLogger("main").info(
+        "starting TPUJob controller against %s (namespace=%s)",
+        kube_config.server, config.namespace or "<all>")
     controller.run(threadiness=args.threadiness, stop_event=stop)
     try:
-        return run_demo(controller, api)
+        stop.wait()                                        # run until signal
     finally:
         stop.set()
+        api.stop()
         controller.queue.shut_down()
+    return 0
 
 
 if __name__ == "__main__":

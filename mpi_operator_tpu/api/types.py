@@ -122,6 +122,9 @@ class PodTemplateSpec:
     restart_policy: str = "OnFailure"
     node_selector: Dict[str, str] = field(default_factory=dict)
     volumes: List[Dict[str, str]] = field(default_factory=list)
+    # wire-format toleration dicts ({key, operator, effect, ...}); used by
+    # launcherOnMaster to tolerate the control-plane taint
+    tolerations: List[Dict[str, str]] = field(default_factory=list)
 
     def main_container(self) -> Container:
         if not self.containers:

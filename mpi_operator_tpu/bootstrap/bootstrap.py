@@ -177,13 +177,24 @@ def initialize(env: Optional[Mapping[str, str]] = None,
 # ranks are mpirun's children; it exits when they do — SURVEY §3.3). Here
 # ranks are independent pods, so rank-0 exposes a one-line TCP status
 # ("running" | "done <exitcode>") and the launcher polls it.
+#
+# Handshake: the poller's first line is the job token (the TPUJob uid,
+# injected by the controller as TPU_JOB_TOKEN into launcher AND workers).
+# A mismatching or missing token gets "denied" and does NOT count as the
+# launcher having observed completion — a stray cluster connection can't
+# consume the done-linger and race the real launcher out of its exit code.
+
+ENV_JOB_TOKEN = "TPU_JOB_TOKEN"
+
 
 class StatusServer:
     """Tiny TCP status endpoint served by rank-0 next to training."""
 
-    def __init__(self, port: int = STATUS_PORT):
+    def __init__(self, port: int = STATUS_PORT, token: Optional[str] = None):
         import threading
 
+        self.token = (token if token is not None
+                      else os.environ.get(ENV_JOB_TOKEN, ""))
         self._state = "running"
         self._lock = threading.Lock()
         self._served_done = threading.Event()
@@ -196,21 +207,43 @@ class StatusServer:
             target=self._serve, name="tpu-status", daemon=True)
         self._thread.start()
 
+    def _authorized(self, conn) -> bool:
+        if not self.token:
+            return True          # tokenless dev mode: accept everyone
+        try:
+            conn.settimeout(2.0)
+            # errors="replace": binary garbage (TLS probes, port scanners)
+            # must compare unequal, not blow up the serving thread
+            line = conn.makefile("rb").readline().decode(
+                errors="replace").strip()
+            return line == self.token
+        except OSError:
+            return False
+
     def _serve(self) -> None:
         while True:
             try:
                 conn, _ = self._sock.accept()
             except OSError:
                 return
-            with self._lock:
-                state = self._state
+            # nothing a single connection does may kill the serving thread —
+            # rank-0 going "unreachable" here triggers a spurious gang restart
             try:
-                conn.sendall(state.encode() + b"\n")
-                conn.close()
-            except OSError:
-                pass
-            if state.startswith("done"):
-                self._served_done.set()
+                authorized = self._authorized(conn)
+                with self._lock:
+                    state = self._state if authorized else "denied"
+                try:
+                    conn.sendall(state.encode() + b"\n")
+                    conn.close()
+                except OSError:
+                    continue
+                if authorized and state.startswith("done"):
+                    self._served_done.set()
+            except Exception:  # noqa: BLE001 — stray-client hardening
+                try:
+                    conn.close()
+                except OSError:
+                    pass
 
     def set_done(self, exit_code: int, linger: float = 10.0) -> None:
         """Mark done and give the launcher a chance to observe it before the
@@ -228,10 +261,15 @@ class StatusServer:
 
 
 def poll_status(host: str, port: int = STATUS_PORT,
-                timeout: float = 2.0) -> Optional[str]:
-    """One status read; None if unreachable."""
+                timeout: float = 2.0,
+                token: Optional[str] = None) -> Optional[str]:
+    """One status read; None if unreachable. Sends the job-token handshake
+    line first (empty token line for tokenless dev servers)."""
+    if token is None:
+        token = os.environ.get(ENV_JOB_TOKEN, "")
     try:
         with socket.create_connection((host, port), timeout=timeout) as conn:
+            conn.sendall(token.encode() + b"\n")
             return conn.makefile().readline().strip()
     except OSError:
         return None
@@ -240,46 +278,58 @@ def poll_status(host: str, port: int = STATUS_PORT,
 def launcher_wait(info: ProcessInfo, port: int = STATUS_PORT,
                   poll_interval: float = 2.0,
                   startup_timeout: float = 600.0,
-                  lost_timeout: float = 120.0) -> int:
+                  lost_timeout: float = 120.0,
+                  token: Optional[str] = None) -> int:
     """Block until rank-0 reports completion; return its exit code.
 
-    State machine: before first contact, wait up to `startup_timeout`
-    (workers are already Ready — the controller gates the launcher on that —
-    so rank-0's server appears as soon as its process starts). After contact,
-    an unreachable server means the worker pod restarted mid-run (kubelet
-    restarts workers, ref RestartPolicy Always, mpi_job_controller.go:1021);
-    we tolerate the outage for `lost_timeout` (rescheduling, image pull) and
-    then KEEP waiting up to a fresh `startup_timeout` window before giving
-    up with LAUNCHER_LOST_EXIT — an exit code distinct from workload codes
-    so operators can tell an infra loss from an application failure.
-    Job-level activeDeadlineSeconds (ref :1221-1222) remains the global
-    stop."""
+    Explicit state machine:
+
+      STARTING ──contact──▶ RUNNING ──outage──▶ LOST ──lost_timeout──▶
+      RESTARTING ──fresh startup_timeout expires──▶ LAUNCHER_LOST_EXIT
+
+    STARTING: before first contact, wait up to `startup_timeout` (workers
+    are already Ready — the controller gates the launcher on that — so
+    rank-0's server appears as soon as its process starts); expiry raises
+    BootstrapError. RUNNING: normal polling. LOST: the server went
+    unreachable — the worker pod restarted mid-run (kubelet restarts
+    workers, ref RestartPolicy Always, mpi_job_controller.go:1021); brief
+    outages under `lost_timeout` are tolerated. RESTARTING: the outage
+    outlived `lost_timeout`, so treat it as a pod reschedule and allow a
+    FRESH `startup_timeout` window for the new pod to come up. ANY
+    successful contact returns to RUNNING and fully resets both windows —
+    repeated transient outages never accumulate toward the give-up
+    deadline. Give-up exit is LAUNCHER_LOST_EXIT (128-255 retryable band)
+    so operators can tell infra loss from application failure; job-level
+    activeDeadlineSeconds (ref :1221-1222) remains the global stop."""
     import time as _time
 
     host = info.coordinator_address.split(":")[0]
-    deadline = _time.monotonic() + startup_timeout
-    seen = False
-    lost_since: Optional[float] = None
+    state = "STARTING"
+    window_expiry = _time.monotonic() + startup_timeout
     while True:
-        status = poll_status(host, port, timeout=poll_interval)
+        status = poll_status(host, port, timeout=poll_interval, token=token)
         now = _time.monotonic()
-        if status is None:
-            if not seen:
-                if now > deadline:
-                    raise BootstrapError(
-                        f"rank-0 status channel {host}:{port} unreachable for "
-                        f"{startup_timeout}s")
-            else:
-                lost_since = lost_since or now
-                if now - lost_since > lost_timeout + startup_timeout:
-                    # worker restarted and never came back in startup scale
-                    return LAUNCHER_LOST_EXIT
-        elif status.startswith("done"):
+        if status is not None and status.startswith("done"):
             parts = status.split()
             return int(parts[1]) if len(parts) > 1 else 0
-        else:
-            seen = True
-            lost_since = None
+        if status is not None:
+            # contact (running/denied both prove liveness) → RUNNING, reset
+            state = "RUNNING"
+        elif state == "STARTING":
+            if now > window_expiry:
+                raise BootstrapError(
+                    f"rank-0 status channel {host}:{port} unreachable for "
+                    f"{startup_timeout}s")
+        elif state == "RUNNING":
+            state = "LOST"
+            window_expiry = now + lost_timeout
+        elif state == "LOST":
+            if now > window_expiry:
+                state = "RESTARTING"
+                window_expiry = now + startup_timeout
+        elif state == "RESTARTING":
+            if now > window_expiry:
+                return LAUNCHER_LOST_EXIT
         _time.sleep(poll_interval)
 
 
@@ -288,5 +338,7 @@ __all__ = [
     "resolve_worker_ordinal",
     "ENV_COORDINATOR", "ENV_NUM_PROCESSES", "ENV_WORKER_HOSTNAMES",
     "ENV_WORKER_ID", "ENV_SLOTS", "ENV_CONFIG_PATH", "ENV_LAUNCHER",
-    "ENV_NUM_SLICES",
+    "ENV_NUM_SLICES", "ENV_JOB_TOKEN",
+    "StatusServer", "poll_status", "launcher_wait",
+    "STATUS_PORT", "LAUNCHER_LOST_EXIT",
 ]

@@ -147,7 +147,11 @@ class InMemoryAPIServer:
 
     # -- watch --------------------------------------------------------------
 
-    def watch(self, kind: str, handler: WatchHandler) -> None:
+    def watch(self, kind: str, handler: WatchHandler,
+              namespace: Optional[str] = None) -> None:
+        # namespace accepted for interface parity with KubeAPIServer.watch;
+        # events fan out unfiltered and the Informer filters by namespace.
+        del namespace
         with self._lock:
             self._watchers.setdefault(kind, []).append(handler)
 
@@ -176,6 +180,13 @@ class InMemoryAPIServer:
                 raise NotFoundError(obj.kind, f"{key[1]}/{key[2]}")
             self._admit(obj)
             obj = deepcopy_resource(obj)
+            if subresource == "status" and hasattr(old, "spec"):
+                # real /status semantics: only .status changes; the caller's
+                # spec/metadata edits are discarded (mirrors an API server
+                # with the status subresource enabled, deploy/0-crd.yaml)
+                merged = deepcopy_resource(old)
+                merged.status = obj.status
+                obj = merged
             obj.metadata.resource_version = next(self._rv)
             obj.metadata.uid = old.metadata.uid
             self._store[key] = obj

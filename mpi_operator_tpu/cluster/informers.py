@@ -54,7 +54,9 @@ class Informer:
         self._update_handlers: List[Callable[[object, object], None]] = []
         self._delete_handlers: List[Callable[[object], None]] = []
         self._synced = False
-        api.watch(kind, self._on_event)
+        # Namespace-scoped watch keeps the real-cluster backend within a
+        # namespaced Role's RBAC (ref main.go:63-71 WithNamespace).
+        api.watch(kind, self._on_event, namespace=namespace)
 
     # -- handler registration (ref AddEventHandler, :204-321) ---------------
 

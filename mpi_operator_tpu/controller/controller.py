@@ -294,7 +294,7 @@ class TPUJobController:
                 f"launcher failed (exit_code="
                 f"{launcher.status.exit_code}); restart "
                 f"{job.status.restart_count}"))
-            self.api.update(job)
+            self.api.update_status(job)
             self.recorder.event(
                 job, "Normal", "TPUJobRestarting",
                 f"gang restart {job.status.restart_count}")
@@ -684,6 +684,10 @@ class TPUJobController:
         hostnames, coordinator, num_processes = self.discovery_topology(job, alloc)
         env = {
             "TPU_JOB_NAME": job.metadata.name,
+            # status-channel handshake token (bootstrap.StatusServer): the
+            # job uid is unguessable-enough to keep stray connections from
+            # consuming the done-linger, and identical across gang restarts
+            "TPU_JOB_TOKEN": job.metadata.uid,
             "TPU_WORKER_HOSTNAMES": ",".join(
                 h.split(".")[0] for h in hostnames
             ),
@@ -769,6 +773,19 @@ class TPUJobController:
             template.init_containers = template.init_containers + [
                 Container(name="discovery", image=self.config.discovery_image)
             ]
+        if job.spec.launcher_on_master:
+            # ref types.go:90-94 (launcherOnMaster — declared by the
+            # reference, reconciled only here): pin the thin coordinator to a
+            # control-plane node and tolerate its taint. Workers are
+            # unaffected — they must land on TPU nodes.
+            template.node_selector = {
+                **template.node_selector,
+                "node-role.kubernetes.io/control-plane": "",
+            }
+            template.tolerations = template.tolerations + [
+                {"key": "node-role.kubernetes.io/control-plane",
+                 "operator": "Exists", "effect": "NoSchedule"},
+            ]
         # OnFailure, not Never (ref :1175-1177): with Never, the batch Job
         # controller increments status.failed on the FIRST pod failure, which
         # our done-check (sync_handler) would read as terminal — backoffLimit
@@ -850,9 +867,41 @@ class TPUJobController:
             job.status.worker_replicas = ready
             changed = True
 
+        # per-replica counts (v1alpha2 ReplicaStatus, common_types.go:68-80 —
+        # defined by the reference, reconciled only here): the launcher Job's
+        # own active/succeeded/failed, and the worker StatefulSet's
+        # ready(=active) replicas. Worker pods never "succeed" — they are
+        # long-lived training processes scaled to 0 on completion.
+        if launcher is not None:
+            launcher_rs = api.ReplicaStatus(
+                active=launcher.status.active,
+                succeeded=launcher.status.succeeded,
+                failed=launcher.status.failed,
+            )
+        elif job.status.is_done():
+            # launcher Job deleted after completion (CleanPodPolicy "All"):
+            # keep the recorded terminal counts instead of flapping to 0
+            launcher_rs = job.status.replica_statuses.get(
+                "launcher", api.ReplicaStatus())
+        else:
+            launcher_rs = api.ReplicaStatus()
+        desired = {
+            "launcher": launcher_rs,
+            # no worker failed-count: the StatefulSet's RestartPolicy=Always
+            # means kubelet resurrects workers rather than failing them
+            "worker": api.ReplicaStatus(active=ready),
+        }
+        if job.status.replica_statuses != desired:
+            job.status.replica_statuses = desired
+            changed = True
+
         if changed:
-            # full-object Update, like the reference (ref :789)
-            self.api.update(job)
+            # /status subresource, NOT full-object Update: our CRD enables
+            # the status subresource (deploy/0-crd.yaml), so a real API
+            # server STRIPS .status from plain PUTs — the reference could
+            # use full Update (ref :789) only because its v1beta1 CRD
+            # predates subresources.
+            self.api.update_status(job)
 
 
 __all__ = [

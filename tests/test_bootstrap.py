@@ -1,5 +1,7 @@
 """Bootstrap-path tests: the env/hostname → jax.distributed resolution that
 replaces the reference's hostfile + kubexec rsh agent (SURVEY §2.4)."""
+import time
+
 import pytest
 
 from mpi_operator_tpu.bootstrap import (
@@ -128,6 +130,111 @@ def test_launcher_wait_startup_timeout():
                        process_id=0, is_launcher=True)
     with pytest.raises(BootstrapError, match="unreachable"):
         launcher_wait(info, port=1, poll_interval=0.05, startup_timeout=0.3)
+
+
+def test_launcher_wait_loss_then_recovery():
+    """LOST → re-contact resets all windows; completion still observed."""
+    import threading
+    from mpi_operator_tpu.bootstrap.bootstrap import (
+        LAUNCHER_LOST_EXIT, ProcessInfo, StatusServer, launcher_wait,
+    )
+    # phase 1: server up, launcher sees "running"
+    server = StatusServer(port=0)
+    port = server.port
+    info = ProcessInfo(coordinator_address="localhost:8476",
+                       num_processes=2, process_id=0, is_launcher=True)
+    result = {}
+    t = threading.Thread(target=lambda: result.update(code=launcher_wait(
+        info, port=port, poll_interval=0.05,
+        startup_timeout=5.0, lost_timeout=0.4)), daemon=True)
+    t.start()
+    time.sleep(0.3)              # launcher has made contact (RUNNING)
+    # phase 2: outage longer than lost_timeout → launcher goes LOST then
+    # RESTARTING, but must NOT give up: a fresh startup window applies
+    server.close()
+    time.sleep(0.8)
+    # phase 3: "pod restarted" — new server on the same port; done observed
+    server2 = StatusServer(port=port)
+    try:
+        server2.set_done(0, linger=5.0)
+        t.join(timeout=10)
+        assert not t.is_alive()
+        assert result["code"] == 0
+        assert result["code"] != LAUNCHER_LOST_EXIT
+    finally:
+        server2.close()
+
+
+def test_launcher_wait_loss_then_timeout_returns_lost_exit():
+    """LOST → RESTARTING → fresh startup window expires → LAUNCHER_LOST_EXIT
+    (not BootstrapError: contact was established, so this is infra loss)."""
+    from mpi_operator_tpu.bootstrap.bootstrap import (
+        LAUNCHER_LOST_EXIT, ProcessInfo, StatusServer, launcher_wait,
+    )
+    server = StatusServer(port=0)
+    port = server.port
+    info = ProcessInfo(coordinator_address="localhost:8476",
+                       num_processes=2, process_id=0, is_launcher=True)
+    import threading
+    result = {}
+    t = threading.Thread(target=lambda: result.update(code=launcher_wait(
+        info, port=port, poll_interval=0.05,
+        startup_timeout=0.3, lost_timeout=0.2)), daemon=True)
+    t.start()
+    time.sleep(0.2)              # contact made
+    server.close()               # permanent loss
+    t.join(timeout=10)
+    assert not t.is_alive()
+    assert result["code"] == LAUNCHER_LOST_EXIT
+
+
+def test_status_channel_token_handshake():
+    """A wrong-token poller is denied and cannot consume the done-linger;
+    the real launcher (right token) still observes completion."""
+    import threading
+    from mpi_operator_tpu.bootstrap.bootstrap import (
+        StatusServer, poll_status,
+    )
+    server = StatusServer(port=0, token="job-uid-42")
+    try:
+        assert poll_status("localhost", server.port,
+                           token="wrong") == "denied"
+        assert poll_status("localhost", server.port,
+                           token="job-uid-42") == "running"
+        done = threading.Event()
+        t = threading.Thread(
+            target=lambda: (server.set_done(7, linger=10.0), done.set()))
+        t.start()
+        time.sleep(0.1)
+        # stray connections hammering the channel must not end the linger
+        for _ in range(5):
+            assert poll_status("localhost", server.port,
+                               token="wrong") == "denied"
+        assert not done.is_set()
+        assert poll_status("localhost", server.port,
+                           token="job-uid-42") == "done 7"
+        t.join(timeout=5)
+        assert done.is_set()
+    finally:
+        server.close()
+
+
+def test_controller_injects_job_token():
+    """The controller's discovery env carries TPU_JOB_TOKEN = job uid for
+    the status-channel handshake."""
+    from mpi_operator_tpu.api.types import new_tpu_job
+    from mpi_operator_tpu.cluster.apiserver import InMemoryAPIServer
+    from mpi_operator_tpu.controller import ControllerConfig, TPUJobController
+
+    api_server = InMemoryAPIServer()
+    controller = TPUJobController(api_server, config=ControllerConfig())
+    job = new_tpu_job("tok", tpus=8)
+    job.metadata.uid = "uid-abc"
+    alloc = controller.allocate_processing_units(job, False)
+    worker = controller.new_worker(job, alloc)
+    launcher = controller.new_launcher(job, alloc)
+    for obj in (worker.spec.template, launcher.spec.template):
+        assert obj.main_container().env["TPU_JOB_TOKEN"] == "uid-abc"
 
 
 def test_launch_forks_slots_and_propagates_failure(tmp_path):

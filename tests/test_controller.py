@@ -107,7 +107,7 @@ def test_all_resources_created():
         ("create", "Role"),
         ("create", "RoleBinding"),
         ("create", "StatefulSet"),
-        ("update", "TPUJob"),       # status: Created condition
+        ("update-status", "TPUJob"),   # status subresource: Created condition
     ]
     sts = f.api.get("StatefulSet", "default", "test" + WORKER_SUFFIX)
     assert sts.spec.replicas == 2
@@ -315,6 +315,55 @@ def test_worker_replicas_status_tracks_ready():
     f.run("default/test")
     updated = f.api.get(api.KIND, "default", "test")
     assert updated.status.worker_replicas == 2
+
+
+def test_replica_statuses_track_launcher_and_workers():
+    """v1alpha2 ReplicaStatus (common_types.go:68-80): per-role
+    active/succeeded/failed counts reconciled into status."""
+    f = Fixture()
+    job = f.seed(new_job(tpus=8))
+    _seed_workers(f, job, replicas=2, ready=2)
+    f.run("default/test")          # creates the launcher (workers ready)
+    # play kubelet: launcher pod starts
+    launcher = f.api.get("Job", "default", "test" + LAUNCHER_SUFFIX)
+    launcher.status.active = 1
+    f.api.update(launcher)
+    f.run("default/test")
+    updated = f.api.get(api.KIND, "default", "test")
+    assert updated.status.replica_statuses["worker"].active == 2
+    assert updated.status.replica_statuses["launcher"].active == 1
+
+    # launcher completes → launcher succeeded=1, workers scale to 0
+    launcher = f.api.get("Job", "default", "test" + LAUNCHER_SUFFIX)
+    launcher.status.succeeded = 1
+    launcher.status.active = 0
+    f.api.update(launcher)
+    sts = f.api.get("StatefulSet", "default", "test" + WORKER_SUFFIX)
+    sts.status.ready_replicas = 0
+    f.api.update(sts)
+    f.run("default/test")
+    updated = f.api.get(api.KIND, "default", "test")
+    assert updated.status.replica_statuses["launcher"].succeeded == 1
+    assert updated.status.replica_statuses["launcher"].active == 0
+    assert updated.status.replica_statuses["worker"].active == 0
+
+
+def test_launcher_on_master_pins_launcher_only():
+    """ref types.go:90-94: launcherOnMaster → control-plane node selector +
+    taint toleration on the launcher pod; workers keep TPU node selectors."""
+    f = Fixture()
+    job = f.seed(new_job(tpus=8, launcher_on_master=True))
+    _seed_workers(f, job, replicas=2, ready=2)
+    f.run("default/test")
+    launcher = f.api.get("Job", "default", "test" + LAUNCHER_SUFFIX)
+    sel = launcher.spec.template.node_selector
+    assert sel.get("node-role.kubernetes.io/control-plane") == ""
+    assert any(t.get("key") == "node-role.kubernetes.io/control-plane"
+               for t in launcher.spec.template.tolerations)
+    sts = f.api.get("StatefulSet", "default", "test" + WORKER_SUFFIX)
+    assert "node-role.kubernetes.io/control-plane" \
+        not in sts.spec.template.node_selector
+    assert sts.spec.template.tolerations == []
 
 
 # ---------------------------------------------------------------------------
@@ -578,6 +627,10 @@ def test_clean_pod_policy_all_deletes_launcher_and_stays_done():
         f.api.get("Job", "default", "test" + LAUNCHER_SUFFIX)
     sts = f.api.get("StatefulSet", "default", "test" + WORKER_SUFFIX)
     assert sts.spec.replicas == 0
+    # replicaStatuses must keep the terminal launcher counts, not flap to 0
+    # after the launcher Job object is garbage-collected
+    updated = f.api.get(api.KIND, "default", "test")
+    assert updated.status.replica_statuses["launcher"].succeeded == 1
 
 
 def test_restart_policy_validation():
