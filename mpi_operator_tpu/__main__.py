@@ -130,11 +130,10 @@ def main(argv=None, stop_event=None) -> int:
     except (KubeConfigError, OSError) as exc:
         print(f"error building kube client config: {exc}", file=sys.stderr)
         return 2
-    if config.namespace is None:
-        # a namespaced in-cluster deployment defaults to its own namespace
-        config.namespace = (KubeConfig.namespace_in_cluster()
-                            if not args.kube_config and not args.master
-                            else None)
+    # scope follows --namespace exactly, as the reference does (main.go:63-71
+    # WithNamespace only when the flag is set): the shipped RBAC
+    # (deploy/2-rbac.yaml) is cluster-wide, so an unflagged operator must
+    # watch all namespaces, not silently self-scope to its own
     api = KubeAPIServer(kube_config)
     controller = TPUJobController(api, config=config)
     logging.getLogger("main").info(

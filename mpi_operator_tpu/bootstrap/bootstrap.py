@@ -226,20 +226,19 @@ class StatusServer:
                 conn, _ = self._sock.accept()
             except OSError:
                 return
-            # nothing a single connection does may kill the serving thread —
-            # rank-0 going "unreachable" here triggers a spurious gang restart
+            # nothing a single connection does may kill the serving thread
+            # or leak its fd — rank-0 going "unreachable" here triggers a
+            # spurious gang restart
             try:
                 authorized = self._authorized(conn)
                 with self._lock:
                     state = self._state if authorized else "denied"
-                try:
-                    conn.sendall(state.encode() + b"\n")
-                    conn.close()
-                except OSError:
-                    continue
+                conn.sendall(state.encode() + b"\n")
                 if authorized and state.startswith("done"):
                     self._served_done.set()
             except Exception:  # noqa: BLE001 — stray-client hardening
+                pass
+            finally:
                 try:
                     conn.close()
                 except OSError:

@@ -96,9 +96,13 @@ class Event:
 
 class EventRecorder:
     """In-memory recorder; the FakeRecorder equivalent the tests use
-    (ref mpi_job_controller_test.go:177)."""
+    (ref mpi_job_controller_test.go:177). Bounded: a run-forever operator
+    appends per reconcile, so an unbounded list would leak."""
+    MAX_EVENTS = 1000
+
     def __init__(self):
-        self.events: List[Event] = []
+        from collections import deque
+        self.events = deque(maxlen=self.MAX_EVENTS)
 
     def event(self, _obj, etype: str, reason: str, message: str) -> None:
         self.events.append(Event(etype, reason, message))
@@ -294,7 +298,10 @@ class TPUJobController:
                 f"launcher failed (exit_code="
                 f"{launcher.status.exit_code}); restart "
                 f"{job.status.restart_count}"))
-            self.api.update_status(job)
+            # keep the returned object: a second status PUT in this same
+            # sync (update_tpu_job_status) must carry the fresh RV or a
+            # real API server 409s it
+            job = self.api.update_status(job)
             self.recorder.event(
                 job, "Normal", "TPUJobRestarting",
                 f"gang restart {job.status.restart_count}")

@@ -173,6 +173,15 @@ def _layer_norm(cfg, name):
                                                ("norm",)))
 
 
+def _constrain(x):
+    """Pin the residual stream to the activation layout (batch-sharded,
+    embed replicated — parallel/sharding.py ACTIVATION_RULES). A no-op
+    unless the trainer entered activation_rules_scope; without the pin,
+    GSPMD infers clashing layouts around the layernorms and pays an
+    involuntary full rematerialization in the backward."""
+    return nn.with_logical_constraint(x, ("batch", "seq", "embed"))
+
+
 class Block(nn.Module):
     """Pre-LN transformer block (GPT-2/ViT style)."""
     config: TransformerConfig
@@ -181,8 +190,9 @@ class Block(nn.Module):
     @nn.compact
     def __call__(self, x, mask=None):
         cfg = self.config
+        x = _constrain(x)
         y = _layer_norm(cfg, "ln_1")(x)
-        x = x + Attention(cfg, name="attn")(y, mask=mask)
+        x = _constrain(x + Attention(cfg, name="attn")(y, mask=mask))
         y = _layer_norm(cfg, "ln_2")(x)
         if self.use_moe:
             from ..parallel.moe import MoeMlp
@@ -193,7 +203,7 @@ class Block(nn.Module):
             self.sow("intermediates", "moe_aux_loss", aux)
         else:
             ff = Mlp(cfg, name="mlp")(y)
-        return x + ff
+        return _constrain(x + ff)
 
 
 class Backbone(nn.Module):
@@ -206,18 +216,28 @@ class Backbone(nn.Module):
         block = Block
         if cfg.remat:
             block = nn.remat(Block, static_argnums=())
+        h = _constrain(h)      # pin the embedding output / dh cotangent too
         for i in range(cfg.num_layers):
             use_moe = (cfg.num_experts > 0
                        and i % cfg.moe_every == cfg.moe_every - 1)
             h = block(cfg, use_moe=use_moe, name=f"block_{i}")(h, mask=mask)
-        return _layer_norm(cfg, "ln_f")(h)
+        return _constrain(_layer_norm(cfg, "ln_f")(h))
 
 
-def _embed(cfg, num, features, name, logical0):
+def _embed(cfg, num, features, name, logical0, logical1="embed"):
     return nn.Embed(
         num, features, dtype=cfg.dtype, name=name,
         embedding_init=nn.with_logical_partitioning(
-            kernel_init, (logical0, "embed")))
+            kernel_init, (logical0, logical1)))
+
+
+def _pos_embed(cfg, num, name="wpe"):
+    """Position/type tables are tiny and fully REPLICATED ("pos" maps to no
+    mesh axis): an fsdp-sharded embed dim here makes the scatter-add
+    gradient reshard the batch-sharded cotangent to embed-sharded through a
+    non-divisible reshape — the exact involuntary-full-remat GSPMD warns
+    about. Megatron replicates position embeddings for the same reason."""
+    return _embed(cfg, num, cfg.embed_dim, name, None, "pos")
 
 
 class CausalLM(nn.Module):
@@ -231,7 +251,7 @@ class CausalLM(nn.Module):
         cfg = self.config
         B, S = tokens.shape
         wte = _embed(cfg, cfg.vocab_size, cfg.embed_dim, "wte", "vocab")
-        wpe = _embed(cfg, cfg.max_len, cfg.embed_dim, "wpe", None)
+        wpe = _pos_embed(cfg, cfg.max_len)
         h = wte(tokens) + wpe(jnp.arange(S)[None])
         h = Backbone(cfg, name="backbone")(h)
         # tied LM head; logits in f32 for a stable softmax-xent
@@ -251,12 +271,11 @@ class MaskedLM(nn.Module):
         assert not cfg.causal, "MaskedLM needs causal=False"
         B, S = tokens.shape
         wte = _embed(cfg, cfg.vocab_size, cfg.embed_dim, "wte", "vocab")
-        h = wte(tokens) + _embed(cfg, cfg.max_len, cfg.embed_dim, "wpe",
-                                 None)(jnp.arange(S)[None])
+        h = wte(tokens) + _pos_embed(cfg, cfg.max_len)(jnp.arange(S)[None])
         if cfg.use_token_types:
             if token_types is None:
                 token_types = jnp.zeros_like(tokens)
-            h = h + _embed(cfg, 2, cfg.embed_dim, "wtte", None)(token_types)
+            h = h + _pos_embed(cfg, 2, "wtte")(token_types)
         h = _layer_norm(cfg, "ln_emb")(h)
         h = Backbone(cfg, name="backbone")(h, mask=attention_mask)
         # MLM transform head (dense + gelu + LN), then tied decoder
@@ -303,8 +322,7 @@ class ViT(nn.Module):
         x = jnp.concatenate(
             [jnp.broadcast_to(cls, (B, 1, cfg.embed_dim)).astype(cfg.dtype),
              x], axis=1)
-        x = x + _embed(cfg, x.shape[1], cfg.embed_dim, "pos",
-                       None)(jnp.arange(x.shape[1])[None])
+        x = x + _pos_embed(cfg, x.shape[1], "pos")(jnp.arange(x.shape[1])[None])
         x = Backbone(cfg, name="backbone")(x)
         return _dense(self.num_classes, "head", ("embed", "vocab"),
                       jnp.float32)(x[:, 0].astype(jnp.float32))
@@ -323,7 +341,10 @@ def gpt2_config(size: str = "medium", **overrides) -> TransformerConfig:
         "test": (2, 4, 128),
     }[size]
     L, H, E = dims
-    base = dict(vocab_size=50257, max_len=1024, num_layers=L, num_heads=H,
+    # vocab padded 50257→50304 (a multiple of 128, Megatron-style): keeps
+    # the tied LM-head matmul MXU-aligned and the table divisible over
+    # tp×fsdp (sharding rule "vocab", parallel/sharding.py)
+    base = dict(vocab_size=50304, max_len=1024, num_layers=L, num_heads=H,
                 embed_dim=E, mlp_dim=4 * E, causal=True)
     base.update(overrides)
     return TransformerConfig(**base)
@@ -336,7 +357,8 @@ def bert_config(size: str = "large", **overrides) -> TransformerConfig:
         "test": (2, 4, 128),
     }[size]
     L, H, E = dims
-    base = dict(vocab_size=30522, max_len=512, num_layers=L, num_heads=H,
+    # vocab padded 30522→30592 (multiple of 128; same rationale as GPT-2)
+    base = dict(vocab_size=30592, max_len=512, num_layers=L, num_heads=H,
                 embed_dim=E, mlp_dim=4 * E, causal=False,
                 use_token_types=True)
     base.update(overrides)

@@ -41,12 +41,52 @@ DEFAULT_RULES: Tuple[Tuple[str, Any], ...] = (
     ("mlp", "tp"),
     ("heads", "tp"),
     ("kv", None),
-    ("vocab", "tp"),
+    # vocab shards over tp AND fsdp: embedding-table storage scales with
+    # both degrees while the embed dim stays replicated — an fsdp-sharded
+    # embed on the table forces the batch-sharded backward cotangent to
+    # reshard embed-wise (GSPMD involuntary full remat at the first block).
+    # Needs vocab divisible by tp*fsdp: model configs pad vocab to a
+    # multiple of 128 (Megatron-style), see models/transformer.py.
+    ("vocab", ("tp", "fsdp")),
     ("expert", "ep"),
     ("expert_mlp", "tp"),
     ("layers", "pp"),
     ("norm", None),
 )
+
+# ACTIVATION rules (flax nn.with_logical_constraint at residual-stream
+# boundaries, models/transformer.py): activations are batch-sharded over the
+# data axes with embed REPLICATED — fsdp shards parameter *storage* (the
+# "embed" param rule above), never the residual stream, and tp shards only
+# the inner heads/mlp dims. Without these constraints GSPMD is free to infer
+# a tp-sharded embed for some ops and a replicated embed for their
+# neighbors, and resolves the clash with "involuntary full rematerialization"
+# (a full allgather+reslice) in the layernorm backward.
+ACTIVATION_RULES: Tuple[Tuple[str, Any], ...] = (
+    ("batch", ("dcn", "dp", "fsdp")),
+    ("seq", "sp"),
+    ("embed", None),
+    ("heads", "tp"),
+    ("kv", None),
+    ("mlp", "tp"),
+    ("vocab", "tp"),
+)
+
+
+def activation_rules_scope(mesh: Mesh):
+    """Context under which the model's nn.with_logical_constraint calls
+    resolve: the mesh set as the ambient device context + ACTIVATION_RULES
+    as the flax logical-axis table. Trainers enter this around jitted-step
+    calls; outside it the constraints are no-ops (tests calling
+    model.apply directly are unaffected)."""
+    import contextlib
+
+    stack = contextlib.ExitStack()
+    # the legacy Mesh context (resource env): what flax's
+    # with_logical_constraint needs to resolve bare PartitionSpecs
+    stack.enter_context(mesh)
+    stack.enter_context(nn.logical_axis_rules(ACTIVATION_RULES))
+    return stack
 
 
 def logical_to_spec(logical_axes: Sequence[Optional[str]],

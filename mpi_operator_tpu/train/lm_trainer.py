@@ -26,7 +26,7 @@ from flax import struct
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..parallel.mesh import batch_spec
-from ..parallel.sharding import shard_init
+from ..parallel.sharding import activation_rules_scope, shard_init
 
 
 class LMTrainState(struct.PyTreeNode):
@@ -152,7 +152,12 @@ class LMTrainer:
         if mask is None:
             mask = jnp.ones_like(targets, jnp.float32)
         mask = mask.astype(jnp.float32)
-        return self.compile_step()(state, tokens, targets, mask)
+        # activation_rules_scope makes the model's residual-stream
+        # constraints live during tracing (first call compiles); they pin
+        # activations to batch-sharded/embed-replicated so GSPMD never pays
+        # an involuntary full remat reconciling inferred layouts
+        with activation_rules_scope(self.mesh):
+            return self.compile_step()(state, tokens, targets, mask)
 
     def benchmark(self, state, dataset, num_steps: int = 50,
                   warmup_steps: int = 5, log: Callable[[str], None] = print,

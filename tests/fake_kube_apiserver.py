@@ -243,6 +243,13 @@ class _Handler(BaseHTTPRequestHandler):
             if old is None:
                 self._status(404, "NotFound", f"{g['name']!r} not found")
                 return
+            sent_rv = (body.get("metadata") or {}).get("resourceVersion")
+            if sent_rv and sent_rv != old["metadata"]["resourceVersion"]:
+                # optimistic concurrency, like a real apiserver: a stale
+                # resourceVersion is rejected, the client must re-read
+                self._status(409, "Conflict",
+                             f"resourceVersion {sent_rv} is stale")
+                return
             st.rv += 1
             if g["sub"] == "status":
                 # status subresource: only .status changes
