@@ -22,10 +22,11 @@ REFERENCE_PER_DEVICE_IPS = 132.1      # ref README.md:113-125
 
 def main() -> None:
     parser = argparse.ArgumentParser()
-    parser.add_argument("--workload", default="resnet",
-                        choices=["resnet", "gpt2", "bert", "vit"],
-                        help="resnet = the reference's headline benchmark; "
-                             "gpt2/bert/vit = the BASELINE ladder")
+    parser.add_argument("--workload", default="all",
+                        choices=["all", "resnet", "gpt2", "bert", "vit"],
+                        help="all = resnet headline + gpt2 secondary (the "
+                             "driver default); gpt2/bert/vit = the BASELINE "
+                             "ladder individually")
     parser.add_argument("--model", default="resnet101")
     parser.add_argument("--batch-per-device", type=int, default=64)
     parser.add_argument("--steps", type=int, default=100)     # ref README.md:89
@@ -49,20 +50,35 @@ def main() -> None:
         args.warmup = 1
         args.image_size = 64
 
-    if args.workload in ("gpt2", "bert"):
+    def run_lm(workload, steps, warmup, batch=None):
         from mpi_operator_tpu.examples.lm_benchmark import run_lm_benchmark
         size = "test" if args.smoke else None
         _state, metrics = run_lm_benchmark(
-            workload=args.workload, size=size,
-            batch_per_device=2 if args.smoke else args.batch_per_device,
+            workload=workload, size=size,
+            batch_per_device=2 if args.smoke else (batch or 8),
             seq_len=32 if args.smoke else 512,
-            num_steps=args.steps, warmup_steps=args.warmup,
+            num_steps=steps, warmup_steps=warmup,
             dtype_name=args.dtype, log=lambda s: print(s, file=sys.stderr))
+        return metrics
+
+    def mfu_fields(metrics):
+        out = {}
+        if metrics.get("mfu") is not None:
+            out["mfu"] = round(metrics["mfu"], 4)
+        if metrics.get("tflops_per_sec_per_device") is not None:
+            out["tflops_per_sec_per_device"] = round(
+                metrics["tflops_per_sec_per_device"], 2)
+        return out
+
+    if args.workload in ("gpt2", "bert"):
+        metrics = run_lm(args.workload, args.steps, args.warmup,
+                         args.batch_per_device)
         print(json.dumps({
             "metric": f"{args.workload}_tokens_per_sec",
             "value": round(metrics["tokens_per_sec"], 0),
             "unit": "tokens/sec",
             "vs_baseline": 0.0,     # reference publishes no LM numbers
+            **mfu_fields(metrics),
         }))
         return
     if args.workload == "vit":
@@ -78,6 +94,7 @@ def main() -> None:
             "value": round(metrics["images_per_sec"], 2),
             "unit": "images/sec",
             "vs_baseline": 0.0,     # reference publishes no ViT numbers
+            **mfu_fields(metrics),
         }))
         return
 
@@ -98,12 +115,27 @@ def main() -> None:
         log=lambda s: print(s, file=sys.stderr))
 
     per_device = metrics["images_per_sec_per_device"]
-    print(json.dumps({
+    line = {
         "metric": f"{args.model}_images_per_sec_per_device",
         "value": round(per_device, 2),
         "unit": "images/sec",
         "vs_baseline": round(per_device / REFERENCE_PER_DEVICE_IPS, 3),
-    }))
+        **mfu_fields(metrics),
+    }
+    if args.workload == "all":
+        # secondary line item: the GPT-2 ladder entry (BASELINE configs[3]),
+        # folded into the single JSON line the driver records. Best-effort:
+        # a failure here (OOM on a small chip, compile error) must not
+        # discard the already-measured resnet headline number.
+        try:
+            gm = run_lm("gpt2", steps=min(args.steps, 30),
+                        warmup=min(args.warmup, 3))
+            line["gpt2_tokens_per_sec"] = round(gm["tokens_per_sec"], 0)
+            line.update({f"gpt2_{k}": v for k, v in mfu_fields(gm).items()})
+        except Exception as exc:  # noqa: BLE001
+            print(f"# gpt2 secondary bench failed: {exc!r}", file=sys.stderr)
+            line["gpt2_error"] = type(exc).__name__
+    print(json.dumps(line))
 
 
 if __name__ == "__main__":

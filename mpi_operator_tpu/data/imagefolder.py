@@ -55,8 +55,18 @@ class NpyImageDataset:
         self._shards = discover_shards(data_dir)
         # fail fast instead of a silent empty-queue hang: at least one shard
         # must be able to cut a full batch (mmap header read only)
-        max_rows = max(
-            np.load(img, mmap_mode="r").shape[0] for img, _ in self._shards)
+        max_rows = 0
+        for img, _ in self._shards:
+            arr = np.load(img, mmap_mode="r")   # header read only
+            max_rows = max(max_rows, arr.shape[0])
+            # every shard must match the requested resolution, or throughput
+            # numbers would be silently mislabeled (trained at shard
+            # resolution while the banner reports --image-size)
+            if arr.ndim != 4 or arr.shape[1:3] != (image_size, image_size):
+                raise ValueError(
+                    f"shard {img!r} has image shape {arr.shape[1:]} but "
+                    f"--image-size is {image_size}; re-export the shards "
+                    f"or pass the matching --image-size")
         if max_rows < batch_size:
             raise ValueError(
                 f"every shard is smaller ({max_rows} rows) than the batch "

@@ -92,14 +92,21 @@ def run_lm_benchmark(
             self._rng, sub = jax.random.split(self._rng)
             toks, tgts = synthetic_token_batch(sub, global_batch, seq_len,
                                                cfg_vocab)
+            if masked:
+                # real MLM objective: targets are the ORIGINAL tokens at the
+                # masked positions and the input is corrupted there with the
+                # mask id (last vocab slot) — without the corruption the
+                # 'loss' is a degenerate copy objective
+                self._rng, msub = jax.random.split(self._rng)
+                mask = jax.random.uniform(msub, toks.shape) < 0.15
+                tgts = toks
+                toks = jnp.where(mask, cfg_vocab - 1, toks)
+                return (jax.device_put(toks, trainer.batch_sharding),
+                        jax.device_put(tgts, trainer.batch_sharding),
+                        jax.device_put(mask.astype(jnp.float32),
+                                       trainer.batch_sharding))
             toks = jax.device_put(toks, trainer.batch_sharding)
             tgts = jax.device_put(tgts, trainer.batch_sharding)
-            if masked:
-                # BERT: score a 15% random slot mask
-                self._rng, msub = jax.random.split(self._rng)
-                mask = (jax.random.uniform(msub, tgts.shape) < 0.15)
-                return toks, tgts, jax.device_put(
-                    mask.astype(jnp.float32), trainer.batch_sharding)
             return toks, tgts
 
         def close(self):

@@ -75,6 +75,7 @@ class ResNet(nn.Module):
     num_filters: int = 64
     dtype: Any = jnp.bfloat16          # MXU-friendly compute dtype
     act: Callable = nn.relu
+    arch: str = ""                     # e.g. "resnet101"; analytic-FLOPs key
 
     @nn.compact
     def __call__(self, x, train: bool = True):
@@ -127,7 +128,7 @@ def create_model(name: str, num_classes: int = 1000, dtype=jnp.bfloat16,
                  **kw) -> nn.Module:
     if name not in MODELS:
         raise ValueError(f"unknown resnet {name!r}; have {sorted(MODELS)}")
-    return MODELS[name](num_classes=num_classes, dtype=dtype, **kw)
+    return MODELS[name](num_classes=num_classes, dtype=dtype, arch=name, **kw)
 
 
 __all__ = ["ResNet", "ResNet18", "ResNet34", "ResNet50", "ResNet101",

@@ -115,23 +115,31 @@ def _attend(q, k, v, mask, cfg: TransformerConfig):
     """Dispatch to the configured attention implementation.
     q/k/v: [B, S, H, D]; returns [B, S, H, D].
 
-    A padding `mask` forces the dense path: neither the flash kernel nor the
-    ring schedule implements key-padding masks yet, and silently ignoring
-    the mask would attend to padding (wrong logits, no error)."""
+    A key-padding `mask` ([B, S] valid-token) is first-class in the flash
+    kernel (ops/attention.py); the ring schedule doesn't implement it, so
+    masked ring requests fall back to dense rather than silently attending
+    to padding."""
     impl = cfg.attention
     if impl == "auto":
         # flash kernel only on TPU; dense elsewhere (CPU tests/simulation)
         impl = "flash" if jax.default_backend() == "tpu" else "dense"
-    if mask is not None:
+    if mask is not None and impl == "ring":
         impl = "dense"
     if impl == "flash":
         from ..ops.attention import flash_attention
-        return flash_attention(q, k, v, causal=cfg.causal)
+        return flash_attention(q, k, v, causal=cfg.causal, mask=mask)
     if impl == "ring":
         from ..parallel.ring_attention import ring_attention_inner
         # inside shard_map the seq dim is already the local shard
-        return ring_attention_inner(q, k, v, axis_name="sp",
-                                    causal=cfg.causal)
+        try:
+            return ring_attention_inner(q, k, v, axis_name="sp",
+                                        causal=cfg.causal)
+        except NameError as exc:
+            raise ValueError(
+                'attention="ring" requires execution inside shard_map/pmap '
+                'over an "sp" mesh axis (LMTrainer does not provide one); '
+                "use parallel.ring_attention(q, k, v, mesh) directly for "
+                "sequence-parallel long-context attention") from exc
     return dense_attention(q, k, v, mask=mask, causal=cfg.causal,
                            dtype=cfg.dtype)
 

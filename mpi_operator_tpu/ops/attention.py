@@ -1,24 +1,35 @@
-"""Flash attention — Pallas TPU kernel for the hot op.
+"""Flash attention — Pallas TPU kernels for the hot op, forward AND backward.
 
 The reference delegates all device compute to out-of-repo CUDA libraries
 (SURVEY.md §2.2); this is the TPU-native hot-path kernel built per
 /opt/skills/guides/pallas_guide.md: the attention score matrix never
-materializes in HBM. Grid = (batch×heads, q_blocks, k_blocks) with the
-k-block loop innermost; VMEM scratch carries the online-softmax state
-(running max m, running sum l, f32 accumulator) across k iterations, and the
-output block is written once on the last k step. Matmuls are MXU-shaped
-([block, head_dim] × [head_dim, block], preferred_element_type=f32);
-block sizes default to 128 lanes.
+materializes in HBM, in either direction.
 
-Causal jobs skip fully-masked k-blocks (predicated with @pl.when, so the
-MXU never sees them) and apply a triangular mask only on diagonal blocks.
+Layouts (all Mosaic-legal):
+  q/k/v/o        [BH, S, D]          blocks (1, block, D)
+  lse / delta    [BH, S, 128]        blocks (1, block_q, 128) — the row
+                 statistic broadcast across a 128-lane minor dim, the same
+                 trick jax's reference TPU kernel uses (Mosaic requires the
+                 last two block dims divisible by (8, 128) or equal to the
+                 array dims; a bare [BH, S] row vector can't block legally)
+  kv mask        [B, 8, S]           blocks (1, 8, block_k) — valid-key
+                 mask broadcast across a sublane dim; indexed b = bh // H
 
-Backward pass: custom_vjp with residuals (q, k, v, out, lse). Gradients are
-computed blockwise over k with `lax.scan` in plain JAX — the same
-flash recurrence (never materializing [S, S] for all heads at once), fused
-by XLA; a dedicated Pallas bwd kernel is a later optimization.
+Three kernels:
+  fwd   grid (BH, nq, nk), k innermost: online softmax in VMEM scratch
+        (running max m, running sum l, f32 accumulator), output + lse
+        written on the last k step. Causal jobs skip fully-masked k blocks
+        (@pl.when — the MXU never sees them).
+  dq    grid (BH, nq, nk), k innermost: dq accumulates in VMEM scratch,
+        ds = p * (dp - delta) recomputed blockwise from the lse residual.
+  dkv   grid (BH, nk, nq), q innermost: dk/dv accumulate in VMEM scratch;
+        causal jobs skip q blocks strictly above the diagonal.
 
-On CPU (tests, simulation) the identical kernel runs in interpret mode.
+Key-padding masks are first-class: `kv_mask` [B, S] (True = real token)
+masks score columns in all three kernels, so padded BERT batches keep the
+flash path instead of falling back to dense O(S²) (the round-1 gap).
+
+On CPU (tests, simulation) the identical kernels run in interpret mode.
 """
 from __future__ import annotations
 
@@ -27,20 +38,21 @@ from typing import Optional
 
 import jax
 import jax.numpy as jnp
-from jax import lax
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 NEG_INF = -1e30
+LANES = 128        # minor-dim width for row-statistic tensors
 
 
 # ---------------------------------------------------------------------------
-# Forward Pallas kernel
+# Forward
 # ---------------------------------------------------------------------------
 
-def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
-                      acc_ref, m_ref, l_ref, *, sm_scale: float,
-                      causal: bool, block_q: int, block_k: int):
+def _fwd_kernel(q_ref, k_ref, v_ref, mask_ref, o_ref, lse_ref,
+                acc_ref, m_ref, l_ref, *, sm_scale, causal,
+                block_q, block_k, num_heads):
+    del num_heads
     qi = pl.program_id(1)
     ki = pl.program_id(2)
     nk = pl.num_programs(2)
@@ -51,9 +63,8 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
         m_ref[:] = jnp.full_like(m_ref, NEG_INF)
         l_ref[:] = jnp.zeros_like(l_ref)
 
-    # causal: k-block strictly above the diagonal touches nothing
     run = True
-    if causal:
+    if causal:  # k-block strictly above the diagonal touches nothing
         run = ki * block_k <= qi * block_q + (block_q - 1)
 
     @pl.when(run)
@@ -65,12 +76,13 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * sm_scale
         if causal:
-            rows = jax.lax.broadcasted_iota(jnp.int32,
-                                            (block_q, block_k), 0)
-            cols = jax.lax.broadcasted_iota(jnp.int32,
-                                            (block_q, block_k), 1)
-            mask = (qi * block_q + rows) >= (ki * block_k + cols)
-            s = jnp.where(mask, s, NEG_INF)
+            rows = jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+            cols = jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+            s = jnp.where((qi * block_q + rows) >= (ki * block_k + cols),
+                          s, NEG_INF)
+        if mask_ref is not None:
+            valid = mask_ref[0, :1] > 0           # [1, block_k]
+            s = jnp.where(valid, s, NEG_INF)
 
         m_prev = m_ref[:, :1]                     # [block_q, 1]
         l_prev = l_ref[:, :1]
@@ -89,134 +101,325 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
     def _finalize():
         l = jnp.maximum(l_ref[:, :1], 1e-30)
         o_ref[0] = (acc_ref[:] / l).astype(o_ref.dtype)
-        lse_ref[0] = (m_ref[:, :1] + jnp.log(l))[:, 0]
+        lse_ref[0] = jnp.broadcast_to(m_ref[:, :1] + jnp.log(l),
+                                      (block_q, LANES))
 
 
-def _flash_fwd(q, k, v, sm_scale, causal, block_q, block_k, interpret):
-    """q/k/v: [BH, S, D] -> (out [BH, S, D], lse [BH, S])."""
+def _flash_fwd(q, k, v, kv_mask, sm_scale, causal, block_q, block_k,
+               num_heads, interpret):
+    """q/k/v: [BH, S, D]; kv_mask: [B, 8, S] f32 or None.
+    Returns (out [BH, S, D], lse [BH, S, LANES])."""
     BH, S, D = q.shape
-    nq = S // block_q
-    nk = S // block_k
-    grid = (BH, nq, nk)
+    grid = (BH, S // block_q, S // block_k)
     kern = functools.partial(
-        _flash_fwd_kernel, sm_scale=sm_scale, causal=causal,
-        block_q=block_q, block_k=block_k)
+        _fwd_kernel, sm_scale=sm_scale, causal=causal,
+        block_q=block_q, block_k=block_k, num_heads=num_heads)
+    H = num_heads
+    in_specs = [
+        pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0)),
+        pl.BlockSpec((1, block_k, D), lambda b, i, j: (b, j, 0)),
+        pl.BlockSpec((1, block_k, D), lambda b, i, j: (b, j, 0)),
+    ]
+    args = [q, k, v]
+    if kv_mask is not None:
+        in_specs.append(
+            pl.BlockSpec((1, 8, block_k), lambda b, i, j: (b // H, 0, j)))
+        args.append(kv_mask)
+    else:
+        def kern_nomask(q_ref, k_ref, v_ref, o_ref, lse_ref, *scratch,
+                        _inner=kern):
+            return _inner(q_ref, k_ref, v_ref, None, o_ref, lse_ref,
+                          *scratch)
+        kern = kern_nomask
     out, lse = pl.pallas_call(
         kern,
         grid=grid,
-        in_specs=[
-            pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, block_k, D), lambda b, i, j: (b, j, 0)),
-            pl.BlockSpec((1, block_k, D), lambda b, i, j: (b, j, 0)),
-        ],
+        in_specs=in_specs,
         out_specs=[
             pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, block_q), lambda b, i, j: (b, i)),
+            pl.BlockSpec((1, block_q, LANES), lambda b, i, j: (b, i, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((BH, S, D), q.dtype),
-            jax.ShapeDtypeStruct((BH, S), jnp.float32),
+            jax.ShapeDtypeStruct((BH, S, LANES), jnp.float32),
         ],
         scratch_shapes=[
-            pltpu.VMEM((block_q, D), jnp.float32),     # acc
-            pltpu.VMEM((block_q, 128), jnp.float32),   # running max m
-            pltpu.VMEM((block_q, 128), jnp.float32),   # running sum l
+            pltpu.VMEM((block_q, D), jnp.float32),      # acc
+            pltpu.VMEM((block_q, LANES), jnp.float32),  # running max m
+            pltpu.VMEM((block_q, LANES), jnp.float32),  # running sum l
         ],
         interpret=interpret,
-    )(q, k, v)
+    )(*args)
     return out, lse
 
 
 # ---------------------------------------------------------------------------
-# Backward (blockwise flash recurrence, plain JAX + lax.scan)
+# Backward: dq kernel (grid over q blocks, k innermost)
 # ---------------------------------------------------------------------------
 
-def _flash_bwd(sm_scale, causal, block_q, block_k, interpret, res, do):
-    q, k, v, out, lse = res
+def _masked_p(s, lse_blk, causal, qi, ki, block_q, block_k, mask_ref):
+    """p = exp(s - lse) with explicit re-masking: fully-masked rows have a
+    degenerate lse, so a bare exp would resurrect masked positions."""
+    masked = s > NEG_INF / 2
+    if causal:
+        rows = jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+        cols = jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        masked = jnp.logical_and(
+            masked, (qi * block_q + rows) >= (ki * block_k + cols))
+        s = jnp.where(masked, s, NEG_INF)
+    if mask_ref is not None:
+        valid = mask_ref[0, :1] > 0
+        masked = jnp.logical_and(masked, valid)
+        s = jnp.where(masked, s, NEG_INF)
+    p = jnp.where(masked, jnp.exp(s - lse_blk), 0.0)
+    return p
+
+
+def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, mask_ref,
+               dq_ref, dq_acc, *, sm_scale, causal, block_q, block_k,
+               num_heads):
+    del num_heads
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+    nk = pl.num_programs(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        dq_acc[:] = jnp.zeros_like(dq_acc)
+
+    run = True
+    if causal:
+        run = ki * block_k <= qi * block_q + (block_q - 1)
+
+    @pl.when(run)
+    def _accumulate():
+        q = q_ref[0]
+        k = k_ref[0]
+        v = v_ref[0]
+        do = do_ref[0].astype(jnp.float32)
+        lse_blk = lse_ref[0, :, :1]               # [block_q, 1]
+        delta_blk = delta_ref[0, :, :1]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * sm_scale
+        p = _masked_p(s, lse_blk, causal, qi, ki, block_q, block_k, mask_ref)
+        dp = jax.lax.dot_general(
+            do, v.astype(jnp.float32), (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        ds = p * (dp - delta_blk) * sm_scale      # [block_q, block_k]
+        dq_acc[:] += jax.lax.dot_general(
+            ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(ki == nk - 1)
+    def _finalize():
+        dq_ref[0] = dq_acc[:].astype(dq_ref.dtype)
+
+
+# ---------------------------------------------------------------------------
+# Backward: dk/dv kernel (grid over k blocks, q innermost)
+# ---------------------------------------------------------------------------
+
+def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, mask_ref,
+                dk_ref, dv_ref, dk_acc, dv_acc, *, sm_scale, causal,
+                block_q, block_k, num_heads):
+    del num_heads
+    ki = pl.program_id(1)
+    qi = pl.program_id(2)
+    nq = pl.num_programs(2)
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_acc[:] = jnp.zeros_like(dk_acc)
+        dv_acc[:] = jnp.zeros_like(dv_acc)
+
+    run = True
+    if causal:  # q blocks strictly above the diagonal see nothing of this k
+        run = ki * block_k <= qi * block_q + (block_q - 1)
+
+    @pl.when(run)
+    def _accumulate():
+        q = q_ref[0]
+        k = k_ref[0]
+        v = v_ref[0]
+        do = do_ref[0].astype(jnp.float32)
+        lse_blk = lse_ref[0, :, :1]
+        delta_blk = delta_ref[0, :, :1]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * sm_scale
+        p = _masked_p(s, lse_blk, causal, qi, ki, block_q, block_k, mask_ref)
+        # dv += pᵀ @ do
+        dv_acc[:] += jax.lax.dot_general(
+            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(
+            do, v.astype(jnp.float32), (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        ds = p * (dp - delta_blk) * sm_scale
+        # dk += dsᵀ @ q
+        dk_acc[:] += jax.lax.dot_general(
+            ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(qi == nq - 1)
+    def _finalize():
+        dk_ref[0] = dk_acc[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc[:].astype(dv_ref.dtype)
+
+
+def _flash_bwd(sm_scale, causal, block_q, block_k, num_heads, interpret,
+               res, do):
+    q, k, v, out, lse, kv_mask = res
     BH, S, D = q.shape
-    qf = q.astype(jnp.float32)
-    kf = k.astype(jnp.float32)
-    vf = v.astype(jnp.float32)
-    dof = do.astype(jnp.float32)
-    # D_i = rowsum(dO * O)
-    delta = jnp.sum(dof * out.astype(jnp.float32), axis=-1)     # [BH, S]
+    H = num_heads
+    # the residual lse is stored [BH, S] (one scalar per row); re-broadcast
+    # to the Mosaic-legal 128-lane layout only for the kernels' lifetime
+    lse = jnp.broadcast_to(lse[..., None], (BH, S, LANES))
+    # delta = rowsum(dO ∘ O), lane-broadcast like lse
+    delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32),
+                    axis=-1)
+    delta = jnp.broadcast_to(delta[..., None], (BH, S, LANES))
 
-    nk = S // block_k
-    ks = kf.reshape(BH, nk, block_k, D).transpose(1, 0, 2, 3)
-    vs = vf.reshape(BH, nk, block_k, D).transpose(1, 0, 2, 3)
+    lm_spec_q = pl.BlockSpec((1, block_q, LANES), lambda b, i, j: (b, i, 0))
 
-    rows = jnp.arange(S)
+    common = dict(sm_scale=sm_scale, causal=causal, block_q=block_q,
+                  block_k=block_k, num_heads=num_heads)
 
-    def kblock(dq, blk):
-        j, k_j, v_j = blk
-        cols = j * block_k + jnp.arange(block_k)
-        s = jnp.einsum("bqd,bkd->bqk", qf, k_j) * sm_scale
-        if causal:
-            mask = rows[:, None] >= cols[None, :]
-            s = jnp.where(mask[None], s, NEG_INF)
-        p = jnp.exp(s - lse[..., None])                          # [BH,S,bk]
-        dp = jnp.einsum("bqd,bkd->bqk", dof, v_j)
-        ds = p * (dp - delta[..., None]) * sm_scale
-        dq = dq + jnp.einsum("bqk,bkd->bqd", ds, k_j)
-        dk_j = jnp.einsum("bqk,bqd->bkd", ds, qf)
-        dv_j = jnp.einsum("bqk,bqd->bkd", p, dof)
-        return dq, (dk_j, dv_j)
+    # --- dq: grid (BH, nq, nk) -------------------------------------------
+    dq_in_specs = [
+        pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0)),   # q
+        pl.BlockSpec((1, block_k, D), lambda b, i, j: (b, j, 0)),   # k
+        pl.BlockSpec((1, block_k, D), lambda b, i, j: (b, j, 0)),   # v
+        pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0)),   # do
+        lm_spec_q,                                                  # lse
+        lm_spec_q,                                                  # delta
+    ]
+    dq_args = [q, k, v, do, lse, delta]
+    dq_kern = functools.partial(_dq_kernel, **common)
+    if kv_mask is not None:
+        dq_in_specs.append(
+            pl.BlockSpec((1, 8, block_k), lambda b, i, j: (b // H, 0, j)))
+        dq_args.append(kv_mask)
+    else:
+        inner_dq = dq_kern
 
-    dq0 = jnp.zeros_like(qf)
-    dq, (dk_blocks, dv_blocks) = lax.scan(
-        kblock, dq0, (jnp.arange(nk), ks, vs))
-    dk = dk_blocks.transpose(1, 0, 2, 3).reshape(BH, S, D)
-    dv = dv_blocks.transpose(1, 0, 2, 3).reshape(BH, S, D)
-    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+        def dq_kern(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                    dq_ref, dq_acc, _inner=inner_dq):
+            return _inner(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                          None, dq_ref, dq_acc)
+    dq = pl.pallas_call(
+        dq_kern,
+        grid=(BH, S // block_q, S // block_k),
+        in_specs=dq_in_specs,
+        out_specs=pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((BH, S, D), q.dtype),
+        scratch_shapes=[pltpu.VMEM((block_q, D), jnp.float32)],
+        interpret=interpret,
+    )(*dq_args)
+
+    # --- dk/dv: grid (BH, nk, nq) ----------------------------------------
+    dkv_in_specs = [
+        pl.BlockSpec((1, block_q, D), lambda b, j, i: (b, i, 0)),   # q
+        pl.BlockSpec((1, block_k, D), lambda b, j, i: (b, j, 0)),   # k
+        pl.BlockSpec((1, block_k, D), lambda b, j, i: (b, j, 0)),   # v
+        pl.BlockSpec((1, block_q, D), lambda b, j, i: (b, i, 0)),   # do
+        pl.BlockSpec((1, block_q, LANES), lambda b, j, i: (b, i, 0)),  # lse
+        pl.BlockSpec((1, block_q, LANES), lambda b, j, i: (b, i, 0)),  # delta
+    ]
+    dkv_args = [q, k, v, do, lse, delta]
+    dkv_kern = functools.partial(_dkv_kernel, **common)
+    if kv_mask is not None:
+        dkv_in_specs.append(
+            pl.BlockSpec((1, 8, block_k), lambda b, j, i: (b // H, 0, j)))
+        dkv_args.append(kv_mask)
+    else:
+        inner_dkv = dkv_kern
+
+        def dkv_kern(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                     dk_ref, dv_ref, dk_acc, dv_acc, _inner=inner_dkv):
+            return _inner(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                          None, dk_ref, dv_ref, dk_acc, dv_acc)
+    dk, dv = pl.pallas_call(
+        dkv_kern,
+        grid=(BH, S // block_k, S // block_q),
+        in_specs=dkv_in_specs,
+        out_specs=[
+            pl.BlockSpec((1, block_k, D), lambda b, j, i: (b, j, 0)),
+            pl.BlockSpec((1, block_k, D), lambda b, j, i: (b, j, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((BH, S, D), k.dtype),
+            jax.ShapeDtypeStruct((BH, S, D), v.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_k, D), jnp.float32),
+            pltpu.VMEM((block_k, D), jnp.float32),
+        ],
+        interpret=interpret,
+    )(*dkv_args)
+    dmask = None if kv_mask is None else jnp.zeros_like(kv_mask)
+    return dq, dk, dv, dmask
 
 
 # ---------------------------------------------------------------------------
-# Public API
+# custom_vjp plumbing
 # ---------------------------------------------------------------------------
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
-def _flash_core(q, k, v, sm_scale, causal, block_q, block_k, interpret):
-    out, _ = _flash_fwd(q, k, v, sm_scale, causal, block_q, block_k,
-                        interpret)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8, 9))
+def _flash_core(q, k, v, kv_mask, sm_scale, causal, block_q, block_k,
+                num_heads, interpret):
+    out, _ = _flash_fwd(q, k, v, kv_mask, sm_scale, causal, block_q,
+                        block_k, num_heads, interpret)
     return out
 
 
-def _flash_core_fwd(q, k, v, sm_scale, causal, block_q, block_k, interpret):
-    out, lse = _flash_fwd(q, k, v, sm_scale, causal, block_q, block_k,
-                          interpret)
-    return out, (q, k, v, out, lse)
+def _flash_core_fwd(q, k, v, kv_mask, sm_scale, causal, block_q, block_k,
+                    num_heads, interpret):
+    out, lse = _flash_fwd(q, k, v, kv_mask, sm_scale, causal, block_q,
+                          block_k, num_heads, interpret)
+    # keep only one lane of the [BH, S, LANES] lse as the fwd→bwd residual
+    # (the broadcast layout is a kernel-interface artifact; holding it in
+    # HBM across the whole backward would cost 128× the needed bytes)
+    return out, (q, k, v, out, lse[..., 0], kv_mask)
 
 
 _flash_core.defvjp(_flash_core_fwd, _flash_bwd)
 
 
 def flash_attention(q, k, v, causal: bool = True,
+                    mask=None,
                     block_q: int = 128, block_k: int = 128,
                     interpret: Optional[bool] = None):
     """Flash attention over [B, S, H, D] tensors (layout matches
-    models.transformer). Falls back to dense attention when S doesn't tile.
+    models.transformer). `mask`: optional [B, S] valid-key mask (True =
+    attend), the BERT padding mask. Falls back to dense attention when S
+    doesn't tile into Mosaic-legal blocks.
     """
     B, S, H, D = q.shape
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
     block_q = min(block_q, S)
     block_k = min(block_k, S)
-    # Fallback to dense when S doesn't tile — and, on real hardware, when
-    # blocks aren't sublane-aligned (Mosaic pads the 128-lane minor dim
-    # itself — validated on v5e with D=64/bf16 — but sub-8 sublane blocks
-    # are not guaranteed to lower; interpret mode has no constraint).
     unaligned = (S % block_q or S % block_k
                  or (not interpret and (block_q % 8 or block_k % 8)))
     if unaligned:
         from ..models.transformer import dense_attention
-        return dense_attention(q, k, v, causal=causal, dtype=q.dtype)
+        return dense_attention(q, k, v, mask=mask, causal=causal,
+                               dtype=q.dtype)
 
     def to_bh(x):
         return x.transpose(0, 2, 1, 3).reshape(B * H, S, D)
 
+    kv_mask = None
+    if mask is not None:
+        # sublane-broadcast [B, 8, S] f32 (Mosaic-legal 2D mask blocks)
+        kv_mask = jnp.broadcast_to(
+            mask.astype(jnp.float32)[:, None, :], (B, 8, S))
+
     sm_scale = 1.0 / (D ** 0.5)
-    out = _flash_core(to_bh(q), to_bh(k), to_bh(v), sm_scale, causal,
-                      block_q, block_k, interpret)
+    out = _flash_core(to_bh(q), to_bh(k), to_bh(v), kv_mask, sm_scale,
+                      causal, block_q, block_k, H, interpret)
     return out.reshape(B, H, S, D).transpose(0, 2, 1, 3)
 
 

@@ -27,6 +27,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..parallel.mesh import batch_spec
 from ..parallel.sharding import activation_rules_scope, shard_init
+from ..utils import flops
 
 
 class LMTrainState(struct.PyTreeNode):
@@ -159,6 +160,33 @@ class LMTrainer:
         with activation_rules_scope(self.mesh):
             return self.compile_step()(state, tokens, targets, mask)
 
+    def _step_flops(self, state, probe) -> Optional[float]:
+        """GLOBAL model FLOPs for one train step. Analytic 6N+attention is
+        primary (the conventional MFU numerator; XLA's cost model scores
+        Pallas custom calls as 0 FLOPs, so it blind-spots the flash
+        attention share); per-device cost model × mesh size is the
+        fallback for models without a config."""
+        mcfg = getattr(self.model, "config", None)
+        if mcfg is not None:
+            per_token = flops.transformer_train_flops_per_token(
+                flops.param_count(state.params), mcfg.num_layers,
+                mcfg.embed_dim, self.config.seq_len, causal=mcfg.causal)
+            return (per_token * self.config.global_batch_size
+                    * self.config.seq_len)
+        batch = tuple(probe)
+        if len(batch) == 2:
+            batch = (*batch, jnp.ones_like(batch[1], jnp.float32))
+        else:
+            batch = (*batch[:2], batch[2].astype(jnp.float32))
+        try:
+            with activation_rules_scope(self.mesh):
+                compiled = self.compile_step().lower(state, *batch).compile()
+            counted = flops.compiled_flops(compiled)
+        except Exception:  # noqa: BLE001 — cost model is best-effort
+            counted = None
+        # cost analysis sees the post-SPMD-partition (per-device) module
+        return counted * self.mesh.size if counted is not None else None
+
     def benchmark(self, state, dataset, num_steps: int = 50,
                   warmup_steps: int = 5, log: Callable[[str], None] = print,
                   ) -> Tuple[LMTrainState, Dict[str, float]]:
@@ -166,11 +194,13 @@ class LMTrainer:
         train.trainer.Trainer.benchmark (ref README.md:113-131 format)."""
         cfg = self.config
         it = iter(dataset)
-        for _ in range(warmup_steps):
+        probe = next(it)
+        state, metrics = self.train_step(state, *probe)   # compiles
+        flops_per_step = self._step_flops(state, probe)
+        for _ in range(max(0, warmup_steps - 1)):
             batch = next(it)
             state, metrics = self.train_step(state, *batch)
-        if warmup_steps:
-            float(metrics["loss"])
+        float(metrics["loss"])
         tokens_per_step = cfg.global_batch_size * cfg.seq_len
         log_every = max(1, min(cfg.log_every, num_steps))
         windows = []
@@ -188,14 +218,21 @@ class LMTrainer:
                 t0 = time.perf_counter()
         steady = windows[1:] if len(windows) > 1 else windows
         tps = sum(steady) / len(steady)
+        n = self.mesh.size
+        stats = flops.throughput_stats(flops_per_step,
+                                       tps / tokens_per_step, n)
         log("-" * 40)
         log(f"total tokens/sec: {tps:.0f}")
+        if stats["mfu"] is not None:
+            log(f"per-device: {stats['tflops_per_sec_per_device']:.1f} "
+                f"TFLOP/s, MFU {stats['mfu']:.1%}")
         log("-" * 40)
         return state, {
             "tokens_per_sec": tps,
-            "tokens_per_sec_per_device": tps / self.mesh.size,
+            "tokens_per_sec_per_device": tps / n,
             "wall_seconds": time.perf_counter() - wall0,
             "final_loss": float(metrics["loss"]),
+            **stats,
         }
 
 

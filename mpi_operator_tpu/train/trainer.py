@@ -27,6 +27,7 @@ from flax.core import FrozenDict
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..parallel.mesh import batch_spec
+from ..utils import flops
 
 
 class TrainState(struct.PyTreeNode):
@@ -170,11 +171,20 @@ class Trainer:
         step_fn = self.compile_step(state)
         it = iter(dataset)
         log_every = max(1, min(self.config.log_every, num_steps))
-        for _ in range(warmup_steps):
+        # XLA's cost model for the exact executable (hits the compile
+        # cache — same shapes as the benchmark steps), for MFU reporting.
+        # The analysis sees the post-SPMD-partition module, so the count
+        # is per device; scale to a global figure.
+        probe = next(it)
+        flops_per_step = flops.compiled_flops(
+            step_fn.lower(state, *probe).compile())
+        if flops_per_step is not None:
+            flops_per_step *= self.mesh.size
+        state, metrics = step_fn(state, *probe)
+        for _ in range(max(0, warmup_steps - 1)):
             images, labels = next(it)
             state, metrics = step_fn(state, images, labels)
-        if warmup_steps > 0:
-            float(metrics["loss"])   # true barrier (see docstring)
+        float(metrics["loss"])       # true barrier (see docstring)
 
         window_ips = []
         wall0 = time.perf_counter()
@@ -195,15 +205,28 @@ class Trainer:
         wall = time.perf_counter() - wall0
         steady = window_ips[1:] if len(window_ips) > 1 else window_ips
         total_ips = sum(steady) / len(steady)
+        n = self.mesh.size
+        if flops_per_step is None:
+            per_image = flops.resnet_train_flops_per_image(
+                getattr(self.model, "arch", "") or "",
+                self.config.image_size)
+            flops_per_step = (per_image * self.config.global_batch_size
+                              if per_image else None)
+        stats = flops.throughput_stats(
+            flops_per_step, total_ips / self.config.global_batch_size, n)
         log("-" * 40)
         log(f"total images/sec: {total_ips:.2f}")   # ref README.md:127-131
+        if stats["mfu"] is not None:
+            log(f"per-device: {stats['tflops_per_sec_per_device']:.1f} "
+                f"TFLOP/s, MFU {stats['mfu']:.1%}")
         log("-" * 40)
         return state, {
             "images_per_sec": total_ips,
-            "images_per_sec_per_device": total_ips / self.mesh.size,
+            "images_per_sec_per_device": total_ips / n,
             "steps": num_steps,
             "wall_seconds": wall,
             "final_loss": final_loss,
+            **stats,
         }
 
 

@@ -125,9 +125,10 @@ def test_gradients_flow():
     assert sum(n > 0 for n in norms) > len(norms) // 2
 
 
-def test_padding_mask_forces_dense_path(monkeypatch):
-    """A padding mask must never be silently dropped: flash/ring configs
-    fall back to dense when a mask is present."""
+def test_padding_mask_keeps_flash_path(monkeypatch):
+    """A padding mask must never be silently dropped: the flash kernel now
+    takes the mask first-class (ops/attention.py kv_mask), so a masked BERT
+    batch keeps the flash path; only ring (no mask support) falls back."""
     from mpi_operator_tpu.models import transformer as tr
 
     cfg = tr.bert_config("test", attention="flash", dtype=jnp.float32,
@@ -136,17 +137,22 @@ def test_padding_mask_forces_dense_path(monkeypatch):
     toks = jnp.zeros((1, 8), jnp.int32)
     vs = unboxed_init(model, jax.random.PRNGKey(0), toks)
 
-    called = {"flash": 0}
-    def boom(*a, **kw):
-        called["flash"] += 1
-        raise AssertionError("flash must not run with a mask")
+    seen = {}
+    def spy(q, k, v, causal=True, mask=None, **kw):
+        seen["mask"] = mask
+        return tr.dense_attention(q, k, v, mask=mask, causal=causal,
+                                  dtype=jnp.float32)
     import mpi_operator_tpu.ops.attention as opsattn
-    monkeypatch.setattr(opsattn, "flash_attention", boom)
+    monkeypatch.setattr(opsattn, "flash_attention", spy)
 
     mask = jnp.ones((1, 8), bool).at[:, 4:].set(False)
-    out = model.apply(vs, toks, attention_mask=mask)   # uses dense path
+    out = model.apply(vs, toks, attention_mask=mask)
     assert out.shape == (1, 8, 64)
-    # and with no mask the flash path IS selected (and our stub trips)
-    import pytest
-    with pytest.raises(AssertionError):
-        model.apply(vs, toks)
+    assert seen["mask"] is not None          # mask reached the kernel
+    # ring has no mask support: masked ring falls back to dense (no error
+    # even outside shard_map, because ring_attention_inner never runs)
+    ring_cfg = tr.bert_config("test", attention="ring", dtype=jnp.float32,
+                              vocab_size=64, max_len=32)
+    ring_model = tr.MaskedLM(ring_cfg)
+    out2 = ring_model.apply(vs, toks, attention_mask=mask)
+    assert out2.shape == (1, 8, 64)
