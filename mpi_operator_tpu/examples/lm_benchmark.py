@@ -45,6 +45,7 @@ def run_lm_benchmark(
     num_slices: int = 1,
     attention: str = "auto",
     remat: bool = False,
+    remat_policy: str = "none",
     train_dir: Optional[str] = None,
     log: Callable[[str], None] = print,
 ) -> Tuple[object, Dict[str, float]]:
@@ -64,7 +65,7 @@ def run_lm_benchmark(
 
     name = f"{workload}-{size}" if size else workload
     model = create_lm(name, dtype=dtype, attention=attention, remat=remat,
-                      max_len=max(seq_len, 32))
+                      remat_policy=remat_policy, max_len=max(seq_len, 32))
     cfg_vocab = model.config.vocab_size
     masked = workload == "bert"
 
@@ -188,6 +189,8 @@ def main(argv=None) -> int:
     parser.add_argument("--attention", default="auto",
                         choices=["auto", "dense", "flash"])
     parser.add_argument("--remat", action="store_true")
+    parser.add_argument("--remat-policy", default="none",
+                        choices=["none", "dots"])
     parser.add_argument("--train-dir", default=None)
     args = parser.parse_args(argv)
 
@@ -221,6 +224,7 @@ def main(argv=None) -> int:
                 warmup_steps=args.warmup_steps, dtype_name=args.dtype,
                 tp=args.tp, num_slices=info.num_slices,
                 attention=args.attention, remat=args.remat,
+                remat_policy=args.remat_policy,
                 train_dir=args.train_dir, log=log)
             headline = {"metric": f"{args.workload}_tokens_per_sec",
                         "value": round(metrics["tokens_per_sec"], 0),

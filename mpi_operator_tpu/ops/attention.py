@@ -389,7 +389,7 @@ _flash_core.defvjp(_flash_core_fwd, _flash_bwd)
 
 def flash_attention(q, k, v, causal: bool = True,
                     mask=None,
-                    block_q: int = 128, block_k: int = 128,
+                    block_q: int = 512, block_k: int = 512,
                     interpret: Optional[bool] = None):
     """Flash attention over [B, S, H, D] tensors (layout matches
     models.transformer). `mask`: optional [B, S] valid-key mask (True =
