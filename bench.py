@@ -27,10 +27,13 @@ REFERENCE_PER_DEVICE_IPS = 132.1      # ref README.md:113-125
 def main() -> None:
     parser = argparse.ArgumentParser()
     parser.add_argument("--workload", default="all",
-                        choices=["all", "resnet", "gpt2", "bert", "vit"],
+                        choices=["all", "resnet", "gpt2", "bert", "vit",
+                                 "allreduce"],
                         help="all = resnet headline + gpt2 secondary (the "
                              "driver default); gpt2/bert/vit = the BASELINE "
-                             "ladder individually")
+                             "ladder individually; allreduce = the scaling-"
+                             "efficiency microbenchmark (BASELINE ≥90% "
+                             "4→32)")
     parser.add_argument("--model", default="resnet101")
     # resnet default 256/device is the single-chip throughput sweet spot on
     # v5e (measured: 64→1377, 128→1408, 256→1612, 512→1442 img/s); the
@@ -93,6 +96,26 @@ def main() -> None:
             "unit": "tokens/sec",
             "vs_baseline": 0.0,     # reference publishes no LM numbers
             **mfu_fields(metrics),
+        }))
+        return
+    if args.workload == "allreduce":
+        from mpi_operator_tpu.examples.allreduce_bench import (
+            run_allreduce_benchmark)
+        result = run_allreduce_benchmark(
+            payload_mb=[0.25, 1.0] if args.smoke else [1.0, 16.0, 64.0],
+            iters=3 if args.smoke else 10,
+            log=lambda s: print(s, file=sys.stderr))
+        curve = result["efficiency_curve"]
+        # a single visible device measures no ring at all — report that
+        # honestly instead of fabricating a perfect score
+        worst = min(curve.values()) if curve else None
+        print(json.dumps({
+            "metric": "allreduce_scaling_efficiency",
+            "value": round(worst, 4) if worst is not None else None,
+            "unit": "fraction_of_smallest_ring_busbw",
+            "vs_baseline": (round(worst / 0.90, 3)       # BASELINE ≥90%
+                            if worst is not None else 0.0),
+            "efficiency_curve": curve or "insufficient devices (need >1)",
         }))
         return
     if args.workload == "vit":

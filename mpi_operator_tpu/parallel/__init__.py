@@ -8,5 +8,6 @@ from .sharding import (  # noqa: F401
     shard_init,
 )
 from .ring_attention import ring_attention, ring_attention_inner  # noqa: F401
-from .pipeline import pipeline_apply, stack_stage_params  # noqa: F401
+from .pipeline import (pipeline_apply, stack_stage_params, stack_lm_params,  # noqa: F401
+                       pipeline_lm_loss, bubble_fraction)
 from .moe import MoeMlp  # noqa: F401

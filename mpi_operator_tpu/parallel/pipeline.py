@@ -3,17 +3,29 @@
 Absent from the reference (SURVEY.md §2.3: PP — NO); first-class here.
 TPU-native shape: stage parameters are *stacked* on a leading axis that is
 sharded over `pp` (logical axis "layers" → pp, parallel/sharding.py), the
-whole schedule lives inside one `shard_map`, and inter-stage transfers are
-single-neighbor `lax.ppermute` hops — thin point-to-point traffic that rides
-one ICI link, which is why pp sits on the outer (slower) mesh dimension
-(parallel/mesh.py AXIS_ORDER).
+whole schedule lives inside one `shard_map`, and every inter-stage transfer
+is a single-neighbor `lax.ppermute` hop — thin point-to-point traffic that
+rides one ICI link, which is why pp sits on the outer (slower) mesh
+dimension (parallel/mesh.py AXIS_ORDER).
+
+Sharded streams, not replicated ones: the microbatched input lives
+pp-sharded (each stage owns M/P contiguous microbatches) and flows to
+stage 0 through a one-microbatch *relay register* that rotates one hop
+backward per tick — the microbatch consumed at tick t is injected by its
+owner stage exactly `owner` ticks early, so per-tick ICI traffic is one
+activation buffer forward + one input buffer backward, independent of M
+and P. Outputs are banked pp-sharded the same way (generic API: a forward
+relay returns each microbatch to its owner; LM API: only the last stage
+computes head+loss under `lax.cond`, so nothing bigger than a scalar needs
+collecting).
 
 Schedule: classic GPipe fill-drain over M microbatches and P stages
-(M + P - 1 ticks). Each tick every device runs its stage on its current
-activation and ppermutes the result one hop forward; autodiff through
-ppermute (its transpose is the reverse permute) gives the backward pipeline
-for free — no hand-written 1F1B needed for correctness, and XLA overlaps
-the permute with the next tick's compute.
+(M + P - 1 compute ticks; the generic API runs P - 1 extra drain ticks to
+relay the tail outputs home). Autodiff through ppermute (its transpose is
+the reverse permute) gives the backward pipeline for free — no hand-written
+1F1B needed for correctness, and XLA overlaps the permute with the next
+tick's compute. Per-stage activation residuals scale with M·L/P (each stage
+saves only its own layers' internals), which is the PP memory win.
 
 Bubble fraction is (P-1)/(M+P-1); callers pick M >= 4*P to keep it small.
 """
@@ -24,54 +36,110 @@ from typing import Any, Callable
 
 import jax
 import jax.numpy as jnp
+import optax
 from jax import lax
 from jax import shard_map
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.sharding import Mesh, PartitionSpec as P
 
 
-def _pipeline_local(stage_fn: Callable, stage_params: Any, x, *,
-                    axis_name: str, num_microbatches: int):
+def bubble_fraction(num_stages: int, num_microbatches: int) -> float:
+    """Idle fraction of the GPipe schedule: (P-1)/(M+P-1)."""
+    return (num_stages - 1) / (num_microbatches + num_stages - 1)
+
+
+def _fwd_perm(n):
+    return [(j, (j + 1) % n) for j in range(n)]
+
+
+def _bwd_perm(n):
+    return [(j, (j - 1) % n) for j in range(n)]
+
+
+def _vma_zero(tree, dtype):
+    """A zero scalar that inherits pp-variance from `tree` — fresh zeros
+    are 'unvarying' under shard_map's VMA typing while the loop writes
+    pp-varying values."""
+    return jax.tree.leaves(tree)[0].astype(dtype).sum() * 0
+
+
+def _inject_input(r, x_local, stage, tau, C, M):
+    """Relay-register refill. The microbatch consumed by stage 0 at tick
+    `tau+1+i` must sit in register i at the end of tick `tau`; its owner
+    (stage (tau+1+i)//C) writes it exactly then, and backward rotation
+    walks it one hop per tick so it reaches register 0 on time."""
+    m_next = tau + 1 + stage
+    own = (m_next // C == stage) & (m_next < M)
+    row = jnp.clip(m_next - stage * C, 0, C - 1)
+    fed = lax.dynamic_index_in_dim(x_local, row, 0, keepdims=False)
+    return jnp.where(own, fed, r)
+
+
+def _pipeline_local(stage_fn: Callable, axis_name: str, M: int,
+                    stage_params: Any, x_local):
     """Body inside shard_map. stage_params: this stage's shard (leading
-    stacked-layer dim already local). x: full [M, mb, ...] microbatched
-    input, replicated over pp. Returns [M, mb, ...] outputs (valid on the
-    last stage, broadcast to all)."""
+    stacked dim already local). x_local: [M/P, mb, ...] — this stage's
+    chunk of the microbatch stream. Returns [M/P, mb, ...] outputs (each
+    microbatch relayed back to the stage that owns its input chunk)."""
     n_stages = lax.axis_size(axis_name)
-    stage_id = lax.axis_index(axis_name)
-    M = num_microbatches
+    stage = lax.axis_index(axis_name)
+    C = M // n_stages
 
-    def tick(t, carry):
-        act, outputs = carry
-        # stage 0 ingests microbatch t (dummy past the end, masked later);
-        # other stages consume the activation handed over last tick.
-        mb_idx = jnp.clip(t, 0, M - 1)
-        fed = lax.dynamic_index_in_dim(x, mb_idx, axis=0, keepdims=False)
-        cur = jnp.where(stage_id == 0, fed, act)
+    def relay_out(o, bank, tau):
+        """Output relay: rotates forward every tick; each stage extracts
+        the value the schedule addresses to it — microbatch tau-P-i after
+        transit, or tau-P+1 on the last stage (extract-at-inject)."""
+        o = lax.ppermute(o, axis_name, _fwd_perm(n_stages))
+        m = jnp.where(stage == n_stages - 1, tau - n_stages + 1,
+                      tau - n_stages - stage)
+        extract = (m >= 0) & (m < M) & (m // C == stage)
+        row = jnp.clip(m - stage * C, 0, C - 1)
+        prev = lax.dynamic_index_in_dim(bank, row, 0, keepdims=False)
+        bank = lax.dynamic_update_index_in_dim(
+            bank, jnp.where(extract, o, prev), row, 0)
+        return o, bank
+
+    def tick(carry, tau):
+        r, act, o, bank = carry
+        # stage 0 ingests from its relay register; others consume the
+        # activation handed over last tick
+        cur = jnp.where(stage == 0, r, act)
         y = stage_fn(stage_params, cur)
-        # last stage banks microbatch t-(P-1) once the pipe is full
-        out_idx = jnp.clip(t - (n_stages - 1), 0, M - 1)
-        take = (stage_id == n_stages - 1) & (t >= n_stages - 1)
-        banked = lax.dynamic_index_in_dim(outputs, out_idx, axis=0,
-                                          keepdims=False)
-        outputs = lax.dynamic_update_index_in_dim(
-            outputs, jnp.where(take, y, banked), out_idx, axis=0)
         # hand activations one hop forward around the ring
-        perm = [(j, (j + 1) % n_stages) for j in range(n_stages)]
-        act = lax.ppermute(y, axis_name, perm)
-        return act, outputs
+        act = lax.ppermute(y, axis_name, _fwd_perm(n_stages))
+        o, bank = relay_out(o, bank, tau)
+        inject = (stage == n_stages - 1) & (tau >= n_stages - 1)
+        o = jnp.where(inject, y, o)
+        # re-extract on the last stage (its own value, freshly injected)
+        m_last = tau - n_stages + 1
+        take = inject & (m_last // C == stage)
+        row = jnp.clip(m_last - stage * C, 0, C - 1)
+        prev = lax.dynamic_index_in_dim(bank, row, 0, keepdims=False)
+        bank = lax.dynamic_update_index_in_dim(
+            bank, jnp.where(take, y, prev), row, 0)
+        # input relay: rotate one hop backward, then owners refill
+        r = lax.ppermute(r, axis_name, _bwd_perm(n_stages))
+        r = _inject_input(r, x_local, stage, tau, C, M)
+        return (r, act, o, bank), None
 
-    # fresh zeros are "unvarying" under shard_map's VMA typing while the
-    # loop writes pp-varying values — inherit pp-variance from the params
-    zero = jax.tree.leaves(stage_params)[0].astype(x.dtype).sum() * 0
-    act0 = jnp.zeros_like(x[0]) + zero
-    outputs0 = jnp.zeros((M,) + x.shape[1:], x.dtype) + zero
-    _, outputs = lax.fori_loop(0, M + n_stages - 1, tick, (act0, outputs0),
-                               unroll=False)
-    # broadcast the last stage's banked outputs to every stage (psum of the
-    # masked buffer — only the last stage contributes) so the loss and its
-    # gradient are computed identically everywhere
-    mask = (stage_id == n_stages - 1).astype(outputs.dtype)
-    outputs = lax.psum(outputs * mask, axis_name)
-    return outputs
+    def drain(carry, tau):
+        # after the last compute tick only the output relay still moves —
+        # running stage_fn here would waste P-1 ticks of stage compute
+        # (and its backward) on garbage activations
+        o, bank = carry
+        o, bank = relay_out(o, bank, tau)
+        return (o, bank), None
+
+    zero = _vma_zero(stage_params, x_local.dtype)
+    r0 = x_local[0]
+    act0 = jnp.zeros_like(x_local[0]) + zero
+    o0 = jnp.zeros_like(x_local[0]) + zero
+    bank0 = jnp.zeros_like(x_local) + zero
+    T = M + n_stages - 1                  # compute ticks
+    (_, _, o, bank), _ = lax.scan(
+        tick, (r0, act0, o0, bank0), jnp.arange(T))
+    (_, bank), _ = lax.scan(
+        drain, (o, bank), jnp.arange(T, T + n_stages - 1))
+    return bank
 
 
 def pipeline_apply(stage_fn: Callable, stage_params: Any, x,
@@ -81,17 +149,24 @@ def pipeline_apply(stage_fn: Callable, stage_params: Any, x,
 
     stage_fn(params_shard, x_mb) -> y_mb — one stage's computation; its
       params argument is the local shard of the stacked parameters.
-    stage_params — pytree whose leaves have leading dim == pp size
-      (stage-stacked), sharded over pp.
-    x — [M, microbatch, ...] microbatched global input.
+    stage_params — pytree whose leaves have a leading dim divisible by the
+      pp size (stage-stacked), sharded over pp.
+    x — [M, microbatch, ...] microbatched global input, sharded over pp on
+      the M dim (stage i owns microbatches [i*M/P, (i+1)*M/P)).
+    Returns [M, microbatch, ...] outputs with the same pp sharding.
     """
+    n_stages = mesh.shape[axis_name]
+    if num_microbatches % n_stages:
+        raise ValueError(
+            f"num_microbatches={num_microbatches} must divide evenly over "
+            f"pp={n_stages} (the stream is pp-sharded)")
     p_spec = jax.tree.map(lambda _: P(axis_name), stage_params)
     fn = shard_map(
-        functools.partial(_pipeline_local, stage_fn, axis_name=axis_name,
-                          num_microbatches=num_microbatches),
+        functools.partial(_pipeline_local, stage_fn, axis_name,
+                          num_microbatches),
         mesh=mesh,
-        in_specs=(p_spec, P()),
-        out_specs=P(),
+        in_specs=(p_spec, P(axis_name)),
+        out_specs=P(axis_name),
     )
     return fn(stage_params, x)
 
@@ -102,4 +177,149 @@ def stack_stage_params(per_stage_params):
     return jax.tree.map(lambda *xs: jnp.stack(xs), *per_stage_params)
 
 
-__all__ = ["pipeline_apply", "stack_stage_params"]
+# ---------------------------------------------------------------------------
+# Transformer integration: a stage-sliced GPT-2 with pipelined loss
+# ---------------------------------------------------------------------------
+
+def stack_lm_params(params, num_layers: int):
+    """Restack unboxed CausalLM params (models/transformer.py) into the
+    pipeline layout: blocks stacked on a leading layer dim (sharded over
+    pp), embeddings/ln_f replicated."""
+    bb = params["backbone"]
+    blocks = jax.tree.map(
+        lambda *xs: jnp.stack(xs),
+        *[bb[f"block_{i}"] for i in range(num_layers)])
+    return {
+        "wte": params["wte"]["embedding"],
+        "wpe": params["wpe"]["embedding"],
+        "blocks": blocks,
+        "ln_f": bb["ln_f"],
+    }
+
+
+def _lm_pipeline_local(cfg, axis_name: str, M: int, pp_params,
+                       tokens_local, targets_local):
+    """Stage-sliced CausalLM forward + loss inside shard_map over pp.
+
+    Each stage owns L/P consecutive blocks (lax.scan over the local layer
+    stack) and M/P microbatches of the token stream. The input relay
+    carries raw int32 tokens (≈E× thinner on ICI than embedded
+    activations, and no float cotangent chain in the backward); stage 0
+    embeds at consumption. ln_f + tied head + xent run only on the last
+    stage, inside `lax.cond`, so the vocab matmul is paid exactly M times.
+    Returns the total cross-entropy SUM over all scored tokens, already
+    psummed over pp (replicated); the caller divides by the static token
+    count."""
+    from ..models.transformer import Block, _head_matmul, _layer_norm
+
+    n_stages = lax.axis_size(axis_name)
+    stage = lax.axis_index(axis_name)
+    C = M // n_stages
+    T = M + n_stages - 1
+    S = tokens_local.shape[-1]
+
+    wte = pp_params["wte"]
+    wpe = pp_params["wpe"]
+    blocks = pp_params["blocks"]         # leaves [L/P, ...]
+    block = Block(cfg)
+    ln_f = _layer_norm(cfg, "ln_f")      # the unpiped model's exact module
+
+    def embed(toks):
+        return wte[toks].astype(cfg.dtype) \
+            + wpe[:S][None].astype(cfg.dtype)
+
+    def stage_apply(h):
+        def body(h, layer_params):
+            return block.apply({"params": layer_params}, h), None
+        h, _ = lax.scan(body, h, blocks)
+        return h
+
+    def head_loss(y, tgt):
+        h = ln_f.apply({"params": pp_params["ln_f"]}, y)
+        logits = _head_matmul(h, wte.astype(cfg.dtype))
+        return optax.softmax_cross_entropy_with_integer_labels(
+            logits, tgt).sum()
+
+    def inject(r_tok, r_tgt, tau):
+        m_next = tau + 1 + stage
+        own = (m_next // C == stage) & (m_next < M)
+        row = jnp.clip(m_next - stage * C, 0, C - 1)
+        toks = lax.dynamic_index_in_dim(tokens_local, row, 0,
+                                        keepdims=False)
+        tgts = lax.dynamic_index_in_dim(targets_local, row, 0,
+                                        keepdims=False)
+        r_tok = jnp.where(own, toks, r_tok)
+        r_tgt = jnp.where(own, tgts, r_tgt)
+        return r_tok, r_tgt
+
+    zero = _vma_zero(blocks, jnp.float32)
+
+    def tick(carry, tau):
+        r_tok, r_tgt, act, tgt, loss_sum = carry
+        cur_h = jnp.where(stage == 0, embed(r_tok), act)
+        cur_t = jnp.where(stage == 0, r_tgt, tgt)
+        y = stage_apply(cur_h)
+        do_loss = (stage == n_stages - 1) & (tau >= n_stages - 1)
+        # the false branch's zero must carry the same pp-variance as the
+        # real loss or cond rejects the branches as differently typed
+        loss_sum = loss_sum + lax.cond(
+            do_loss, lambda: head_loss(y, cur_t),
+            lambda: jnp.zeros((), jnp.float32) + zero)
+        act = lax.ppermute(y, axis_name, _fwd_perm(n_stages))
+        tgt = lax.ppermute(cur_t, axis_name, _fwd_perm(n_stages))
+        r_tok = lax.ppermute(r_tok, axis_name, _bwd_perm(n_stages))
+        r_tgt = lax.ppermute(r_tgt, axis_name, _bwd_perm(n_stages))
+        r_tok, r_tgt = inject(r_tok, r_tgt, tau)
+        return (r_tok, r_tgt, act, tgt, loss_sum), None
+
+    r_tok0 = tokens_local[0]
+    r_tgt0 = targets_local[0]
+    act0 = jnp.zeros((r_tok0.shape[0], S, wte.shape[1]), cfg.dtype) \
+        + zero.astype(cfg.dtype)
+    carry0 = (r_tok0, r_tgt0, act0, r_tgt0,
+              jnp.zeros((), jnp.float32) + zero)
+    (_, _, _, _, loss_sum), _ = lax.scan(tick, carry0, jnp.arange(T))
+    return lax.psum(loss_sum, axis_name)
+
+
+def pipeline_lm_loss(cfg, pp_params, tokens, targets, mesh: Mesh,
+                     num_microbatches: int, axis_name: str = "pp"):
+    """Mean next-token cross-entropy of a pp-stage-sliced CausalLM.
+
+    cfg — TransformerConfig; cfg.num_layers must divide over pp.
+    pp_params — stack_lm_params() layout; blocks sharded over pp.
+    tokens/targets — [M, microbatch, S] int32, sharded over pp on M.
+    Equals models.CausalLM.apply + lm_loss on the same (restacked) params;
+    see tests/test_parallel.py::TestPipelineLM."""
+    n_stages = mesh.shape[axis_name]
+    M = num_microbatches
+    if M % n_stages:
+        raise ValueError(f"num_microbatches={M} must divide over "
+                         f"pp={n_stages}")
+    if cfg.num_layers % n_stages:
+        raise ValueError(f"num_layers={cfg.num_layers} must divide over "
+                         f"pp={n_stages}")
+    specs = {
+        "wte": P(), "wpe": P(),
+        "blocks": jax.tree.map(lambda _: P(axis_name),
+                               pp_params["blocks"]),
+        "ln_f": jax.tree.map(lambda _: P(), pp_params["ln_f"]),
+    }
+    # check_vma=False: differentiating through lax.cond inside shard_map
+    # trips a JAX varying-manual-axes bookkeeping bug (the residuals of the
+    # two branches get different inferred variance); the error message
+    # itself prescribes this workaround. Correctness is pinned by the
+    # grads-vs-unpiped parity test (tests/test_parallel.py TestPipelineLM).
+    fn = shard_map(
+        functools.partial(_lm_pipeline_local, cfg, axis_name, M),
+        mesh=mesh,
+        in_specs=(specs, P(axis_name), P(axis_name)),
+        out_specs=P(),
+        check_vma=False,
+    )
+    loss_sum = fn(pp_params, tokens, targets)
+    return loss_sum / (tokens.shape[0] * tokens.shape[1] * tokens.shape[2])
+
+
+__all__ = ["pipeline_apply", "stack_stage_params", "stack_lm_params",
+           "pipeline_lm_loss", "bubble_fraction"]

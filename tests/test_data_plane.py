@@ -155,3 +155,23 @@ def test_synthetic_dataset_sharded():
                                sharding=batch_sharding(mesh))
     imgs, labels = next(iter(ds))
     assert imgs.sharding.spec == P(("dcn", "dp", "fsdp"))
+
+
+# ---------------------------------------------------------------------------
+# allreduce scaling harness (VERDICT #8; BASELINE "≥90% 4→32")
+# ---------------------------------------------------------------------------
+
+def test_allreduce_bench_curve_structure():
+    from mpi_operator_tpu.examples.allreduce_bench import (
+        run_allreduce_benchmark)
+
+    result = run_allreduce_benchmark(payload_mb=[0.25], iters=2,
+                                     device_counts=[1, 2, 4, 8],
+                                     log=lambda s: None)
+    assert len(result["points"]) == 4
+    for p in result["points"]:
+        assert p["time_ms"] > 0 and p["algbw_gbs"] > 0
+    # efficiency relative to the smallest multi-device ring, which is 1.0
+    curve = result["efficiency_curve"]
+    assert set(curve) == {"2", "4", "8"}
+    assert curve["2"] == 1.0
