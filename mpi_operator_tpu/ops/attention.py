@@ -45,6 +45,14 @@ NEG_INF = -1e30
 LANES = 128        # minor-dim width for row-statistic tensors
 
 
+def _out_struct(shape, dtype, *like):
+    """Pallas out_shape carrying the varying-manual-axes of its inputs, so
+    the kernels type-check under shard_map's default check_vma (ring
+    attention launches them inside a manual region)."""
+    vma = frozenset().union(*(jax.typeof(x).vma for x in like))
+    return jax.ShapeDtypeStruct(shape, dtype, vma=vma)
+
+
 # ---------------------------------------------------------------------------
 # Forward
 # ---------------------------------------------------------------------------
@@ -140,8 +148,8 @@ def _flash_fwd(q, k, v, kv_mask, sm_scale, causal, block_q, block_k,
             pl.BlockSpec((1, block_q, LANES), lambda b, i, j: (b, i, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((BH, S, D), q.dtype),
-            jax.ShapeDtypeStruct((BH, S, LANES), jnp.float32),
+            _out_struct((BH, S, D), q.dtype, q, k, v),
+            _out_struct((BH, S, LANES), jnp.float32, q, k, v),
         ],
         scratch_shapes=[
             pltpu.VMEM((block_q, D), jnp.float32),      # acc
@@ -268,25 +276,14 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, mask_ref,
         dv_ref[0] = dv_acc[:].astype(dv_ref.dtype)
 
 
-def _flash_bwd(sm_scale, causal, block_q, block_k, num_heads, interpret,
-               res, do):
-    q, k, v, out, lse, kv_mask = res
+def _dq_call(q, k, v, do, lse_lanes, delta_lanes, kv_mask, sm_scale,
+             causal, block_q, block_k, num_heads, interpret):
+    """dq for one (q-span × k-span) pairing. lse/delta: [BH, S, LANES].
+    Reused by the ring-attention backward (parallel/ring_attention.py)
+    with per-block lse/delta from the GLOBAL softmax statistics."""
     BH, S, D = q.shape
     H = num_heads
-    # the residual lse is stored [BH, S] (one scalar per row); re-broadcast
-    # to the Mosaic-legal 128-lane layout only for the kernels' lifetime
-    lse = jnp.broadcast_to(lse[..., None], (BH, S, LANES))
-    # delta = rowsum(dO ∘ O), lane-broadcast like lse
-    delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32),
-                    axis=-1)
-    delta = jnp.broadcast_to(delta[..., None], (BH, S, LANES))
-
     lm_spec_q = pl.BlockSpec((1, block_q, LANES), lambda b, i, j: (b, i, 0))
-
-    common = dict(sm_scale=sm_scale, causal=causal, block_q=block_q,
-                  block_k=block_k, num_heads=num_heads)
-
-    # --- dq: grid (BH, nq, nk) -------------------------------------------
     dq_in_specs = [
         pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0)),   # q
         pl.BlockSpec((1, block_k, D), lambda b, i, j: (b, j, 0)),   # k
@@ -295,8 +292,10 @@ def _flash_bwd(sm_scale, causal, block_q, block_k, num_heads, interpret,
         lm_spec_q,                                                  # lse
         lm_spec_q,                                                  # delta
     ]
-    dq_args = [q, k, v, do, lse, delta]
-    dq_kern = functools.partial(_dq_kernel, **common)
+    dq_args = [q, k, v, do, lse_lanes, delta_lanes]
+    dq_kern = functools.partial(
+        _dq_kernel, sm_scale=sm_scale, causal=causal, block_q=block_q,
+        block_k=block_k, num_heads=num_heads)
     if kv_mask is not None:
         dq_in_specs.append(
             pl.BlockSpec((1, 8, block_k), lambda b, i, j: (b // H, 0, j)))
@@ -308,17 +307,22 @@ def _flash_bwd(sm_scale, causal, block_q, block_k, num_heads, interpret,
                     dq_ref, dq_acc, _inner=inner_dq):
             return _inner(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                           None, dq_ref, dq_acc)
-    dq = pl.pallas_call(
+    return pl.pallas_call(
         dq_kern,
         grid=(BH, S // block_q, S // block_k),
         in_specs=dq_in_specs,
         out_specs=pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0)),
-        out_shape=jax.ShapeDtypeStruct((BH, S, D), q.dtype),
+        out_shape=_out_struct((BH, S, D), q.dtype, q, k, v, do),
         scratch_shapes=[pltpu.VMEM((block_q, D), jnp.float32)],
         interpret=interpret,
     )(*dq_args)
 
-    # --- dk/dv: grid (BH, nk, nq) ----------------------------------------
+
+def _dkv_call(q, k, v, do, lse_lanes, delta_lanes, kv_mask, sm_scale,
+              causal, block_q, block_k, num_heads, interpret):
+    """dk/dv for one (q-span × k-span) pairing; see _dq_call."""
+    BH, S, D = q.shape
+    H = num_heads
     dkv_in_specs = [
         pl.BlockSpec((1, block_q, D), lambda b, j, i: (b, i, 0)),   # q
         pl.BlockSpec((1, block_k, D), lambda b, j, i: (b, j, 0)),   # k
@@ -327,8 +331,10 @@ def _flash_bwd(sm_scale, causal, block_q, block_k, num_heads, interpret,
         pl.BlockSpec((1, block_q, LANES), lambda b, j, i: (b, i, 0)),  # lse
         pl.BlockSpec((1, block_q, LANES), lambda b, j, i: (b, i, 0)),  # delta
     ]
-    dkv_args = [q, k, v, do, lse, delta]
-    dkv_kern = functools.partial(_dkv_kernel, **common)
+    dkv_args = [q, k, v, do, lse_lanes, delta_lanes]
+    dkv_kern = functools.partial(
+        _dkv_kernel, sm_scale=sm_scale, causal=causal, block_q=block_q,
+        block_k=block_k, num_heads=num_heads)
     if kv_mask is not None:
         dkv_in_specs.append(
             pl.BlockSpec((1, 8, block_k), lambda b, j, i: (b // H, 0, j)))
@@ -340,7 +346,7 @@ def _flash_bwd(sm_scale, causal, block_q, block_k, num_heads, interpret,
                      dk_ref, dv_ref, dk_acc, dv_acc, _inner=inner_dkv):
             return _inner(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                           None, dk_ref, dv_ref, dk_acc, dv_acc)
-    dk, dv = pl.pallas_call(
+    return pl.pallas_call(
         dkv_kern,
         grid=(BH, S // block_k, S // block_q),
         in_specs=dkv_in_specs,
@@ -349,8 +355,8 @@ def _flash_bwd(sm_scale, causal, block_q, block_k, num_heads, interpret,
             pl.BlockSpec((1, block_k, D), lambda b, j, i: (b, j, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((BH, S, D), k.dtype),
-            jax.ShapeDtypeStruct((BH, S, D), v.dtype),
+            _out_struct((BH, S, D), k.dtype, q, k, v, do),
+            _out_struct((BH, S, D), v.dtype, q, k, v, do),
         ],
         scratch_shapes=[
             pltpu.VMEM((block_k, D), jnp.float32),
@@ -358,6 +364,23 @@ def _flash_bwd(sm_scale, causal, block_q, block_k, num_heads, interpret,
         ],
         interpret=interpret,
     )(*dkv_args)
+
+
+def _flash_bwd(sm_scale, causal, block_q, block_k, num_heads, interpret,
+               res, do):
+    q, k, v, out, lse, kv_mask = res
+    BH, S, D = q.shape
+    # the residual lse is stored [BH, S] (one scalar per row); re-broadcast
+    # to the Mosaic-legal 128-lane layout only for the kernels' lifetime
+    lse = jnp.broadcast_to(lse[..., None], (BH, S, LANES))
+    # delta = rowsum(dO ∘ O), lane-broadcast like lse
+    delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32),
+                    axis=-1)
+    delta = jnp.broadcast_to(delta[..., None], (BH, S, LANES))
+    dq = _dq_call(q, k, v, do, lse, delta, kv_mask, sm_scale, causal,
+                  block_q, block_k, num_heads, interpret)
+    dk, dv = _dkv_call(q, k, v, do, lse, delta, kv_mask, sm_scale, causal,
+                       block_q, block_k, num_heads, interpret)
     dmask = None if kv_mask is None else jnp.zeros_like(kv_mask)
     return dq, dk, dv, dmask
 

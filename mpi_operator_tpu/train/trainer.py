@@ -98,22 +98,30 @@ class Trainer:
             (2, self.config.image_size, self.config.image_size, 3),
             jnp.float32,
         )
-        variables = jax.jit(partial(self.model.init, train=False))(rng, dummy)
-        # models annotated with logical partitioning (ViT) come back boxed;
-        # unbox is a no-op for plain arrays (ResNet)
-        variables = meta.unbox(variables)
-        params = variables["params"]
-        batch_stats = variables.get("batch_stats", FrozenDict())
-        state = TrainState(
-            step=jnp.zeros((), jnp.int32),
+
+        def init_all(rng):
+            variables = self.model.init(rng, dummy, train=False)
+            # models annotated with logical partitioning (ViT) come back
+            # boxed; unbox is a no-op for plain arrays (ResNet)
+            variables = meta.unbox(variables)
+            params = variables["params"]
+            return (params, variables.get("batch_stats", FrozenDict()),
+                    self.tx.init(params))
+
+        # initialize DIRECTLY into the target (replicated) layout — params
+        # AND optimizer state materialize once, laid out by XLA, with no
+        # single-device staging copy (the same out_shardings discipline
+        # LMTrainer's shard_init uses for ruled layouts)
+        params, batch_stats, opt_state = jax.jit(
+            init_all, out_shardings=self.replicated)(rng)
+        return TrainState(
+            step=jax.device_put(jnp.zeros((), jnp.int32), self.replicated),
             params=params,
             batch_stats=batch_stats,
-            opt_state=self.tx.init(params),
+            opt_state=opt_state,
             tx=self.tx,
             apply_fn=self.model.apply,
         )
-        # replicate the whole state across the mesh
-        return jax.device_put(state, self.replicated)
 
     # -- the jitted step ----------------------------------------------------
 

@@ -61,28 +61,32 @@ class TestTensorParallel:
 # ---------------------------------------------------------------------------
 
 class TestRingAttention:
+    @pytest.mark.parametrize("impl", ["dense", "flash"])
     @pytest.mark.parametrize("causal", [True, False])
-    def test_matches_dense(self, causal):
+    def test_matches_dense(self, causal, impl):
         mesh = make_mesh(MeshConfig(dp=2, sp=4))
         B, S, H, D = 4, 64, 2, 16
         q, k, v = (jax.random.normal(jax.random.PRNGKey(i), (B, S, H, D))
                    for i in range(3))
         ref = dense_attention(q, k, v, causal=causal, dtype=jnp.float32)
-        out = ring_attention(q, k, v, mesh, causal=causal)
+        out = ring_attention(q, k, v, mesh, causal=causal, impl=impl)
         np.testing.assert_allclose(np.asarray(ref), np.asarray(out),
                                    atol=1e-5)
 
-    def test_gradients_match_dense(self):
+    @pytest.mark.parametrize("impl", ["dense", "flash"])
+    @pytest.mark.parametrize("causal", [True, False])
+    def test_gradients_match_dense(self, causal, impl):
         mesh = make_mesh(MeshConfig(sp=8))
         B, S, H, D = 2, 32, 2, 8
         q, k, v = (jax.random.normal(jax.random.PRNGKey(i), (B, S, H, D))
                    for i in range(3))
 
         def lr(q, k, v):
-            return (ring_attention(q, k, v, mesh, causal=True) ** 2).sum()
+            return (ring_attention(q, k, v, mesh, causal=causal,
+                                   impl=impl) ** 2).sum()
 
         def ld(q, k, v):
-            return (dense_attention(q, k, v, causal=True,
+            return (dense_attention(q, k, v, causal=causal,
                                     dtype=jnp.float32) ** 2).sum()
 
         g1 = jax.grad(lr, argnums=(0, 1, 2))(q, k, v)
