@@ -47,12 +47,15 @@ class NpyImageDataset:
 
     def __init__(self, data_dir: str, batch_size: int,
                  image_size: int = 224, dtype=jnp.bfloat16,
-                 sharding=None, seed: int = 0, prefetch: int = 2):
+                 sharding=None, seed: int = 0, prefetch: int = 2,
+                 use_native: str = "auto"):
         self.batch_size = batch_size
         self.image_size = image_size
         self.dtype = dtype
         self._sharding = sharding
         self._shards = discover_shards(data_dir)
+        if use_native not in ("auto", "never", "always"):
+            raise ValueError(f"use_native={use_native!r}")
         # fail fast instead of a silent empty-queue hang: at least one shard
         # must be able to cut a full batch (mmap header read only)
         max_rows = 0
@@ -72,6 +75,24 @@ class NpyImageDataset:
                 f"every shard is smaller ({max_rows} rows) than the batch "
                 f"size ({batch_size}); no batch can ever be produced")
         self._seed = seed
+        # native C++ loader (mpi_operator_tpu/native): shard IO + fused
+        # normalize/cast run outside the GIL with their own prefetch
+        # thread; the Python feeder then only does device_put. Falls back
+        # to the pure-Python path when no compiler is available.
+        self._native = None
+        if use_native != "never":
+            try:
+                from ..native import NativeShardLoader, native_available
+                if use_native == "always" or native_available():
+                    self._native = NativeShardLoader(
+                        self._shards, batch_size,
+                        (image_size, image_size, 3),
+                        dtype=np.dtype(self.dtype).name,
+                        mean=_MEAN.tolist(), std=_STD.tolist(), seed=seed)
+            except Exception:  # noqa: BLE001 — fall back to Python
+                if use_native == "always":
+                    raise
+                self._native = None
         self._queue: Queue = Queue(maxsize=prefetch)
         self._stop = threading.Event()
         self._thread = threading.Thread(target=self._feeder, daemon=True)
@@ -106,6 +127,15 @@ class NpyImageDataset:
 
     def _feeder(self):
         try:
+            if self._native is not None:
+                for images, labels in self._native:
+                    if self._stop.is_set():
+                        return
+                    batch = (jax.device_put(images, self._sharding),
+                             jax.device_put(labels, self._sharding))
+                    if not self._put(batch):
+                        return
+                return
             for raw_images, raw_labels in self._host_batches():
                 if self._stop.is_set():
                     return
@@ -141,6 +171,8 @@ class NpyImageDataset:
         except Exception:
             pass
         self._thread.join(timeout=2.0)
+        if self._native is not None:
+            self._native.close()
 
 
 def write_npy_shard(data_dir: str, stem: str, images: np.ndarray,

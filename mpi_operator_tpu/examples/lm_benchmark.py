@@ -42,6 +42,7 @@ def run_lm_benchmark(
     warmup_steps: int = 5,
     dtype_name: str = "bfloat16",
     tp: int = 1,
+    pp: int = 1,
     num_slices: int = 1,
     attention: str = "auto",
     remat: bool = False,
@@ -72,6 +73,42 @@ def run_lm_benchmark(
     global_batch = batch_per_device * n
     tcfg = LMTrainerConfig(global_batch_size=global_batch, seq_len=seq_len,
                            masked_lm=masked)
+    if pp > 1:
+        # GPipe over the pp axis: stage-sliced CausalLM with a pp-sharded
+        # microbatch stream (train/pp_trainer.py). bert (masked) stays on
+        # the unpiped trainer — the pipelined head is next-token xent.
+        if masked:
+            raise ValueError("--pp supports the causal LM (gpt2) only")
+        if tp > 1:
+            raise ValueError("--pp does not compose with --tp yet; the "
+                             "stage body applies blocks without tensor-"
+                             "parallel sharding rules")
+        if train_dir:
+            raise ValueError("--train-dir checkpointing is not wired for "
+                             "--pp runs yet; drop one of the flags")
+        from ..train.pp_trainer import PipelineLMTrainer
+        if n % (pp * num_slices):
+            raise ValueError(f"{n} devices not divisible by pp={pp}")
+        pp_mesh = make_mesh(MeshConfig(pp=pp, dp=n // (pp * num_slices),
+                                       dcn=num_slices))
+        pp_trainer = PipelineLMTrainer(model.config, pp_mesh, tcfg)
+        pp_state = pp_trainer.init_state(jax.random.PRNGKey(0))
+
+        class RawStream:
+            def __init__(self):
+                self._rng = jax.random.PRNGKey(1)
+
+            def __iter__(self):
+                return self
+
+            def __next__(self):
+                self._rng, sub = jax.random.split(self._rng)
+                return synthetic_token_batch(sub, global_batch, seq_len,
+                                             cfg_vocab)
+
+        return pp_trainer.benchmark(pp_state, RawStream(),
+                                    num_steps=num_steps,
+                                    warmup_steps=warmup_steps, log=log)
     trainer = LMTrainer(model, mesh, tcfg)
     state = trainer.init_state(jax.random.PRNGKey(0))
 
@@ -186,6 +223,8 @@ def main(argv=None) -> int:
     parser.add_argument("--dtype", default="bfloat16",
                         choices=["bfloat16", "float32"])
     parser.add_argument("--tp", type=int, default=1)
+    parser.add_argument("--pp", type=int, default=1,
+                        help="GPipe pipeline stages (causal LM only)")
     parser.add_argument("--attention", default="auto",
                         choices=["auto", "dense", "flash"])
     parser.add_argument("--remat", action="store_true")
@@ -222,7 +261,7 @@ def main(argv=None) -> int:
                 batch_per_device=args.batch_per_device or 8,
                 seq_len=args.seq_len, num_steps=args.num_steps,
                 warmup_steps=args.warmup_steps, dtype_name=args.dtype,
-                tp=args.tp, num_slices=info.num_slices,
+                tp=args.tp, pp=args.pp, num_slices=info.num_slices,
                 attention=args.attention, remat=args.remat,
                 remat_policy=args.remat_policy,
                 train_dir=args.train_dir, log=log)

@@ -1,0 +1,1 @@
+from .loader import NativeShardLoader, native_available  # noqa: F401

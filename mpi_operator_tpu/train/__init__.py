@@ -5,3 +5,4 @@ from .checkpoint import latest_checkpoint, restore_checkpoint, save_checkpoint  
 from .lm_trainer import (  # noqa: F401,E402
     LMTrainer, LMTrainerConfig, LMTrainState, lm_loss, make_adamw,
 )
+from .pp_trainer import PipelineLMTrainer, PPTrainState  # noqa: F401,E402

@@ -1,0 +1,193 @@
+"""Pipeline-parallel LM trainer — end-to-end training over the pp axis.
+
+Builds on parallel/pipeline.pipeline_lm_loss (the stage-sliced CausalLM):
+this module adds the optimizer half so pp is a usable training strategy,
+not just a loss function. Parameters live in the pipeline layout
+(stack_lm_params: blocks stacked [L, ...] and SHARDED over pp on the layer
+dim; embeddings/ln_f replicated), the AdamW state mirrors that layout leaf
+for leaf, and the jitted step carries explicit shardings so XLA keeps
+every tensor where it belongs — each stage's optimizer update touches only
+its own L/P layer slice (the pp memory win extends to the optimizer).
+
+Composes with data axes: the microbatch dim of the token stream is sharded
+over (dcn, dp, fsdp) while the M dim is sharded over pp, so pp×dp runs
+without replicating either stream.
+"""
+from __future__ import annotations
+
+import time
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+from flax import struct
+from flax.core import meta
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..models.transformer import CausalLM, TransformerConfig
+from ..parallel.pipeline import (bubble_fraction, pipeline_lm_loss,
+                                 stack_lm_params)
+from ..utils import flops
+from .lm_trainer import LMTrainerConfig, _opt_shardings, make_adamw
+
+
+class PPTrainState(struct.PyTreeNode):
+    step: jax.Array
+    params: Any                       # stack_lm_params layout
+    opt_state: Any
+    tx: optax.GradientTransformation = struct.field(pytree_node=False)
+
+
+class PipelineLMTrainer:
+    """GPipe training over mesh axes pp × (dcn, dp, fsdp).
+
+    num_microbatches M must divide over pp; pick M >= 4 × pp to keep the
+    bubble (P-1)/(M+P-1) small (parallel/pipeline.bubble_fraction)."""
+
+    def __init__(self, cfg: TransformerConfig, mesh: Mesh,
+                 config: Optional[LMTrainerConfig] = None,
+                 num_microbatches: Optional[int] = None,
+                 tx: Optional[optax.GradientTransformation] = None):
+        self.cfg = cfg
+        self.mesh = mesh
+        self.config = config or LMTrainerConfig()
+        self.pp = mesh.shape["pp"]
+        self.num_microbatches = num_microbatches or max(4 * self.pp, self.pp)
+        if self.num_microbatches % self.pp:
+            raise ValueError(f"num_microbatches={self.num_microbatches} "
+                             f"must divide over pp={self.pp}")
+        if self.config.global_batch_size % self.num_microbatches:
+            raise ValueError(
+                f"global_batch_size={self.config.global_batch_size} must "
+                f"divide into {self.num_microbatches} microbatches")
+        data_deg = (mesh.shape["dcn"] * mesh.shape["dp"]
+                    * mesh.shape["fsdp"])
+        mb = self.config.global_batch_size // self.num_microbatches
+        if mb % data_deg:
+            raise ValueError(
+                f"microbatch size {mb} (global {self.config.global_batch_size}"
+                f" / M={self.num_microbatches}) must divide over the data "
+                f"axes (dcn×dp×fsdp = {data_deg})")
+        self.tx = tx or make_adamw(self.config)
+        # token stream [M, mb, S]: M over pp, microbatch over data axes
+        self.batch_sharding = NamedSharding(
+            mesh, P("pp", ("dcn", "dp", "fsdp")))
+        self.replicated = NamedSharding(mesh, P())
+        self._step = None
+        self._state_shardings = None
+
+    @property
+    def bubble(self) -> float:
+        return bubble_fraction(self.pp, self.num_microbatches)
+
+    # -- initialization -----------------------------------------------------
+
+    def _param_shardings(self, params):
+        blocks_sh = jax.tree.map(
+            lambda _: NamedSharding(self.mesh, P("pp")), params["blocks"])
+        return {"wte": self.replicated, "wpe": self.replicated,
+                "blocks": blocks_sh,
+                "ln_f": jax.tree.map(lambda _: self.replicated,
+                                     params["ln_f"])}
+
+    def init_state(self, rng: jax.Array) -> PPTrainState:
+        cfg = self.cfg
+        model = CausalLM(cfg)
+        dummy = jnp.zeros((2, self.config.seq_len), jnp.int32)
+
+        def init_all(rng):
+            variables = meta.unbox(model.init(rng, dummy))
+            params = stack_lm_params(variables["params"], cfg.num_layers)
+            return params, self.tx.init(params)
+
+        abstract_p, _ = jax.eval_shape(init_all, rng)
+        param_sh = self._param_shardings(abstract_p)
+        opt_abstract = jax.eval_shape(self.tx.init, abstract_p)
+        # AdamW moments mirror the params leaf-for-leaf: shard them
+        # identically (blocks' mu/nu live pp-sharded with their layers)
+        opt_sh = _opt_shardings(opt_abstract, abstract_p, param_sh,
+                                self.replicated)
+        params, opt_state = jax.jit(
+            init_all, out_shardings=(param_sh, opt_sh))(rng)
+        self._state_shardings = PPTrainState(
+            step=self.replicated, params=param_sh, opt_state=opt_sh,
+            tx=self.tx)
+        return PPTrainState(
+            step=jax.device_put(jnp.zeros((), jnp.int32), self.replicated),
+            params=params, opt_state=opt_state, tx=self.tx)
+
+    # -- the jitted step ----------------------------------------------------
+
+    def _step_fn(self, state: PPTrainState, tokens, targets):
+        def loss_fn(params):
+            return pipeline_lm_loss(self.cfg, params, tokens, targets,
+                                    self.mesh, self.num_microbatches)
+        loss, grads = jax.value_and_grad(loss_fn)(state.params)
+        updates, new_opt = state.tx.update(grads, state.opt_state,
+                                           state.params)
+        return state.replace(
+            step=state.step + 1,
+            params=optax.apply_updates(state.params, updates),
+            opt_state=new_opt), {"loss": loss}
+
+    def compile_step(self):
+        if self._step is None:
+            assert self._state_shardings is not None, "call init_state first"
+            self._step = jax.jit(
+                self._step_fn,
+                in_shardings=(self._state_shardings, self.batch_sharding,
+                              self.batch_sharding),
+                out_shardings=(self._state_shardings, self.replicated),
+                donate_argnums=(0,),
+            )
+        return self._step
+
+    def train_step(self, state, tokens, targets):
+        """tokens/targets: [M, microbatch, S] int32."""
+        return self.compile_step()(state, tokens, targets)
+
+    def microbatch(self, tokens, targets):
+        """Reshape a flat [B, S] batch into the [M, B/M, S] stream."""
+        M = self.num_microbatches
+        B, S = tokens.shape
+        return (tokens.reshape(M, B // M, S),
+                targets.reshape(M, B // M, S))
+
+    # -- benchmark loop -----------------------------------------------------
+
+    def benchmark(self, state, dataset, num_steps: int = 50,
+                  warmup_steps: int = 5, log: Callable[[str], None] = print,
+                  ) -> Tuple[PPTrainState, Dict[str, float]]:
+        cfg = self.config
+        it = iter(dataset)
+        step = self.compile_step()
+        for _ in range(max(1, warmup_steps)):
+            toks, tgts = next(it)
+            state, metrics = step(state, *self.microbatch(toks, tgts))
+        float(metrics["loss"])
+        tokens_per_step = cfg.global_batch_size * cfg.seq_len
+        t0 = time.perf_counter()
+        for _ in range(num_steps):
+            toks, tgts = next(it)
+            state, metrics = step(state, *self.microbatch(toks, tgts))
+        final_loss = float(metrics["loss"])         # host read barrier
+        dt = time.perf_counter() - t0
+        tps = tokens_per_step * num_steps / dt
+        n = self.mesh.size
+        num_params = flops.param_count(state.params)
+        per_token = flops.transformer_train_flops_per_token(
+            num_params, self.cfg.num_layers, self.cfg.embed_dim,
+            cfg.seq_len, causal=self.cfg.causal)
+        stats = flops.throughput_stats(
+            per_token * tokens_per_step, tps / tokens_per_step, n)
+        log(f"pp={self.pp} M={self.num_microbatches} "
+            f"bubble={self.bubble:.1%}: {tps:.0f} tokens/sec")
+        return state, {"tokens_per_sec": tps,
+                       "tokens_per_sec_per_device": tps / n,
+                       "final_loss": final_loss,
+                       "bubble_fraction": self.bubble,
+                       **stats}
+
+
+__all__ = ["PipelineLMTrainer", "PPTrainState"]

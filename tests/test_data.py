@@ -4,6 +4,7 @@ examples/tensorflow-benchmarks-imagenet.yaml:32-45)."""
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 from mpi_operator_tpu.data import (
     NpyImageDataset, SyntheticImageDataset, write_npy_shard)
@@ -84,3 +85,130 @@ def test_npy_dataset_close_stops_feeder(tmp_path):
     next(ds)
     ds.close()
     assert not ds._thread.is_alive()
+
+
+# ---------------------------------------------------------------------------
+# native C++ loader (mpi_operator_tpu/native)
+# ---------------------------------------------------------------------------
+
+class TestNativeLoader:
+    def _shard(self, tmp_path, n=12, hw=8, dtype=np.uint8):
+        from mpi_operator_tpu.data.imagefolder import write_npy_shard
+        rng = np.random.RandomState(0)
+        if dtype == np.uint8:
+            images = rng.randint(0, 256, (n, hw, hw, 3)).astype(np.uint8)
+        else:
+            images = rng.randn(n, hw, hw, 3).astype(np.float32)
+        labels = rng.randint(0, 10, (n,)).astype(np.int64)
+        write_npy_shard(str(tmp_path), "s0", images, labels)
+        return images, labels
+
+    @pytest.mark.parametrize("src_dtype", [np.uint8, np.float32])
+    def test_matches_python_normalization(self, tmp_path, src_dtype):
+        from mpi_operator_tpu.data.imagefolder import _MEAN, _STD
+        from mpi_operator_tpu.native import NativeShardLoader, native_available
+        if not native_available():
+            pytest.skip("no g++ available")
+        images, labels = self._shard(tmp_path, dtype=src_dtype)
+        shards = [(str(tmp_path / "s0_images.npy"),
+                   str(tmp_path / "s0_labels.npy"))]
+        loader = NativeShardLoader(shards, batch_size=4,
+                                   image_shape=(8, 8, 3), dtype="float32",
+                                   mean=_MEAN.tolist(), std=_STD.tolist(),
+                                   seed=0)
+        img, lbl = next(loader)
+        ref = (images[:4].astype(np.float32) - _MEAN) / _STD
+        np.testing.assert_allclose(img, ref, atol=1e-5)
+        np.testing.assert_array_equal(lbl, labels[:4].astype(np.int32))
+        # second batch continues through the shard
+        img2, _ = next(loader)
+        ref2 = (images[4:8].astype(np.float32) - _MEAN) / _STD
+        np.testing.assert_allclose(img2, ref2, atol=1e-5)
+        loader.close()
+
+    def test_bf16_output_rounds_correctly(self, tmp_path):
+        import ml_dtypes
+
+        from mpi_operator_tpu.data.imagefolder import _MEAN, _STD
+        from mpi_operator_tpu.native import NativeShardLoader, native_available
+        if not native_available():
+            pytest.skip("no g++ available")
+        images, _ = self._shard(tmp_path)
+        shards = [(str(tmp_path / "s0_images.npy"),
+                   str(tmp_path / "s0_labels.npy"))]
+        loader = NativeShardLoader(shards, batch_size=4,
+                                   image_shape=(8, 8, 3), dtype="bfloat16",
+                                   mean=_MEAN.tolist(), std=_STD.tolist())
+        img, _ = next(loader)
+        assert img.dtype == np.dtype(ml_dtypes.bfloat16)
+        ref = (((images[:4].astype(np.float32) - _MEAN) / _STD)
+               .astype(ml_dtypes.bfloat16))
+        np.testing.assert_array_equal(
+            img.view(np.uint16), ref.view(np.uint16))
+        loader.close()
+
+    def test_dataset_uses_native_path(self, tmp_path):
+        from mpi_operator_tpu.data.imagefolder import NpyImageDataset
+        from mpi_operator_tpu.native import native_available
+        if not native_available():
+            pytest.skip("no g++ available")
+        self._shard(tmp_path, n=16)
+        ds = NpyImageDataset(str(tmp_path), batch_size=4, image_size=8,
+                             dtype=jnp.float32, use_native="always")
+        assert ds._native is not None
+        images, labels = next(ds)
+        assert images.shape == (4, 8, 8, 3)
+        assert labels.shape == (4,)
+        assert bool(jnp.isfinite(images).all())
+        ds.close()
+
+    def test_native_and_python_paths_agree(self, tmp_path):
+        from mpi_operator_tpu.data.imagefolder import NpyImageDataset
+        from mpi_operator_tpu.native import native_available
+        if not native_available():
+            pytest.skip("no g++ available")
+        self._shard(tmp_path, n=16)
+        a = NpyImageDataset(str(tmp_path), batch_size=4, image_size=8,
+                            dtype=jnp.float32, use_native="always")
+        b = NpyImageDataset(str(tmp_path), batch_size=4, image_size=8,
+                            dtype=jnp.float32, use_native="never")
+        # single shard: identical deterministic order
+        for _ in range(4):
+            ia, la = next(a)
+            ib, lb = next(b)
+            np.testing.assert_allclose(np.asarray(ia), np.asarray(ib),
+                                       atol=1e-5)
+            np.testing.assert_array_equal(np.asarray(la), np.asarray(lb))
+        a.close()
+        b.close()
+
+    def test_shape_mismatch_rejected_not_overflowed(self, tmp_path):
+        """An RGBA (or wrong-resolution) shard must fail nsl_open with a
+        clean error — the destination buffer is sized from the requested
+        shape, so accepting the shard would overflow it."""
+        from mpi_operator_tpu.native import NativeShardLoader, native_available
+        if not native_available():
+            pytest.skip("no g++ available")
+        from mpi_operator_tpu.data.imagefolder import write_npy_shard
+        rng = np.random.RandomState(0)
+        write_npy_shard(str(tmp_path), "s0",
+                        rng.randint(0, 256, (8, 8, 8, 4)).astype(np.uint8),
+                        rng.randint(0, 10, (8,)).astype(np.int64))
+        shards = [(str(tmp_path / "s0_images.npy"),
+                   str(tmp_path / "s0_labels.npy"))]
+        with pytest.raises(RuntimeError, match="shape"):
+            NativeShardLoader(shards, batch_size=4, image_shape=(8, 8, 3))
+
+    def test_int_image_shard_rejected(self, tmp_path):
+        from mpi_operator_tpu.native import NativeShardLoader, native_available
+        if not native_available():
+            pytest.skip("no g++ available")
+        from mpi_operator_tpu.data.imagefolder import write_npy_shard
+        rng = np.random.RandomState(0)
+        write_npy_shard(str(tmp_path), "s0",
+                        rng.randint(0, 256, (8, 8, 8, 3)).astype(np.int32),
+                        rng.randint(0, 10, (8,)).astype(np.int64))
+        shards = [(str(tmp_path / "s0_images.npy"),
+                   str(tmp_path / "s0_labels.npy"))]
+        with pytest.raises(RuntimeError, match="u1 or f4"):
+            NativeShardLoader(shards, batch_size=4, image_shape=(8, 8, 3))

@@ -274,3 +274,69 @@ def test_mesh_has_all_strategy_axes():
 def test_mesh_rejects_wrong_device_count():
     with pytest.raises(ValueError):
         make_mesh(MeshConfig(dp=3, tp=5))
+
+
+class TestPipelineTrainer:
+    """End-to-end pp training: one PipelineLMTrainer step must equal one
+    LMTrainer step on the same init, batch, and optimizer."""
+
+    def test_one_step_matches_unpiped_trainer(self):
+        import optax
+
+        from mpi_operator_tpu.parallel import stack_lm_params
+        from mpi_operator_tpu.train import (LMTrainer, LMTrainerConfig,
+                                            PipelineLMTrainer)
+
+        cfg = gpt2_config("test", attention="dense", dtype=jnp.float32,
+                          vocab_size=256, max_len=32)
+        tcfg = LMTrainerConfig(global_batch_size=16, seq_len=16)
+        key = jax.random.PRNGKey(0)
+        toks = jax.random.randint(jax.random.PRNGKey(5), (16, 17), 0,
+                                  cfg.vocab_size)
+        toks, tgts = toks[:, :-1], toks[:, 1:]
+
+        ppt = PipelineLMTrainer(cfg, make_mesh(MeshConfig(pp=2, dp=4)),
+                                tcfg, num_microbatches=4,
+                                tx=optax.sgd(0.1))
+        s_pp = ppt.init_state(key)
+        s_pp, m_pp = ppt.train_step(s_pp, *ppt.microbatch(toks, tgts))
+
+        lmt = LMTrainer(CausalLM(cfg), make_mesh(MeshConfig(dp=8)), tcfg,
+                        tx=optax.sgd(0.1))
+        s_lm = lmt.init_state(key)
+        s_lm, m_lm = lmt.train_step(s_lm, toks, tgts)
+
+        np.testing.assert_allclose(float(m_pp["loss"]),
+                                   float(m_lm["loss"]), atol=1e-5)
+        ref = stack_lm_params(s_lm.params, cfg.num_layers)
+        flat_p, _ = jax.tree_util.tree_flatten_with_path(s_pp.params)
+        flat_r = jax.tree.leaves(ref)
+        for (path, a), b in zip(flat_p, flat_r):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), atol=5e-5,
+                err_msg=jax.tree_util.keystr(path))
+
+    def test_bubble_and_validation(self):
+        import optax
+
+        from mpi_operator_tpu.train import (LMTrainerConfig,
+                                            PipelineLMTrainer)
+
+        cfg = gpt2_config("test", attention="dense", dtype=jnp.float32,
+                          vocab_size=64, max_len=16)
+        mesh = make_mesh(MeshConfig(pp=2, dp=4))
+        t = PipelineLMTrainer(cfg, mesh,
+                              LMTrainerConfig(global_batch_size=32,
+                                              seq_len=8),
+                              num_microbatches=8, tx=optax.sgd(0.1))
+        assert t.bubble == pytest.approx(1 / 9)
+        with pytest.raises(ValueError):    # M must divide over pp
+            PipelineLMTrainer(cfg, mesh,
+                              LMTrainerConfig(global_batch_size=24,
+                                              seq_len=8),
+                              num_microbatches=3, tx=optax.sgd(0.1))
+        with pytest.raises(ValueError):    # microbatch must divide over dp
+            PipelineLMTrainer(cfg, mesh,
+                              LMTrainerConfig(global_batch_size=16,
+                                              seq_len=8),
+                              num_microbatches=8, tx=optax.sgd(0.1))
