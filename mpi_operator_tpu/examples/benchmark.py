@@ -32,6 +32,7 @@ def run_benchmark(
     num_slices: int = 1,
     learning_rate: float = 0.1,
     data_dir: Optional[str] = None,
+    profile_dir: Optional[str] = None,
     log: Callable[[str], None] = print,
 ) -> Tuple[object, Dict[str, float]]:
     """Shared wiring for every benchmark surface (bench.py, the container
@@ -68,7 +69,8 @@ def run_benchmark(
             dtype=dtype, sharding=batch_sharding(mesh))
     try:
         return trainer.benchmark(state, dataset, num_steps=num_steps,
-                                 warmup_steps=warmup_steps, log=log)
+                                 warmup_steps=warmup_steps, log=log,
+                                 profile_dir=profile_dir)
     finally:
         if hasattr(dataset, "close"):
             dataset.close()
@@ -100,6 +102,9 @@ def main(argv=None) -> int:
     parser.add_argument("--train-dir", default=None,
                         help="checkpoint directory (orbax)")
     parser.add_argument("--learning-rate", type=float, default=0.1)
+    parser.add_argument("--profile-dir", default=None,
+                        help="write a jax.profiler trace of the first "
+                             "measurement window here (XProf format)")
     args = parser.parse_args(argv)
 
     from ..bootstrap import initialize
@@ -139,6 +144,7 @@ def main(argv=None) -> int:
             num_slices=info.num_slices,
             learning_rate=args.learning_rate,
             data_dir=args.data_dir,
+            profile_dir=args.profile_dir,
             log=print if info.is_coordinator else (lambda s: None))
 
         if args.train_dir:

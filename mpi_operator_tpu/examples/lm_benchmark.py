@@ -48,6 +48,7 @@ def run_lm_benchmark(
     remat: bool = False,
     remat_policy: str = "none",
     train_dir: Optional[str] = None,
+    profile_dir: Optional[str] = None,
     log: Callable[[str], None] = print,
 ) -> Tuple[object, Dict[str, float]]:
     """GPT-2 / BERT token-stream benchmark on a dcn×dp×fsdp×tp mesh."""
@@ -152,7 +153,7 @@ def run_lm_benchmark(
 
     state, metrics = trainer.benchmark(
         state, TokenStream(), num_steps=num_steps,
-        warmup_steps=warmup_steps, log=log)
+        warmup_steps=warmup_steps, log=log, profile_dir=profile_dir)
     if train_dir:
         from ..train.checkpoint import save_checkpoint
         save_checkpoint(train_dir, state)
@@ -231,6 +232,9 @@ def main(argv=None) -> int:
     parser.add_argument("--remat-policy", default="none",
                         choices=["none", "dots"])
     parser.add_argument("--train-dir", default=None)
+    parser.add_argument("--profile-dir", default=None,
+                        help="write a jax.profiler trace of the first "
+                             "measurement window here (XProf format)")
     args = parser.parse_args(argv)
 
     from ..bootstrap import initialize
@@ -264,7 +268,8 @@ def main(argv=None) -> int:
                 tp=args.tp, pp=args.pp, num_slices=info.num_slices,
                 attention=args.attention, remat=args.remat,
                 remat_policy=args.remat_policy,
-                train_dir=args.train_dir, log=log)
+                train_dir=args.train_dir,
+                profile_dir=args.profile_dir, log=log)
             headline = {"metric": f"{args.workload}_tokens_per_sec",
                         "value": round(metrics["tokens_per_sec"], 0),
                         "unit": "tokens/sec"}

@@ -28,6 +28,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from ..parallel.mesh import batch_spec
 from ..parallel.sharding import activation_rules_scope, shard_init
 from ..utils import flops
+from ..utils.profiling import WindowProfiler
 
 
 class LMTrainState(struct.PyTreeNode):
@@ -189,6 +190,7 @@ class LMTrainer:
 
     def benchmark(self, state, dataset, num_steps: int = 50,
                   warmup_steps: int = 5, log: Callable[[str], None] = print,
+                  profile_dir: Optional[str] = None,
                   ) -> Tuple[LMTrainState, Dict[str, float]]:
         """tokens/sec measurement, same windowed protocol as
         train.trainer.Trainer.benchmark (ref README.md:113-131 format)."""
@@ -204,18 +206,24 @@ class LMTrainer:
         tokens_per_step = cfg.global_batch_size * cfg.seq_len
         log_every = max(1, min(cfg.log_every, num_steps))
         windows = []
+        profiler = WindowProfiler(profile_dir, log)
+        profiler.start()
         t0 = time.perf_counter()
         wall0 = t0
-        for i in range(1, num_steps + 1):
-            batch = next(it)
-            state, metrics = self.train_step(state, *batch)
-            if i % log_every == 0:
-                loss = float(metrics["loss"])
-                t1 = time.perf_counter()
-                tps = tokens_per_step * log_every / (t1 - t0)
-                windows.append(tps)
-                log(f"{i}\ttokens/sec: {tps:.0f}\tloss: {loss:.3f}")
-                t0 = time.perf_counter()
+        try:
+            for i in range(1, num_steps + 1):
+                batch = next(it)
+                state, metrics = self.train_step(state, *batch)
+                if i % log_every == 0:
+                    loss = float(metrics["loss"])
+                    t1 = time.perf_counter()       # BEFORE the trace write
+                    profiler.stop_if_active()
+                    tps = tokens_per_step * log_every / (t1 - t0)
+                    windows.append(tps)
+                    log(f"{i}\ttokens/sec: {tps:.0f}\tloss: {loss:.3f}")
+                    t0 = time.perf_counter()
+        finally:
+            profiler.stop_if_active()
         steady = windows[1:] if len(windows) > 1 else windows
         tps = sum(steady) / len(steady)
         n = self.mesh.size

@@ -28,6 +28,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..parallel.mesh import batch_spec
 from ..utils import flops
+from ..utils.profiling import WindowProfiler
 
 
 class TrainState(struct.PyTreeNode):
@@ -162,6 +163,7 @@ class Trainer:
     def benchmark(self, state: TrainState, dataset, num_steps: int = 100,
                   warmup_steps: int = 10,
                   log: Callable[[str], None] = print,
+                  profile_dir: Optional[str] = None,
                   ) -> Tuple[TrainState, Dict[str, float]]:
         """Windowed throughput measurement, tf_cnn_benchmarks-style.
         Returns (final_state, metrics) — the input state is DONATED by the
@@ -195,20 +197,26 @@ class Trainer:
         float(metrics["loss"])       # true barrier (see docstring)
 
         window_ips = []
+        profiler = WindowProfiler(profile_dir, log)
+        profiler.start()
         wall0 = time.perf_counter()
         t0 = wall0
-        for i in range(1, num_steps + 1):
-            images, labels = next(it)
-            state, metrics = step_fn(state, images, labels)
-            if i % log_every == 0:
-                loss = float(metrics["loss"])      # sync: closes the window
-                t1 = time.perf_counter()
-                ips = self.config.global_batch_size * log_every \
-                    / (t1 - t0)
-                window_ips.append(ips)
-                # tf_cnn_benchmarks log format (ref README.md:113-125)
-                log(f"{i}\timages/sec: {ips:.1f}\tloss: {loss:.3f}")
-                t0 = time.perf_counter()           # fetch/log time excluded
+        try:
+            for i in range(1, num_steps + 1):
+                images, labels = next(it)
+                state, metrics = step_fn(state, images, labels)
+                if i % log_every == 0:
+                    loss = float(metrics["loss"])  # sync: closes the window
+                    t1 = time.perf_counter()       # BEFORE the trace write
+                    profiler.stop_if_active()
+                    ips = self.config.global_batch_size * log_every \
+                        / (t1 - t0)
+                    window_ips.append(ips)
+                    # tf_cnn_benchmarks log format (ref README.md:113-125)
+                    log(f"{i}\timages/sec: {ips:.1f}\tloss: {loss:.3f}")
+                    t0 = time.perf_counter()       # fetch/log time excluded
+        finally:
+            profiler.stop_if_active()
         final_loss = float(metrics["loss"])
         wall = time.perf_counter() - wall0
         steady = window_ips[1:] if len(window_ips) > 1 else window_ips

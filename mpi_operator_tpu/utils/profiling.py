@@ -1,0 +1,43 @@
+"""First-window benchmark profiling (jax.profiler / XProf).
+
+The reference has no profiling story at all (SURVEY §5 — glog only); this
+exposes per-op device timelines, HBM traffic, and MXU occupancy for the
+first measurement window of a benchmark loop. Kept as a tiny stateful
+helper so both trainers share the exact same start/stop discipline:
+
+  - the stop (which serializes the xplane file — real I/O) happens AFTER
+    the window's closing timestamp is taken, so trace writing is never
+    charged to reported throughput;
+  - callers wrap their loop in try/finally with `stop_if_active()` so an
+    exception mid-window can't leave the global profiler session running
+    (a leaked session makes every later start_trace raise).
+"""
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+
+class WindowProfiler:
+    def __init__(self, profile_dir: Optional[str],
+                 log: Callable[[str], None] = print):
+        self._dir = profile_dir
+        self._log = log
+        self._active = False
+
+    def start(self) -> None:
+        if self._dir and not self._active:
+            import jax
+
+            jax.profiler.start_trace(self._dir)
+            self._active = True
+
+    def stop_if_active(self) -> None:
+        if self._active:
+            import jax
+
+            jax.profiler.stop_trace()
+            self._active = False
+            self._log(f"profiler trace written to {self._dir}")
+
+
+__all__ = ["WindowProfiler"]

@@ -175,3 +175,18 @@ def test_allreduce_bench_curve_structure():
     curve = result["efficiency_curve"]
     assert set(curve) == {"2", "4", "8"}
     assert curve["2"] == 1.0
+
+
+def test_benchmark_profile_dir_writes_trace(tmp_path):
+    """SURVEY §5: the reference has no profiling story; ours writes an
+    XProf/xplane trace of the first measurement window on request."""
+    import glob
+
+    from mpi_operator_tpu.examples.benchmark import run_benchmark
+
+    _state, _metrics = run_benchmark(
+        model_name="resnet18", batch_per_device=2, num_steps=4,
+        warmup_steps=1, image_size=32, profile_dir=str(tmp_path),
+        log=lambda s: None)
+    traces = glob.glob(str(tmp_path / "**" / "*.xplane.pb"), recursive=True)
+    assert traces, "no xplane trace written"
