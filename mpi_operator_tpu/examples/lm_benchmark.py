@@ -47,6 +47,8 @@ def run_lm_benchmark(
     attention: str = "auto",
     remat: bool = False,
     remat_policy: str = "none",
+    moe_experts: int = 0,
+    ep: int = 1,
     train_dir: Optional[str] = None,
     profile_dir: Optional[str] = None,
     log: Callable[[str], None] = print,
@@ -61,13 +63,32 @@ def run_lm_benchmark(
     from ..train.lm_trainer import LMTrainer, LMTrainerConfig
 
     n = jax.device_count()
-    dp, tp = _lm_mesh_shape(n, tp, num_slices)
-    mesh = make_mesh(MeshConfig(dp=dp, tp=tp, dcn=num_slices))
+    if ep > 1 and not moe_experts:
+        raise ValueError("--ep needs --moe-experts (nothing to shard)")
+    if moe_experts and moe_experts % ep:
+        # the sharding rules silently REPLICATE a non-divisible expert dim
+        # (parallel/sharding._divisible_spec), which would mislabel a
+        # data-parallel run as expert-parallel — reject instead
+        raise ValueError(f"--moe-experts={moe_experts} must be divisible "
+                         f"by --ep={ep}")
+    if n % (tp * ep * num_slices):
+        raise ValueError(f"{n} devices not divisible by tp={tp} × ep={ep} "
+                         f"× slices={num_slices}")
+    dp, tp = _lm_mesh_shape(n, tp * ep, num_slices)
+    tp //= ep
+    mesh = make_mesh(MeshConfig(dp=dp, tp=tp, ep=ep, dcn=num_slices))
     dtype = jnp.bfloat16 if dtype_name == "bfloat16" else jnp.float32
 
     name = f"{workload}-{size}" if size else workload
+    overrides = {}
+    if moe_experts:
+        # expert-parallel MoE: every other block's FFN becomes a top-2
+        # mixture routed over the ep axis (parallel/moe.py); the trainer
+        # folds the load-balancing aux loss in automatically
+        overrides = dict(num_experts=moe_experts)
     model = create_lm(name, dtype=dtype, attention=attention, remat=remat,
-                      remat_policy=remat_policy, max_len=max(seq_len, 32))
+                      remat_policy=remat_policy, max_len=max(seq_len, 32),
+                      **overrides)
     cfg_vocab = model.config.vocab_size
     masked = workload == "bert"
 
@@ -84,6 +105,10 @@ def run_lm_benchmark(
             raise ValueError("--pp does not compose with --tp yet; the "
                              "stage body applies blocks without tensor-"
                              "parallel sharding rules")
+        if moe_experts or ep > 1:
+            raise ValueError("--pp does not compose with --moe-experts/"
+                             "--ep yet; the stage body applies dense "
+                             "blocks only")
         if train_dir:
             raise ValueError("--train-dir checkpointing is not wired for "
                              "--pp runs yet; drop one of the flags")
@@ -226,6 +251,11 @@ def main(argv=None) -> int:
     parser.add_argument("--tp", type=int, default=1)
     parser.add_argument("--pp", type=int, default=1,
                         help="GPipe pipeline stages (causal LM only)")
+    parser.add_argument("--moe-experts", type=int, default=0,
+                        help="replace every other FFN with an N-expert "
+                             "top-2 MoE (expert-parallel over ep)")
+    parser.add_argument("--ep", type=int, default=1,
+                        help="expert-parallel degree (shards MoE experts)")
     parser.add_argument("--attention", default="auto",
                         choices=["auto", "dense", "flash"])
     parser.add_argument("--remat", action="store_true")
@@ -265,7 +295,9 @@ def main(argv=None) -> int:
                 batch_per_device=args.batch_per_device or 8,
                 seq_len=args.seq_len, num_steps=args.num_steps,
                 warmup_steps=args.warmup_steps, dtype_name=args.dtype,
-                tp=args.tp, pp=args.pp, num_slices=info.num_slices,
+                tp=args.tp, pp=args.pp, moe_experts=args.moe_experts,
+                ep=args.ep,
+                num_slices=info.num_slices,
                 attention=args.attention, remat=args.remat,
                 remat_policy=args.remat_policy,
                 train_dir=args.train_dir,
