@@ -49,6 +49,7 @@ def run_lm_benchmark(
     remat_policy: str = "none",
     moe_experts: int = 0,
     ep: int = 1,
+    fused_xent: bool = False,
     train_dir: Optional[str] = None,
     profile_dir: Optional[str] = None,
     log: Callable[[str], None] = print,
@@ -91,10 +92,14 @@ def run_lm_benchmark(
                       **overrides)
     cfg_vocab = model.config.vocab_size
     masked = workload == "bert"
+    if fused_xent and masked:
+        raise ValueError("--fused-xent supports the causal LM only (BERT's "
+                         "MLM head has extra layers before the tied "
+                         "decoder)")
 
     global_batch = batch_per_device * n
     tcfg = LMTrainerConfig(global_batch_size=global_batch, seq_len=seq_len,
-                           masked_lm=masked)
+                           masked_lm=masked, fused_xent=fused_xent)
     if pp > 1:
         # GPipe over the pp axis: stage-sliced CausalLM with a pp-sharded
         # microbatch stream (train/pp_trainer.py). bert (masked) stays on
@@ -109,6 +114,9 @@ def run_lm_benchmark(
             raise ValueError("--pp does not compose with --moe-experts/"
                              "--ep yet; the stage body applies dense "
                              "blocks only")
+        if fused_xent:
+            raise ValueError("--fused-xent is not wired into the pipeline "
+                             "trainer; drop one of the flags")
         if train_dir:
             raise ValueError("--train-dir checkpointing is not wired for "
                              "--pp runs yet; drop one of the flags")
@@ -256,6 +264,11 @@ def main(argv=None) -> int:
                              "top-2 MoE (expert-parallel over ep)")
     parser.add_argument("--ep", type=int, default=1,
                         help="expert-parallel degree (shards MoE experts)")
+    parser.add_argument("--fused-xent", action="store_true",
+                        help="chunked tied-head cross-entropy: the full "
+                             "[B*S, vocab] logits never hit HBM - slower "
+                             "at small scale (~3%% recompute tax) but the "
+                             "memory headroom for long-seq/big-vocab runs")
     parser.add_argument("--attention", default="auto",
                         choices=["auto", "dense", "flash"])
     parser.add_argument("--remat", action="store_true")
@@ -296,7 +309,7 @@ def main(argv=None) -> int:
                 seq_len=args.seq_len, num_steps=args.num_steps,
                 warmup_steps=args.warmup_steps, dtype_name=args.dtype,
                 tp=args.tp, pp=args.pp, moe_experts=args.moe_experts,
-                ep=args.ep,
+                ep=args.ep, fused_xent=args.fused_xent,
                 num_slices=info.num_slices,
                 attention=args.attention, remat=args.remat,
                 remat_policy=args.remat_policy,

@@ -308,13 +308,20 @@ class CausalLM(nn.Module):
     config: TransformerConfig
 
     @nn.compact
-    def __call__(self, tokens):
+    def __call__(self, tokens, with_head: bool = True):
+        """with_head=False returns the backbone output h [B, S, E] instead
+        of logits — the chunked fused-xent path (train/lm_trainer.py)
+        consumes h + the wte table directly so the full [B·S, vocab]
+        logits never materialize in HBM. Both modes create identical
+        params (the tied head adds none)."""
         cfg = self.config
         B, S = tokens.shape
         wte = _embed(cfg, cfg.vocab_size, cfg.embed_dim, "wte", "vocab")
         wpe = _pos_embed(cfg, cfg.max_len)
         h = wte(tokens) + wpe(jnp.arange(S)[None])
         h = Backbone(cfg, name="backbone")(h)
+        if not with_head:
+            return h
         # tied LM head; bf16 MXU matmul, f32 accumulation (tied_logits)
         return tied_logits(h, wte, cfg)
 

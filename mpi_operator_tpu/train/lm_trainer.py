@@ -15,6 +15,7 @@ Remat: cfg.remat wraps each block in jax.checkpoint inside the model
 """
 from __future__ import annotations
 
+import math
 import time
 from dataclasses import dataclass
 from typing import Any, Callable, Dict, Optional, Tuple
@@ -23,6 +24,7 @@ import jax
 import jax.numpy as jnp
 import optax
 from flax import struct
+from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..parallel.mesh import batch_spec
@@ -57,6 +59,9 @@ class LMTrainerConfig:
     warmup_steps: int = 100
     moe_aux_weight: float = 0.01
     masked_lm: bool = False        # BERT-style objective over masked slots
+    # chunked tied-head xent (fused_lm_loss): the full [B*S, vocab] logits
+    # never hit HBM; causal models only (BERT's MLM head has extra layers)
+    fused_xent: bool = False
     log_every: int = 10
 
 
@@ -78,6 +83,50 @@ def lm_loss(logits, targets, mask=None):
         return losses.mean()
     denom = jnp.maximum(mask.sum(), 1)
     return (losses * mask).sum() / denom
+
+
+def fused_lm_loss(h, table, targets, mask=None, num_chunks: int = 8):
+    """Tied-head projection + softmax-xent, chunked over tokens so the full
+    [B·S, vocab] logits NEVER materialize in HBM.
+
+    The un-fused path writes the f32 logits (e.g. 1.65 GB for gpt2-medium
+    at batch 16 × seq 512), reads them through softmax, and — under the
+    dots remat policy — holds them as a forward→backward residual. Here a
+    `lax.scan` over token chunks computes each chunk's loss from a
+    transient [C, vocab] logits tile, and `jax.checkpoint` on the chunk
+    body makes the backward recompute that tile instead of saving it —
+    HBM traffic and the residual both shrink by num_chunks×.
+
+    h: [B, S, E] backbone output (CausalLM __call__ with_head=False);
+    table: the [V, E] tied embedding (params['wte']['embedding']).
+    Numerically equals lm_loss(tied_logits(h, wte), targets, mask).
+
+    Chunking is along the SEQUENCE axis only — the batch axis stays intact
+    so a dp/fsdp-sharded batch keeps its sharding through the scan (a
+    [B·S]-flattened chunking would force GSPMD to all-gather the whole
+    activation on every device). num_chunks degrades to gcd(num_chunks, S)
+    when S is not divisible (power-of-two seq lens keep all 8)."""
+    from ..models.transformer import _head_matmul
+
+    B, S, E = h.shape
+    num_chunks = math.gcd(num_chunks, S)
+    C = S // num_chunks
+    h_r = jnp.moveaxis(h.reshape(B, num_chunks, C, E), 1, 0)
+    t_r = jnp.moveaxis(targets.reshape(B, num_chunks, C), 1, 0)
+    m = (jnp.ones((B, S), jnp.float32) if mask is None
+         else mask.astype(jnp.float32))
+    m_r = jnp.moveaxis(m.reshape(B, num_chunks, C), 1, 0)
+    table = table.astype(h.dtype)
+
+    def chunk(carry, xs):
+        h_c, t_c, m_c = xs                             # [B, C, ...]
+        logits = _head_matmul(h_c, table)              # [B, C, V] transient
+        losses = optax.softmax_cross_entropy_with_integer_labels(logits, t_c)
+        return carry + (losses * m_c).sum(), None
+
+    total, _ = lax.scan(jax.checkpoint(chunk), jnp.zeros((), jnp.float32),
+                        (h_r, t_r, m_r))
+    return total / jnp.maximum(m_r.sum(), 1)
 
 
 class LMTrainer:
@@ -120,10 +169,23 @@ class LMTrainer:
             tx=self.tx, apply_fn=self.model.apply)
         return state
 
+    def _use_fused(self):
+        mcfg = getattr(self.model, "config", None)
+        return (self.config.fused_xent and mcfg is not None and mcfg.causal
+                and not self.config.masked_lm)
+
     def _loss_fn(self, params, tokens, targets, mask):
-        logits, interm = self.model.apply(
-            {"params": params}, tokens, mutable=["intermediates"])
-        loss = lm_loss(logits, targets, mask)
+        if self._use_fused():
+            h, interm = self.model.apply(
+                {"params": params}, tokens, with_head=False,
+                mutable=["intermediates"])
+            loss = fused_lm_loss(h, params["wte"]["embedding"], targets,
+                                 mask)
+            logits = None
+        else:
+            logits, interm = self.model.apply(
+                {"params": params}, tokens, mutable=["intermediates"])
+            loss = lm_loss(logits, targets, mask)
         aux = jax.tree.leaves(interm.get("intermediates", {}))
         if aux:
             loss = loss + self.config.moe_aux_weight * sum(
@@ -134,8 +196,13 @@ class LMTrainer:
         (loss, logits), grads = jax.value_and_grad(
             self._loss_fn, has_aux=True)(state.params, tokens, targets, mask)
         state = state.apply_gradients(grads)
-        acc = jnp.sum((jnp.argmax(logits, -1) == targets) * mask) \
-            / jnp.maximum(mask.sum(), 1)
+        if logits is None:
+            # fused path never materializes logits; accuracy is a
+            # diagnostic, not worth a second vocab projection
+            acc = jnp.full((), jnp.nan)
+        else:
+            acc = jnp.sum((jnp.argmax(logits, -1) == targets) * mask) \
+                / jnp.maximum(mask.sum(), 1)
         return state, {"loss": loss, "accuracy": acc}
 
     def compile_step(self):
@@ -268,4 +335,4 @@ def _opt_shardings(opt_abstract, params, param_sh, replicated):
 
 
 __all__ = ["LMTrainer", "LMTrainerConfig", "LMTrainState", "make_adamw",
-           "lm_loss"]
+           "lm_loss", "fused_lm_loss"]

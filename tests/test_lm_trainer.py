@@ -85,3 +85,35 @@ def test_optimizer_state_sharded_like_params():
            if hasattr(l, "shape") and l.shape == p.shape]
     assert mus, "no optimizer moment matching the param"
     assert mus[0].sharding == p.sharding
+
+
+def test_fused_xent_matches_unfused_step():
+    """fused_lm_loss must be numerically identical to the logits path —
+    same loss and same params after one step (chunked scan + checkpoint
+    changes memory behavior, never values)."""
+    import numpy as np
+    import optax
+    from flax.core import meta
+
+    from mpi_operator_tpu.models.transformer import CausalLM, gpt2_config
+    from mpi_operator_tpu.parallel import MeshConfig, make_mesh
+    from mpi_operator_tpu.train import LMTrainer, LMTrainerConfig
+
+    cfg = gpt2_config("test", attention="dense", dtype=jnp.float32,
+                      vocab_size=256, max_len=32)
+    toks = jax.random.randint(jax.random.PRNGKey(5), (8, 17), 0, 256)
+    toks, tgts = toks[:, :-1], toks[:, 1:]
+    mesh = make_mesh(MeshConfig(dp=8))
+    outs = {}
+    for fused in (False, True):
+        t = LMTrainer(CausalLM(cfg), mesh,
+                      LMTrainerConfig(global_batch_size=8, seq_len=16,
+                                      fused_xent=fused),
+                      tx=optax.sgd(0.1))
+        s = t.init_state(jax.random.PRNGKey(0))
+        s, m = t.train_step(s, toks, tgts)
+        outs[fused] = (float(m["loss"]), s.params)
+    assert abs(outs[True][0] - outs[False][0]) < 1e-5
+    for a, b in zip(jax.tree.leaves(outs[True][1]),
+                    jax.tree.leaves(outs[False][1])):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=2e-5)
