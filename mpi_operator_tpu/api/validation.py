@@ -74,9 +74,18 @@ def validate_spec(spec: TPUJobSpec) -> None:
     if spec.tpus is not None:
         if spec.tpus < 1:
             errs.append(f"spec.tpus must be >= 1, got {spec.tpus}")
-        elif not _valid_tpu_count(spec.tpus):
+        elif spec.num_slices >= 1 and spec.tpus % spec.num_slices:
             errs.append(
-                f"spec.tpus={spec.tpus} is not a valid v5e slice chip count "
+                f"spec.tpus={spec.tpus} does not divide into "
+                f"{spec.num_slices} slices"
+            )
+        elif not _valid_tpu_count(spec.tpus // max(spec.num_slices, 1)):
+            # the slice-shape constraint applies PER SLICE: tpus=512 over
+            # numSlices=2 is two valid v5e-256 slices
+            errs.append(
+                f"spec.tpus={spec.tpus} over numSlices={spec.num_slices} "
+                f"is {spec.tpus // max(spec.num_slices, 1)} chips per "
+                f"slice — not a valid v5e slice chip count "
                 f"{V5E_VALID_SLICE_CHIPS}"
             )
 
@@ -102,22 +111,37 @@ def validate_spec(spec: TPUJobSpec) -> None:
     if spec.slots_per_worker is not None and spec.slots_per_worker < 1:
         errs.append(f"spec.slotsPerWorker must be >= 1, got {spec.slots_per_worker}")
 
+    if spec.num_slices < 1:
+        errs.append(f"spec.numSlices must be >= 1, got {spec.num_slices}")
+
     if spec.slice_topology is not None:
         total = spec.tpus or spec.processing_units
-        valid_topos = V5E_TOPOLOGIES.get(total) if total else None
+        field = "spec.tpus" if spec.tpus is not None else \
+            "spec.processingUnits"
+        # sliceTopology describes ONE slice; a multi-slice job's chip
+        # count divides over numSlices first (e.g. tpus=64, numSlices=2 →
+        # two 4x8 v5e-32 slices joined over DCN)
+        per_slice = None
+        if total is not None and spec.num_slices >= 1:
+            if total % spec.num_slices:
+                if spec.tpus is None:   # tpus-mode already reported this
+                    errs.append(
+                        f"{field}={total} does not divide into "
+                        f"{spec.num_slices} slices"
+                    )
+            else:
+                per_slice = total // spec.num_slices
+        valid_topos = V5E_TOPOLOGIES.get(per_slice) if per_slice else None
         if valid_topos is not None and spec.slice_topology not in valid_topos:
             errs.append(
                 f"spec.sliceTopology={spec.slice_topology!r} does not match "
-                f"{total} chips; valid: {valid_topos}"
+                f"{per_slice} chips per slice; valid: {valid_topos}"
             )
-        elif valid_topos is None and total is not None:
+        elif valid_topos is None and per_slice is not None:
             errs.append(
-                f"no known v5e topology for {total} chips with an explicit "
-                f"sliceTopology"
+                f"no known v5e topology for {per_slice} chips per slice "
+                f"with an explicit sliceTopology"
             )
-
-    if spec.num_slices < 1:
-        errs.append(f"spec.numSlices must be >= 1, got {spec.num_slices}")
 
     if spec.backoff_limit is not None and spec.backoff_limit < 0:
         errs.append(f"spec.backoffLimit must be >= 0, got {spec.backoff_limit}")

@@ -95,3 +95,38 @@ def test_condition_transition_time_stable_when_unchanged():
     t0 = st.get_condition(COND_RUNNING).last_transition_time
     st.set_condition(JobCondition(COND_RUNNING, "True", reason="r"))
     assert st.get_condition(COND_RUNNING).last_transition_time == t0
+
+
+def test_example_manifests_validate():
+    """Every shipped examples/*.yaml must deserialize into a TPUJob whose
+    spec passes admission validation (the reference's examples are its
+    primary user documentation; shipping an invalid one would be a bug)."""
+    import glob
+    import os
+
+    import yaml
+
+    from mpi_operator_tpu.cluster.serialize import from_manifest
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    manifests = sorted(glob.glob(os.path.join(repo, "examples", "*.yaml")))
+    assert len(manifests) >= 8
+    for path in manifests:
+        with open(path) as f:
+            doc = yaml.safe_load(f)
+        job = from_manifest(doc)
+        validate_spec(job.spec)   # raises on violation
+
+
+def test_multislice_validation_is_per_slice():
+    """Slice-shape constraints apply PER SLICE: tpus=512 over 2 slices is
+    two valid v5e-256 slices; non-divisible counts fail at admission (the
+    SURVEY §7 hard part: invalid shapes must not reach runtime)."""
+    validate_spec(TPUJobSpec(tpus=512, num_slices=2,
+                             slice_topology="16x16"))
+    validate_spec(TPUJobSpec(tpus=96, num_slices=3, slice_topology="4x8"))
+    with pytest.raises(ValidationError, match="divide into 3"):
+        validate_spec(TPUJobSpec(tpus=64, num_slices=3))
+    with pytest.raises(ValidationError, match="processingUnits"):
+        validate_spec(TPUJobSpec(processing_units=9, num_slices=2,
+                                 slice_topology="2x2"))
