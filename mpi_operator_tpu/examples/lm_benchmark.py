@@ -117,9 +117,6 @@ def run_lm_benchmark(
         if fused_xent:
             raise ValueError("--fused-xent is not wired into the pipeline "
                              "trainer; drop one of the flags")
-        if train_dir:
-            raise ValueError("--train-dir checkpointing is not wired for "
-                             "--pp runs yet; drop one of the flags")
         from ..train.pp_trainer import PipelineLMTrainer
         if n % (pp * num_slices):
             raise ValueError(f"{n} devices not divisible by pp={pp}")
@@ -127,6 +124,8 @@ def run_lm_benchmark(
                                        dcn=num_slices))
         pp_trainer = PipelineLMTrainer(model.config, pp_mesh, tcfg)
         pp_state = pp_trainer.init_state(jax.random.PRNGKey(0))
+        from ..train.checkpoint import maybe_resume, maybe_save
+        pp_state = maybe_resume(train_dir, pp_state, log)
 
         class RawStream:
             def __init__(self):
@@ -140,18 +139,16 @@ def run_lm_benchmark(
                 return synthetic_token_batch(sub, global_batch, seq_len,
                                              cfg_vocab)
 
-        return pp_trainer.benchmark(pp_state, RawStream(),
-                                    num_steps=num_steps,
-                                    warmup_steps=warmup_steps, log=log)
+        pp_state, pp_metrics = pp_trainer.benchmark(
+            pp_state, RawStream(), num_steps=num_steps,
+            warmup_steps=warmup_steps, log=log)
+        maybe_save(train_dir, pp_state, log)
+        return pp_state, pp_metrics
     trainer = LMTrainer(model, mesh, tcfg)
     state = trainer.init_state(jax.random.PRNGKey(0))
 
-    if train_dir:
-        from ..train.checkpoint import latest_checkpoint, restore_checkpoint
-        latest = latest_checkpoint(train_dir)
-        if latest is not None:
-            state = restore_checkpoint(latest, state)
-            log(f"resumed from {latest} (step {int(state.step)})")
+    from ..train.checkpoint import maybe_resume, maybe_save
+    state = maybe_resume(train_dir, state, log)
 
     class TokenStream:
         def __init__(self):
@@ -187,9 +184,7 @@ def run_lm_benchmark(
     state, metrics = trainer.benchmark(
         state, TokenStream(), num_steps=num_steps,
         warmup_steps=warmup_steps, log=log, profile_dir=profile_dir)
-    if train_dir:
-        from ..train.checkpoint import save_checkpoint
-        save_checkpoint(train_dir, state)
+    maybe_save(train_dir, state, log)
     return state, metrics
 
 
@@ -224,21 +219,15 @@ def run_vit_benchmark(
                         image_size=image_size, num_classes=1000)
     trainer = Trainer(model, mesh, cfg)
     state = trainer.init_state(jax.random.PRNGKey(0))
-    if train_dir:
-        from ..train.checkpoint import latest_checkpoint, restore_checkpoint
-        latest = latest_checkpoint(train_dir)
-        if latest is not None:
-            state = restore_checkpoint(latest, state)
-            log(f"resumed from {latest} (step {int(state.step)})")
+    from ..train.checkpoint import maybe_resume, maybe_save
+    state = maybe_resume(train_dir, state, log)
     dataset = SyntheticImageDataset(
         global_batch, image_size=image_size, num_classes=1000,
         dtype=dtype, sharding=batch_sharding(mesh))
     state, metrics = trainer.benchmark(
         state, dataset, num_steps=num_steps, warmup_steps=warmup_steps,
         log=log)
-    if train_dir:
-        from ..train.checkpoint import save_checkpoint
-        save_checkpoint(train_dir, state)
+    maybe_save(train_dir, state, log)
     return state, metrics
 
 

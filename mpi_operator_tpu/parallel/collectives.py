@@ -54,6 +54,16 @@ def reduce_scatter(x, axis_name: str, axis: int = 0):
     return lax.psum_scatter(x, axis_name, scatter_dimension=axis, tiled=True)
 
 
+def alltoall(x, axis_name: str, split_axis: int = 0, concat_axis: int = 0):
+    """hvd.alltoall equivalent: split `x` along `split_axis` into one chunk
+    per rank, exchange, concatenate received chunks along `concat_axis`.
+    This is the MoE token-exchange primitive (parallel/moe.py routes with
+    it implicitly via sharded einsums); exposed here for Horovod-call-style
+    code."""
+    return lax.all_to_all(x, axis_name, split_axis=split_axis,
+                          concat_axis=concat_axis, tiled=True)
+
+
 def hierarchical_allreduce_mean(x, ici_axes: Sequence[str], dcn_axis: str):
     """Two-phase allreduce for multi-slice meshes: reduce-scatter over ICI,
     allreduce the shards over DCN, all-gather back over ICI. This is the
@@ -109,6 +119,6 @@ def sharded_allreduce_fn(mesh: Mesh, axis_names: Tuple[str, ...] = ("dp",)):
 
 __all__ = [
     "allreduce_mean", "allreduce_sum", "allgather", "broadcast",
-    "reduce_scatter", "hierarchical_allreduce_mean",
+    "reduce_scatter", "alltoall", "hierarchical_allreduce_mean",
     "allreduce_gradients", "sharded_allreduce_fn",
 ]

@@ -76,3 +76,26 @@ def restore_checkpoint(directory_or_path: str, state):
 
 
 __all__ = ["save_checkpoint", "restore_checkpoint", "latest_checkpoint"]
+
+
+def maybe_resume(train_dir, state, log=print):
+    """Restore the latest checkpoint under train_dir into `state` (no-op
+    when train_dir is falsy or empty). The single resume path every
+    benchmark entrypoint shares."""
+    if not train_dir:
+        return state
+    latest = latest_checkpoint(train_dir)
+    if latest is None:
+        return state
+    state = restore_checkpoint(latest, state)
+    log(f"resumed from {latest} (step {int(state.step)})")
+    return state
+
+
+def maybe_save(train_dir, state, log=print):
+    """Write a checkpoint when train_dir is set (collective across all
+    processes — see examples/benchmark.py for why every rank must call)."""
+    if not train_dir:
+        return
+    path = save_checkpoint(train_dir, state)
+    log(f"checkpoint written to {path}")

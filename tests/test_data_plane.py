@@ -190,3 +190,23 @@ def test_benchmark_profile_dir_writes_trace(tmp_path):
         log=lambda s: None)
     traces = glob.glob(str(tmp_path / "**" / "*.xplane.pb"), recursive=True)
     assert traces, "no xplane trace written"
+
+
+def test_alltoall_matches_transpose():
+    """alltoall over n ranks is a block transpose: rank i's j-th chunk
+    lands as rank j's i-th chunk."""
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    from mpi_operator_tpu.parallel import MeshConfig, make_mesh
+    from mpi_operator_tpu.parallel.collectives import alltoall
+
+    mesh = make_mesh(MeshConfig(dp=8))
+    x = jnp.arange(8 * 8 * 4, dtype=jnp.float32).reshape(8, 8, 4)
+    fn = shard_map(lambda s: alltoall(s[0], "dp")[None],
+                   mesh=mesh, in_specs=P("dp"), out_specs=P("dp"))
+    out = np.asarray(fn(x))
+    # global semantics: out[j, i*C:(i+1)*C] == x[i, j*C:(j+1)*C], C=1 row
+    ref = np.asarray(x).reshape(8, 8, 1, 4).transpose(1, 0, 2, 3) \
+        .reshape(8, 8, 4)
+    np.testing.assert_array_equal(out, ref)
