@@ -25,14 +25,6 @@ import sys
 from typing import Callable, Dict, Optional, Tuple
 
 
-def _lm_mesh_shape(n: int, tp: int, num_slices: int):
-    """dp fills whatever tp and dcn leave over."""
-    if n % (tp * num_slices):
-        raise ValueError(f"{n} devices not divisible by tp={tp} × "
-                         f"slices={num_slices}")
-    return n // (tp * num_slices), tp
-
-
 def run_lm_benchmark(
     workload: str = "gpt2",
     size: Optional[str] = None,
@@ -43,6 +35,7 @@ def run_lm_benchmark(
     dtype_name: str = "bfloat16",
     tp: int = 1,
     pp: int = 1,
+    sp: int = 1,
     num_slices: int = 1,
     attention: str = "auto",
     remat: bool = False,
@@ -72,12 +65,24 @@ def run_lm_benchmark(
         # data-parallel run as expert-parallel — reject instead
         raise ValueError(f"--moe-experts={moe_experts} must be divisible "
                          f"by --ep={ep}")
-    if n % (tp * ep * num_slices):
+    if n % (tp * ep * sp * num_slices):
         raise ValueError(f"{n} devices not divisible by tp={tp} × ep={ep} "
-                         f"× slices={num_slices}")
-    dp, tp = _lm_mesh_shape(n, tp * ep, num_slices)
-    tp //= ep
-    mesh = make_mesh(MeshConfig(dp=dp, tp=tp, ep=ep, dcn=num_slices))
+                         f"× sp={sp} × slices={num_slices}")
+    if sp > 1:
+        # context parallelism: seq sharded over sp, attention rings the K/V
+        # shards (parallel/ring_attention.py via the model's "ring" impl)
+        if seq_len % sp:
+            raise ValueError(f"--seq-len={seq_len} must be divisible by "
+                             f"--sp={sp}")
+        if attention == "auto":
+            attention = "ring"
+        elif attention != "ring":
+            raise ValueError(f"--sp={sp} shards the sequence axis; "
+                             f"--attention must be 'ring' (got "
+                             f"{attention!r})")
+    dp = n // (tp * ep * sp * num_slices)   # dp fills what the rest leaves
+    mesh = make_mesh(MeshConfig(dp=dp, tp=tp, ep=ep, sp=sp,
+                                dcn=num_slices))
     dtype = jnp.bfloat16 if dtype_name == "bfloat16" else jnp.float32
 
     name = f"{workload}-{size}" if size else workload
@@ -117,6 +122,9 @@ def run_lm_benchmark(
         if fused_xent:
             raise ValueError("--fused-xent is not wired into the pipeline "
                              "trainer; drop one of the flags")
+        if sp > 1:
+            raise ValueError("--pp does not compose with --sp yet; the "
+                             "stage body does not ring the sequence axis")
         from ..train.pp_trainer import PipelineLMTrainer
         if n % (pp * num_slices):
             raise ValueError(f"{n} devices not divisible by pp={pp}")
@@ -248,6 +256,10 @@ def main(argv=None) -> int:
     parser.add_argument("--tp", type=int, default=1)
     parser.add_argument("--pp", type=int, default=1,
                         help="GPipe pipeline stages (causal LM only)")
+    parser.add_argument("--sp", type=int, default=1,
+                        help="sequence/context-parallel degree: seq axis "
+                             "sharded over sp, ring attention over the sp "
+                             "ICI neighbors (long-context training)")
     parser.add_argument("--moe-experts", type=int, default=0,
                         help="replace every other FFN with an N-expert "
                              "top-2 MoE (expert-parallel over ep)")
@@ -259,7 +271,7 @@ def main(argv=None) -> int:
                              "at small scale (~3%% recompute tax) but the "
                              "memory headroom for long-seq/big-vocab runs")
     parser.add_argument("--attention", default="auto",
-                        choices=["auto", "dense", "flash"])
+                        choices=["auto", "dense", "flash", "ring"])
     parser.add_argument("--remat", action="store_true")
     parser.add_argument("--remat-policy", default="none",
                         choices=["none", "dots"])
@@ -297,7 +309,8 @@ def main(argv=None) -> int:
                 batch_per_device=args.batch_per_device or 8,
                 seq_len=args.seq_len, num_steps=args.num_steps,
                 warmup_steps=args.warmup_steps, dtype_name=args.dtype,
-                tp=args.tp, pp=args.pp, moe_experts=args.moe_experts,
+                tp=args.tp, pp=args.pp, sp=args.sp,
+                moe_experts=args.moe_experts,
                 ep=args.ep, fused_xent=args.fused_xent,
                 num_slices=info.num_slices,
                 attention=args.attention, remat=args.remat,

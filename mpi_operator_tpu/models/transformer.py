@@ -117,6 +117,16 @@ class Attention(nn.Module):
         return out
 
 
+def _axis_bound(name: str) -> bool:
+    """True when `name` is a live collective axis (we're tracing inside
+    shard_map/pmap over it)."""
+    try:
+        jax.lax.axis_size(name)
+        return True
+    except NameError:
+        return False
+
+
 def _attend(q, k, v, mask, cfg: TransformerConfig):
     """Dispatch to the configured attention implementation.
     q/k/v: [B, S, H, D]; returns [B, S, H, D].
@@ -135,17 +145,27 @@ def _attend(q, k, v, mask, cfg: TransformerConfig):
         from ..ops.attention import flash_attention
         return flash_attention(q, k, v, causal=cfg.causal, mask=mask)
     if impl == "ring":
-        from ..parallel.ring_attention import ring_attention_inner
-        # inside shard_map the seq dim is already the local shard
-        try:
+        from ..parallel.ring_attention import (ring_attention,
+                                               ring_attention_inner)
+        from ..parallel.sharding import current_mesh
+        if _axis_bound("sp"):
+            # already inside shard_map/pmap over sp: the seq dim is the
+            # local shard, run the ring body directly
             return ring_attention_inner(q, k, v, axis_name="sp",
                                         causal=cfg.causal)
-        except NameError as exc:
-            raise ValueError(
-                'attention="ring" requires execution inside shard_map/pmap '
-                'over an "sp" mesh axis (LMTrainer does not provide one); '
-                "use parallel.ring_attention(q, k, v, mesh) directly for "
-                "sequence-parallel long-context attention") from exc
+        mesh = current_mesh()
+        if mesh is not None and dict(mesh.shape).get("sp", 1) > 1:
+            # plain-jit caller (LMTrainer's step under
+            # activation_rules_scope): nest the shard_map wrapper — the
+            # seq-sharded residual stream ("seq"→"sp" activation rule)
+            # feeds the ring without a resharding gather
+            return ring_attention(q, k, v, mesh, causal=cfg.causal)
+        raise ValueError(
+            'attention="ring" needs either execution inside shard_map/pmap '
+            'over an "sp" mesh axis, or an ambient mesh with sp > 1 '
+            "(train under LMTrainer on a MeshConfig(sp=N) mesh; a "
+            "degenerate 1-device ring would deliver no context parallelism"
+            "); for direct use call parallel.ring_attention(q, k, v, mesh)")
     return dense_attention(q, k, v, mask=mask, causal=cfg.causal,
                            dtype=cfg.dtype)
 

@@ -320,9 +320,16 @@ def ring_attention(q, k, v, mesh: Mesh, axis_name: str = "sp",
                    causal: bool = True, impl: str = "auto"):
     """shard_map wrapper: q/k/v are global [B, S, H, D] arrays (sharded or
     not); the sequence dim is split over `axis_name` and attention runs as a
-    ring. Batch stays sharded over the data axes.
+    ring. Batch stays sharded over the data axes, heads over tp (each tp
+    rank rings its own head group — no tp collective, heads are
+    independent), so ring attention composes with tensor parallelism when
+    called under jit (models/transformer._attend does this for
+    attention="ring" inside LMTrainer's step).
     """
-    spec = P(("dcn", "dp", "fsdp"), axis_name, None, None)
+    H = q.shape[2]
+    tp = dict(mesh.shape).get("tp", 1)
+    heads_axis = "tp" if tp > 1 and H % tp == 0 else None
+    spec = P(("dcn", "dp", "fsdp"), axis_name, heads_axis, None)
     # On TPU the flash kernels' out_shapes carry vma annotations
     # (ops/attention._out_struct) so the default VMA checker passes. In
     # interpret mode (CPU tests) JAX's pallas HLO interpreter itself trips

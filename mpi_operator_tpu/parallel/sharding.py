@@ -25,6 +25,7 @@ XLA insert the collectives.
 """
 from __future__ import annotations
 
+import contextvars
 from typing import Any, Optional, Sequence, Tuple
 
 import jax
@@ -73,6 +74,19 @@ ACTIVATION_RULES: Tuple[Tuple[str, Any], ...] = (
 )
 
 
+# The mesh made ambient by activation_rules_scope. Model code that needs a
+# concrete Mesh at trace time (the ring-attention shard_map dispatch in
+# models/transformer._attend) reads it via current_mesh() instead of the
+# deprecated jax.interpreters.pxla.thread_resources channel.
+_ACTIVE_MESH: contextvars.ContextVar = contextvars.ContextVar(
+    "mpi_operator_tpu_active_mesh", default=None)
+
+
+def current_mesh() -> Optional[Mesh]:
+    """The Mesh of the innermost activation_rules_scope, or None."""
+    return _ACTIVE_MESH.get()
+
+
 def activation_rules_scope(mesh: Mesh):
     """Context under which the model's nn.with_logical_constraint calls
     resolve: the mesh set as the ambient device context + ACTIVATION_RULES
@@ -86,6 +100,8 @@ def activation_rules_scope(mesh: Mesh):
     # with_logical_constraint needs to resolve bare PartitionSpecs
     stack.enter_context(mesh)
     stack.enter_context(nn.logical_axis_rules(ACTIVATION_RULES))
+    token = _ACTIVE_MESH.set(mesh)
+    stack.callback(_ACTIVE_MESH.reset, token)
     return stack
 
 
@@ -179,5 +195,6 @@ def shard_init(model: nn.Module, mesh: Mesh, rng, *init_args,
     return variables, out_shardings
 
 
-__all__ = ["DEFAULT_RULES", "logical_to_spec", "logical_sharding",
-           "param_shardings", "shard_init", "unbox"]
+__all__ = ["DEFAULT_RULES", "activation_rules_scope", "current_mesh",
+           "logical_to_spec", "logical_sharding", "param_shardings",
+           "shard_init", "unbox"]

@@ -27,7 +27,7 @@ from flax import struct
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from ..parallel.mesh import batch_spec
+from ..parallel.mesh import BATCH_AXES, batch_spec
 from ..parallel.sharding import activation_rules_scope, shard_init
 from ..utils import flops
 from ..utils.profiling import WindowProfiler
@@ -142,15 +142,32 @@ class LMTrainer:
         self.mesh = mesh
         self.config = config or LMTrainerConfig()
         self.tx = tx or make_adamw(self.config)
-        self.batch_sharding = NamedSharding(mesh, batch_spec())
+        # [B, S] batches: batch over the data axes, seq over sp (context
+        # parallelism — attention="ring" rings the K/V shards; everything
+        # else in the model is position-wise so GSPMD shards it over seq
+        # for free). sp=1 meshes get the same spec, trivially.
+        sp = dict(mesh.shape).get("sp", 1)
+        if self.config.seq_len % max(sp, 1):
+            raise ValueError(
+                f"seq_len={self.config.seq_len} not divisible by the mesh's "
+                f"sp={sp}; context parallelism shards the sequence axis")
+        self.batch_sharding = NamedSharding(mesh, batch_spec(("sp",)))
         self.replicated = NamedSharding(mesh, P())
         self._step = None
         self._state_shardings = None
 
     def init_state(self, rng: jax.Array) -> LMTrainState:
         cfg = self.config
-        dummy = jnp.zeros((2, cfg.seq_len), jnp.int32)
-        variables, shardings = shard_init(self.model, self.mesh, rng, dummy)
+        # batch dim sized to the data-axes product: the nested ring
+        # shard_map (attention="ring") needs every global dim divisible by
+        # its mapped mesh axes, init included
+        nb = math.prod(self.mesh.shape[a] for a in BATCH_AXES)
+        dummy = jnp.zeros((max(2, nb), cfg.seq_len), jnp.int32)
+        # under the scope so attention="ring" can resolve the ambient mesh
+        # while tracing init (same context the step runs in)
+        with activation_rules_scope(self.mesh):
+            variables, shardings = shard_init(self.model, self.mesh, rng,
+                                              dummy)
         params = variables["params"]
         param_sh = shardings["params"]
 

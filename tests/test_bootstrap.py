@@ -263,3 +263,68 @@ def test_config_dir_fallback(tmp_path):
                         hostname="job-worker-1")
     assert info.coordinator_address == "cm-host:8476"
     assert info.num_processes == 2 and info.process_id == 1
+
+
+WORKER_SCRIPT = r'''
+import os, sys
+rank, port, repo = int(sys.argv[1]), sys.argv[2], sys.argv[3]
+# fresh process: force the host platform (one local device) before any
+# backend init, same channel as utils/hostplatform
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+import jax
+jax.config.update("jax_platforms", "cpu")
+# cross-process CPU collectives need the gloo transport (XLA CPU default
+# cannot psum across processes)
+jax.config.update("jax_cpu_collectives_implementation", "gloo")
+sys.path.insert(0, repo)
+from mpi_operator_tpu.bootstrap import initialize
+env = dict(os.environ)
+env["TPU_COORDINATOR_ADDRESS"] = "127.0.0.1:" + port
+env["TPU_NUM_PROCESSES"] = "2"
+info = initialize(env, hostname="e2e-worker-%d" % rank)
+assert info.process_id == rank, (info.process_id, rank)
+assert jax.process_count() == 2
+import jax.numpy as jnp
+out = jax.pmap(lambda x: jax.lax.psum(x, "i"), axis_name="i")(
+    jnp.ones((jax.local_device_count(),)))
+assert float(out[0]) == float(len(jax.devices())), float(out[0])
+print("rank %d psum ok" % rank, flush=True)
+'''
+
+
+def test_multiprocess_rendezvous_e2e(tmp_path):
+    """The full distributed-bootstrap slice as two REAL processes: the
+    controller's env contract (TPU_COORDINATOR_ADDRESS / TPU_NUM_PROCESSES)
+    plus StatefulSet-hostname rank derivation feed jax.distributed, and a
+    cross-process psum proves the collective fabric is live — the
+    capability the reference assembles from hostfile + kubexec + mpirun +
+    orted (ref mpi_job_controller.go:849-885, :1123-1131), with zero exec
+    machinery."""
+    import os
+    import socket
+    import subprocess
+    import sys
+
+    script = tmp_path / "worker.py"
+    script.write_text(WORKER_SCRIPT)
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    with socket.socket() as s:            # free port for the coordinator
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+
+    env = {k: v for k, v in os.environ.items() if k != "XLA_FLAGS"}
+    procs = [subprocess.Popen(
+        [sys.executable, str(script), str(rank), str(port), repo],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        env=env) for rank in (0, 1)]
+    outs = []
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=180)
+            outs.append(out)
+    finally:
+        for p in procs:
+            p.kill()
+    for rank, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"rank {rank} failed:\n{out}"
+        assert f"rank {rank} psum ok" in out
