@@ -48,6 +48,10 @@ def build_parser() -> argparse.ArgumentParser:
                    help="optional init-container image "
                         "(ref --kubectl-delivery-image; usually unneeded)")
     p.add_argument("--threadiness", type=int, default=2)
+    p.add_argument("--metrics-port", type=int, default=0,
+                   help="serve /metrics (Prometheus) and /healthz on this "
+                        "port (0 = disabled; the shipped Deployment sets "
+                        "8080 and probes /healthz)")
     p.add_argument("--demo", action="store_true",
                    help="run against the in-memory API server with a sample "
                         "TPUJob and simulated kubelet")
@@ -111,15 +115,30 @@ def main(argv=None, stop_event=None) -> int:
         signal.signal(signal.SIGTERM, lambda *_: stop.set())
         signal.signal(signal.SIGINT, lambda *_: stop.set())
 
+    def start_metrics(controller):
+        if args.metrics_port <= 0:
+            return None
+        from .controller.metrics import MetricsServer
+        server = MetricsServer(controller, port=args.metrics_port)
+        logging.getLogger("main").info(
+            "metrics/healthz on :%d", server.port)
+        return server
+
     if args.demo:
         api = InMemoryAPIServer()
         controller = TPUJobController(api, config=config)
-        controller.run(threadiness=args.threadiness, stop_event=stop)
+        metrics = None
         try:
+            # bind before run(): the probe target must exist while caches
+            # sync, and a bind failure must still tear the queue down
+            metrics = start_metrics(controller)
+            controller.run(threadiness=args.threadiness, stop_event=stop)
             return run_demo(controller, api)
         finally:
             stop.set()
             controller.queue.shut_down()
+            if metrics:
+                metrics.close()
 
     # Real-cluster mode (ref main.go:42-96): kubeconfig / --master /
     # in-cluster, then run until signaled.
@@ -139,13 +158,17 @@ def main(argv=None, stop_event=None) -> int:
     logging.getLogger("main").info(
         "starting TPUJob controller against %s (namespace=%s)",
         kube_config.server, config.namespace or "<all>")
-    controller.run(threadiness=args.threadiness, stop_event=stop)
+    metrics = None
     try:
+        metrics = start_metrics(controller)     # bind before cache sync
+        controller.run(threadiness=args.threadiness, stop_event=stop)
         stop.wait()                                        # run until signal
     finally:
         stop.set()
         api.stop()
         controller.queue.shut_down()
+        if metrics:
+            metrics.close()
     return 0
 
 

@@ -145,6 +145,8 @@ class TPUJobController:
         self.recorder = recorder or EventRecorder()
         self.factory = factory or InformerFactory(api_server, self.config.namespace)
         self.queue = RateLimitingQueue()
+        from .metrics import SyncCounters
+        self.sync_counters = SyncCounters()
 
         # Admission: reject invalid TPUJob specs at create/update, the CRD
         # openAPIV3-schema analogue (ref deploy/0-crd.yaml:16-99) — invalid
@@ -250,12 +252,23 @@ class TPUJobController:
         try:
             self.sync_handler(key)
             self.queue.forget(key)          # ref :399-404
+            self.sync_counters.record(ok=True)
         except Exception:                   # noqa: BLE001
             logger.exception("error syncing %s; requeuing", key)
             self.queue.add_rate_limited(key)
+            self.sync_counters.record(ok=False)
         finally:
             self.queue.done(key)
         return True
+
+    def workers_alive(self) -> bool:
+        """Liveness signal for /healthz: healthy while starting (run() not
+        yet called — the metrics server binds BEFORE run() so a slow
+        cache sync can't crash-loop the pod) and while every started
+        worker thread is alive; unhealthy once any worker has died."""
+        if not self._threads:
+            return True
+        return all(t.is_alive() for t in self._threads)
 
     # ------------------------------------------------------------------
     # THE core: sync_handler (ref syncHandler :420-520; SURVEY §3.2)

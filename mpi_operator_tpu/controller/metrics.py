@@ -1,0 +1,152 @@
+"""Operator observability: /metrics (Prometheus text format) + /healthz.
+
+The reference's only observability is glog to stderr and Kubernetes Events
+(SURVEY.md §5 — no pprof, no metrics server). This module is the TPU-native
+extension every production operator grows: a zero-dependency HTTP endpoint
+exposing the reconciler's vital signs, scrapeable by Prometheus and usable
+as a liveness probe.
+
+Exported series (all prefixed ``tpu_operator_``):
+  syncs_total            counter — sync_handler completions
+  sync_errors_total      counter — sync_handler raises (requeued with backoff)
+  workqueue_depth        gauge   — keys queued + rate-limit-delayed
+  jobs{phase=...}        gauge   — TPUJobs by condition-derived phase,
+                                   computed from the informer cache at scrape
+  gang_restarts_total    gauge   — sum of status.restart_count over jobs
+                                   (monotone per job; survives operator
+                                   restarts because it lives in job status)
+
+/healthz returns 200 while every worker thread is alive, 503 otherwise —
+wire it to the Deployment's livenessProbe so a wedged reconciler gets
+restarted instead of silently idling.
+"""
+from __future__ import annotations
+
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+from ..api import types as api
+
+#: phase precedence: terminal beats transitional beats initial
+_PHASES = (api.COND_SUCCEEDED, api.COND_FAILED, api.COND_RESTARTING,
+           api.COND_RUNNING, api.COND_CREATED)
+
+
+class SyncCounters:
+    """Thread-safe sync outcome counters (incremented by the run loop)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.syncs_total = 0
+        self.sync_errors_total = 0
+
+    def record(self, ok: bool) -> None:
+        with self._lock:
+            self.syncs_total += 1
+            if not ok:
+                self.sync_errors_total += 1
+
+    def snapshot(self):
+        with self._lock:
+            return self.syncs_total, self.sync_errors_total
+
+
+def job_phase(job) -> str:
+    """Condition-derived phase: the highest-precedence condition currently
+    True; "Pending" before the controller has written any."""
+    status = {c.type: c.status for c in job.status.conditions}
+    for phase in _PHASES:
+        if status.get(phase) in (True, "True"):
+            return phase
+    return "Pending"
+
+
+def render_metrics(controller) -> str:
+    """One Prometheus-text scrape of the controller's state. Gauges are
+    computed from the informer cache (deepcopy-free: read-only field
+    access on lister copies)."""
+    syncs, errors = controller.sync_counters.snapshot()
+    by_phase: dict = {}
+    restarts = 0
+    for job in controller.job_lister.list():
+        by_phase[job_phase(job)] = by_phase.get(job_phase(job), 0) + 1
+        restarts += job.status.restart_count
+    lines = [
+        "# HELP tpu_operator_syncs_total sync_handler completions",
+        "# TYPE tpu_operator_syncs_total counter",
+        f"tpu_operator_syncs_total {syncs}",
+        "# HELP tpu_operator_sync_errors_total sync_handler errors (requeued)",
+        "# TYPE tpu_operator_sync_errors_total counter",
+        f"tpu_operator_sync_errors_total {errors}",
+        "# HELP tpu_operator_workqueue_depth queued + rate-limit-delayed keys",
+        "# TYPE tpu_operator_workqueue_depth gauge",
+        f"tpu_operator_workqueue_depth {len(controller.queue)}",
+        "# HELP tpu_operator_jobs TPUJobs by phase",
+        "# TYPE tpu_operator_jobs gauge",
+    ]
+    # every phase is emitted, zero included — a vanishing series reads as
+    # "no data" in Prometheus, not as 0
+    for phase in (*_PHASES, "Pending"):
+        lines.append(f'tpu_operator_jobs{{phase="{phase}"}} '
+                     f"{by_phase.get(phase, 0)}")
+    lines += [
+        # gauge over currently-cached jobs (drops when a job is deleted),
+        # hence no _total suffix — that would invite rate() over a
+        # non-monotone series
+        "# HELP tpu_operator_job_restarts sum of restart counts over live jobs",
+        "# TYPE tpu_operator_job_restarts gauge",
+        f"tpu_operator_job_restarts {restarts}",
+    ]
+    return "\n".join(lines) + "\n"
+
+
+class MetricsServer:
+    """Serves /metrics and /healthz for a running TPUJobController in a
+    daemon thread. Port 0 picks a free port (tests); `.port` has the bound
+    value. close() is idempotent."""
+
+    def __init__(self, controller, port: int = 8080, host: str = ""):
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def do_GET(self):  # noqa: N802 — http.server API
+                if self.path == "/metrics":
+                    body = render_metrics(outer.controller).encode()
+                    self.send_response(200)
+                    self.send_header(
+                        "Content-Type",
+                        "text/plain; version=0.0.4; charset=utf-8")
+                elif self.path == "/healthz":
+                    healthy = outer.controller.workers_alive()
+                    body = (b"ok\n" if healthy else b"unhealthy\n")
+                    self.send_response(200 if healthy else 503)
+                    self.send_header("Content-Type", "text/plain")
+                else:
+                    body = b"not found\n"
+                    self.send_response(404)
+                    self.send_header("Content-Type", "text/plain")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *args):   # scrapes are not log events
+                pass
+
+        self.controller = controller
+        self._httpd = ThreadingHTTPServer((host, port), Handler)
+        self.port = self._httpd.server_address[1]
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, name="tpu-operator-metrics",
+            daemon=True)
+        self._thread.start()
+
+    def close(self) -> None:
+        try:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+        except OSError:
+            pass
+
+
+__all__ = ["MetricsServer", "SyncCounters", "job_phase", "render_metrics"]

@@ -637,3 +637,66 @@ def test_restart_policy_validation():
     from mpi_operator_tpu.api.validation import ValidationError, validate_spec
     with pytest.raises(ValidationError, match="restartPolicy"):
         validate_spec(new_job(tpus=8, restart_policy="Always").spec)
+
+
+def test_metrics_and_healthz_endpoints():
+    """Operator observability (extension over the reference, which has
+    glog only — SURVEY §5): /metrics exposes sync counters, queue depth,
+    and per-phase job gauges in Prometheus text format; /healthz tracks
+    reconciler-worker liveness (503 before run(), 200 after)."""
+    import urllib.error
+    from urllib.request import urlopen
+
+    from mpi_operator_tpu.controller.metrics import MetricsServer
+
+    f = Fixture()
+    f.seed(new_job("obs", tpus=8))
+    f.controller.enqueue_tpu_job(f.api.get(api.KIND, "default", "obs"))
+    assert f.controller.process_next_work_item(timeout=1.0)
+
+    server = MetricsServer(f.controller, port=0)
+    try:
+        base = f"http://127.0.0.1:{server.port}"
+        body = urlopen(base + "/metrics").read().decode()
+        assert "tpu_operator_syncs_total 1" in body
+        assert "tpu_operator_sync_errors_total 0" in body
+        assert "tpu_operator_workqueue_depth" in body
+        assert 'tpu_operator_jobs{phase="Created"} 1' in body
+        # zero phases are emitted too — a vanishing series reads as "no
+        # data", not 0
+        assert 'tpu_operator_jobs{phase="Failed"} 0' in body
+        assert "tpu_operator_job_restarts 0" in body
+
+        # healthy while starting (run() not yet called): the probe must not
+        # crash-loop a pod that is still syncing caches
+        assert urlopen(base + "/healthz").status == 200
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            urlopen(base + "/nope")
+        assert exc.value.code == 404
+
+        stop = f.controller.run(threadiness=1)
+        assert urlopen(base + "/healthz").status == 200
+        # dead worker threads flip liveness to 503
+        stop.set()
+        f.controller.queue.shut_down()
+        for t in f.controller._threads:
+            t.join(timeout=5)
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            urlopen(base + "/healthz")
+        assert exc.value.code == 503
+    finally:
+        server.close()
+
+
+def test_metrics_sync_error_counter():
+    """A failing sync (foreign-owned child → ForeignOwnershipError) lands in
+    sync_errors_total and the key re-enters the queue via the rate limiter."""
+    from mpi_operator_tpu.controller.metrics import render_metrics
+
+    f = Fixture()
+    f.seed(new_job(tpus=8))
+    f.seed(ConfigMap(metadata=_foreign_meta("test" + CONFIG_SUFFIX)))
+    f.controller.enqueue_tpu_job(f.api.get(api.KIND, "default", "test"))
+    assert f.controller.process_next_work_item(timeout=1.0)
+    body = render_metrics(f.controller)
+    assert "tpu_operator_sync_errors_total 1" in body
