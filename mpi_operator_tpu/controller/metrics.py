@@ -11,10 +11,12 @@ Exported series (all prefixed ``tpu_operator_``):
   sync_errors_total      counter — sync_handler raises (requeued with backoff)
   workqueue_depth        gauge   — keys queued + rate-limit-delayed
   jobs{phase=...}        gauge   — TPUJobs by condition-derived phase,
-                                   computed from the informer cache at scrape
-  gang_restarts_total    gauge   — sum of status.restart_count over jobs
-                                   (monotone per job; survives operator
-                                   restarts because it lives in job status)
+                                   computed from the informer cache at
+                                   scrape; every phase emitted (zero
+                                   included) so series never go stale
+  job_restarts           gauge   — sum of status.restart_count over
+                                   currently-cached jobs (drops when a job
+                                   is deleted — hence gauge, no _total)
 
 /healthz returns 200 while every worker thread is alive, 503 otherwise —
 wire it to the Deployment's livenessProbe so a wedged reconciler gets
@@ -70,7 +72,8 @@ def render_metrics(controller) -> str:
     by_phase: dict = {}
     restarts = 0
     for job in controller.job_lister.list():
-        by_phase[job_phase(job)] = by_phase.get(job_phase(job), 0) + 1
+        phase = job_phase(job)
+        by_phase[phase] = by_phase.get(phase, 0) + 1
         restarts += job.status.restart_count
     lines = [
         "# HELP tpu_operator_syncs_total sync_handler completions",

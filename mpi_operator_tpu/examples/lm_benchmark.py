@@ -43,6 +43,7 @@ def run_lm_benchmark(
     moe_experts: int = 0,
     ep: int = 1,
     fused_xent: bool = False,
+    accum_steps: int = 1,
     train_dir: Optional[str] = None,
     profile_dir: Optional[str] = None,
     log: Callable[[str], None] = print,
@@ -104,7 +105,8 @@ def run_lm_benchmark(
 
     global_batch = batch_per_device * n
     tcfg = LMTrainerConfig(global_batch_size=global_batch, seq_len=seq_len,
-                           masked_lm=masked, fused_xent=fused_xent)
+                           masked_lm=masked, fused_xent=fused_xent,
+                           accum_steps=accum_steps)
     if pp > 1:
         # GPipe over the pp axis: stage-sliced CausalLM with a pp-sharded
         # microbatch stream (train/pp_trainer.py). bert (masked) stays on
@@ -125,6 +127,10 @@ def run_lm_benchmark(
         if sp > 1:
             raise ValueError("--pp does not compose with --sp yet; the "
                              "stage body does not ring the sequence axis")
+        if accum_steps > 1:
+            raise ValueError("--accum-steps is redundant with --pp: the "
+                             "pipeline trainer already streams "
+                             "microbatches; drop the flag")
         from ..train.pp_trainer import PipelineLMTrainer
         if n % (pp * num_slices):
             raise ValueError(f"{n} devices not divisible by pp={pp}")
@@ -265,6 +271,10 @@ def main(argv=None) -> int:
                              "top-2 MoE (expert-parallel over ep)")
     parser.add_argument("--ep", type=int, default=1,
                         help="expert-parallel degree (shards MoE experts)")
+    parser.add_argument("--accum-steps", type=int, default=1,
+                        help="gradient accumulation: microbatches per "
+                             "optimizer step (activation memory / N, "
+                             "numerically identical update)")
     parser.add_argument("--fused-xent", action="store_true",
                         help="chunked tied-head cross-entropy: the full "
                              "[B*S, vocab] logits never hit HBM - slower "
@@ -312,6 +322,7 @@ def main(argv=None) -> int:
                 tp=args.tp, pp=args.pp, sp=args.sp,
                 moe_experts=args.moe_experts,
                 ep=args.ep, fused_xent=args.fused_xent,
+                accum_steps=args.accum_steps,
                 num_slices=info.num_slices,
                 attention=args.attention, remat=args.remat,
                 remat_policy=args.remat_policy,

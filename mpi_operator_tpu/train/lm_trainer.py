@@ -62,6 +62,13 @@ class LMTrainerConfig:
     # chunked tied-head xent (fused_lm_loss): the full [B*S, vocab] logits
     # never hit HBM; causal models only (BERT's MLM head has extra layers)
     fused_xent: bool = False
+    # gradient accumulation: split each global batch into `accum_steps`
+    # microbatches, lax.scan the fwd+bwd over them, apply ONE optimizer
+    # update on the summed gradient — numerically identical to the
+    # unaccumulated step because every microbatch objective is normalized
+    # by the FULL batch's mask count (masked objectives included; see
+    # _loss_fn), with activation memory divided by accum_steps
+    accum_steps: int = 1
     log_every: int = 10
 
 
@@ -75,17 +82,22 @@ def make_adamw(cfg: LMTrainerConfig) -> optax.GradientTransformation:
     )
 
 
-def lm_loss(logits, targets, mask=None):
+def lm_loss(logits, targets, mask=None, denom=None):
     """Token-level softmax cross-entropy; mask selects scored positions
-    (next-token LM passes all-ones, MLM passes the masked slots)."""
+    (next-token LM passes all-ones, MLM passes the masked slots). `denom`
+    overrides the normalizer (gradient accumulation passes the FULL-batch
+    mask count so microbatch grads sum to exactly the full-batch grad)."""
     losses = optax.softmax_cross_entropy_with_integer_labels(logits, targets)
-    if mask is None:
+    if mask is None and denom is None:
         return losses.mean()
-    denom = jnp.maximum(mask.sum(), 1)
-    return (losses * mask).sum() / denom
+    if mask is None:
+        mask = jnp.ones(losses.shape, jnp.float32)
+    d = denom if denom is not None else jnp.maximum(mask.sum(), 1)
+    return (losses * mask).sum() / d
 
 
-def fused_lm_loss(h, table, targets, mask=None, num_chunks: int = 8):
+def fused_lm_loss(h, table, targets, mask=None, num_chunks: int = 8,
+                  denom=None):
     """Tied-head projection + softmax-xent, chunked over tokens so the full
     [B·S, vocab] logits NEVER materialize in HBM.
 
@@ -126,7 +138,8 @@ def fused_lm_loss(h, table, targets, mask=None, num_chunks: int = 8):
 
     total, _ = lax.scan(jax.checkpoint(chunk), jnp.zeros((), jnp.float32),
                         (h_r, t_r, m_r))
-    return total / jnp.maximum(m_r.sum(), 1)
+    d = denom if denom is not None else jnp.maximum(m_r.sum(), 1)
+    return total / d
 
 
 class LMTrainer:
@@ -152,6 +165,15 @@ class LMTrainer:
                 f"seq_len={self.config.seq_len} not divisible by the mesh's "
                 f"sp={sp}; context parallelism shards the sequence axis")
         self.batch_sharding = NamedSharding(mesh, batch_spec(("sp",)))
+        A = self.config.accum_steps
+        nb = math.prod(mesh.shape[a] for a in BATCH_AXES)
+        if A < 1:
+            raise ValueError(f"accum_steps={A} must be >= 1")
+        if A > 1 and self.config.global_batch_size % (A * nb):
+            raise ValueError(
+                f"global_batch_size={self.config.global_batch_size} must "
+                f"split into accum_steps={A} microbatches of whole "
+                f"per-device shards (data-parallel degree {nb})")
         self.replicated = NamedSharding(mesh, P())
         self._step = None
         self._state_shardings = None
@@ -191,25 +213,61 @@ class LMTrainer:
         return (self.config.fused_xent and mcfg is not None and mcfg.causal
                 and not self.config.masked_lm)
 
-    def _loss_fn(self, params, tokens, targets, mask):
+    def _loss_fn(self, params, tokens, targets, mask, denom=None,
+                 aux_scale=1.0):
+        """`denom`/`aux_scale` support exact gradient accumulation: with
+        denom = the FULL-batch mask count and aux_scale = 1/accum_steps,
+        the SUM of microbatch gradients equals the full-batch gradient by
+        linearity — masked objectives included (each microbatch's own
+        mask.sum() would weight tokens unevenly)."""
         if self._use_fused():
             h, interm = self.model.apply(
                 {"params": params}, tokens, with_head=False,
                 mutable=["intermediates"])
             loss = fused_lm_loss(h, params["wte"]["embedding"], targets,
-                                 mask)
+                                 mask, denom=denom)
             logits = None
         else:
             logits, interm = self.model.apply(
                 {"params": params}, tokens, mutable=["intermediates"])
-            loss = lm_loss(logits, targets, mask)
+            loss = lm_loss(logits, targets, mask, denom=denom)
         aux = jax.tree.leaves(interm.get("intermediates", {}))
         if aux:
-            loss = loss + self.config.moe_aux_weight * sum(
+            loss = loss + aux_scale * self.config.moe_aux_weight * sum(
                 jnp.asarray(a).mean() for a in aux)
         return loss, logits
 
     def _step_fn(self, state: LMTrainState, tokens, targets, mask):
+        A = self.config.accum_steps
+        if A > 1:
+            B = tokens.shape[0]
+            # Each microbatch objective is normalized by the FULL batch's
+            # mask count (and aux scaled by 1/A), so summing microbatch
+            # grads reproduces the full-batch grad EXACTLY — masked
+            # objectives included. Batch stays the leading microbatch dim
+            # so the dp/fsdp sharding survives the reshape.
+            total = jnp.maximum(mask.sum(), 1.0)
+
+            def micro(carry, xs):
+                loss_sum, grad_sum = carry
+                t, g, m = xs
+                (loss, _), grads = jax.value_and_grad(
+                    self._loss_fn, has_aux=True)(
+                        state.params, t, g, m, denom=total,
+                        aux_scale=1.0 / A)
+                return (loss_sum + loss,
+                        jax.tree.map(jnp.add, grad_sum, grads)), None
+            zeros = jax.tree.map(jnp.zeros_like, state.params)
+            (loss_sum, grad_sum), _ = lax.scan(
+                micro, (jnp.zeros(()), zeros),
+                (tokens.reshape(A, B // A, *tokens.shape[1:]),
+                 targets.reshape(A, B // A, *targets.shape[1:]),
+                 mask.reshape(A, B // A, *mask.shape[1:])))
+            state = state.apply_gradients(grad_sum)
+            # accuracy would need the per-microbatch logits kept alive —
+            # defeats the memory point of accumulating
+            return state, {"loss": loss_sum,
+                           "accuracy": jnp.full((), jnp.nan)}
         (loss, logits), grads = jax.value_and_grad(
             self._loss_fn, has_aux=True)(state.params, tokens, targets, mask)
         state = state.apply_gradients(grads)

@@ -642,8 +642,9 @@ def test_restart_policy_validation():
 def test_metrics_and_healthz_endpoints():
     """Operator observability (extension over the reference, which has
     glog only — SURVEY §5): /metrics exposes sync counters, queue depth,
-    and per-phase job gauges in Prometheus text format; /healthz tracks
-    reconciler-worker liveness (503 before run(), 200 after)."""
+    and per-phase job gauges in Prometheus text format; /healthz reports
+    200 while starting AND while workers run (so a slow cache sync can't
+    crash-loop the pod), 503 once a worker thread has died."""
     import urllib.error
     from urllib.request import urlopen
 

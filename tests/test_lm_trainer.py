@@ -166,6 +166,76 @@ def test_ring_without_sp_context_raises():
         tr.init_state(jax.random.PRNGKey(0))
 
 
+def test_grad_accumulation_matches_single_step():
+    """accum_steps=2 must produce the SAME update as the unaccumulated
+    step on the same global batch: mean of microbatch mean-grads equals
+    the full-batch mean grad (linearity), so with sgd the params after one
+    optimizer step are identical."""
+    import optax
+
+    toks = jax.random.randint(jax.random.PRNGKey(1), (16, 32), 0, 128)
+    tgts = jnp.roll(toks, -1, axis=1)
+    outs = {}
+    for accum in (1, 2):
+        cfg = gpt2_config("test", attention="dense", dtype=jnp.float32,
+                          vocab_size=128, max_len=64)
+        tr = LMTrainer(CausalLM(cfg), make_mesh(MeshConfig(dp=8)),
+                       LMTrainerConfig(global_batch_size=16, seq_len=32,
+                                       accum_steps=accum),
+                       tx=optax.sgd(0.1))
+        state = tr.init_state(jax.random.PRNGKey(0))
+        state, m = tr.train_step(
+            state, jax.device_put(toks, tr.batch_sharding),
+            jax.device_put(tgts, tr.batch_sharding))
+        outs[accum] = (float(m["loss"]), state.params)
+    assert abs(outs[2][0] - outs[1][0]) < 1e-5
+    for a, b in zip(jax.tree.leaves(outs[2][1]),
+                    jax.tree.leaves(outs[1][1])):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=2e-5)
+
+
+def test_grad_accumulation_masked_lm_exact():
+    """The masked objective is the hard case: microbatches carry DIFFERENT
+    mask counts, so naive mean-of-means would weight tokens unevenly. The
+    fixed full-batch denominator makes accumulation exact — same params
+    after one sgd step."""
+    import optax
+
+    cfg = bert_config("test", attention="dense", dtype=jnp.float32,
+                      vocab_size=128, max_len=64)
+    toks = jax.random.randint(jax.random.PRNGKey(3), (16, 32), 0, 128)
+    # deliberately unbalanced mask: 12 scored slots in the first half of
+    # the batch, 4 in the second
+    mask = jnp.zeros((16, 32)).at[:8, ::3].set(1.0).at[8:, ::8].set(1.0)
+    outs = {}
+    for accum in (1, 2):
+        tr = LMTrainer(MaskedLM(cfg), make_mesh(MeshConfig(dp=8)),
+                       LMTrainerConfig(global_batch_size=16, seq_len=32,
+                                       masked_lm=True, accum_steps=accum),
+                       tx=optax.sgd(0.1))
+        state = tr.init_state(jax.random.PRNGKey(0))
+        state, m = tr.train_step(
+            state, jax.device_put(toks, tr.batch_sharding),
+            jax.device_put(toks, tr.batch_sharding),
+            jax.device_put(mask, tr.batch_sharding))
+        outs[accum] = (float(m["loss"]), state.params)
+    assert abs(outs[2][0] - outs[1][0]) < 1e-5
+    for a, b in zip(jax.tree.leaves(outs[2][1]),
+                    jax.tree.leaves(outs[1][1])):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=2e-5)
+
+
+def test_grad_accumulation_batch_validation():
+    import pytest
+
+    cfg = gpt2_config("test", attention="dense", dtype=jnp.float32,
+                      vocab_size=128, max_len=64)
+    with pytest.raises(ValueError, match="accum_steps"):
+        LMTrainer(CausalLM(cfg), make_mesh(MeshConfig(dp=8)),
+                  LMTrainerConfig(global_batch_size=12, seq_len=32,
+                                  accum_steps=2))   # 12 % (2*8) != 0
+
+
 def test_fused_xent_matches_unfused_step():
     """fused_lm_loss must be numerically identical to the logits path —
     same loss and same params after one step (chunked scan + checkpoint
