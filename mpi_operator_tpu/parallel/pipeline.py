@@ -32,6 +32,7 @@ Bubble fraction is (P-1)/(M+P-1); callers pick M >= 4*P to keep it small.
 from __future__ import annotations
 
 import functools
+import math
 from typing import Any, Callable
 
 import jax
@@ -197,7 +198,7 @@ def stack_lm_params(params, num_layers: int):
     }
 
 
-def _lm_pipeline_local(cfg, axis_name: str, M: int, pp_params,
+def _lm_pipeline_local(cfg, axis_name: str, M: int, psum_axes, pp_params,
                        tokens_local, targets_local):
     """Stage-sliced CausalLM forward + loss inside shard_map over pp.
 
@@ -207,9 +208,10 @@ def _lm_pipeline_local(cfg, axis_name: str, M: int, pp_params,
     activations, and no float cotangent chain in the backward); stage 0
     embeds at consumption. ln_f + tied head + xent run only on the last
     stage, inside `lax.cond`, so the vocab matmul is paid exactly M times.
-    Returns the total cross-entropy SUM over all scored tokens, already
-    psummed over pp (replicated); the caller divides by the static token
-    count."""
+    Returns the total cross-entropy SUM over all scored tokens, psummed
+    over `psum_axes` — pp alone when the microbatch dim is replicated, pp
+    plus the data axes when it is dp-sharded (pipeline_lm_loss picks); the
+    caller divides by the static global token count."""
     from ..models.transformer import Block, _head_matmul, _layer_norm
 
     n_stages = lax.axis_size(axis_name)
@@ -279,7 +281,7 @@ def _lm_pipeline_local(cfg, axis_name: str, M: int, pp_params,
     carry0 = (r_tok0, r_tgt0, act0, r_tgt0,
               jnp.zeros((), jnp.float32) + zero)
     (_, _, _, _, loss_sum), _ = lax.scan(tick, carry0, jnp.arange(T))
-    return lax.psum(loss_sum, axis_name)
+    return lax.psum(loss_sum, psum_axes)
 
 
 def pipeline_lm_loss(cfg, pp_params, tokens, targets, mesh: Mesh,
@@ -299,6 +301,18 @@ def pipeline_lm_loss(cfg, pp_params, tokens, targets, mesh: Mesh,
     if cfg.num_layers % n_stages:
         raise ValueError(f"num_layers={cfg.num_layers} must divide over "
                          f"pp={n_stages}")
+    # The microbatch dim shards over the data axes whenever it divides, so
+    # pp×dp genuinely splits the work (each dp rank pipelines its own slice
+    # of every microbatch); otherwise it replicates (tiny test shapes).
+    # The loss psum then spans pp AND the sharded data axes — the total is
+    # the global sum either way.
+    from .mesh import BATCH_AXES
+
+    data_deg = math.prod(mesh.shape[a] for a in BATCH_AXES)
+    shard_mb = data_deg > 1 and tokens.shape[1] % data_deg == 0
+    stream_spec = (P(axis_name, BATCH_AXES) if shard_mb
+                   else P(axis_name))
+    psum_axes = (axis_name, *BATCH_AXES) if shard_mb else (axis_name,)
     specs = {
         "wte": P(), "wpe": P(),
         "blocks": jax.tree.map(lambda _: P(axis_name),
@@ -311,9 +325,9 @@ def pipeline_lm_loss(cfg, pp_params, tokens, targets, mesh: Mesh,
     # itself prescribes this workaround. Correctness is pinned by the
     # grads-vs-unpiped parity test (tests/test_parallel.py TestPipelineLM).
     fn = shard_map(
-        functools.partial(_lm_pipeline_local, cfg, axis_name, M),
+        functools.partial(_lm_pipeline_local, cfg, axis_name, M, psum_axes),
         mesh=mesh,
-        in_specs=(specs, P(axis_name), P(axis_name)),
+        in_specs=(specs, stream_spec, stream_spec),
         out_specs=P(),
         check_vma=False,
     )

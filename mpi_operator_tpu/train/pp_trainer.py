@@ -10,8 +10,10 @@ every tensor where it belongs — each stage's optimizer update touches only
 its own L/P layer slice (the pp memory win extends to the optimizer).
 
 Composes with data axes: the microbatch dim of the token stream is sharded
-over (dcn, dp, fsdp) while the M dim is sharded over pp, so pp×dp runs
-without replicating either stream.
+over (dcn, dp, fsdp) while the M dim is sharded over pp (the trainer's
+mb % data_degree validation guarantees pipeline_lm_loss takes its
+dp-sharded path), so pp×dp runs without replicating either stream and the
+loss/grad psums span both axis groups.
 """
 from __future__ import annotations
 
