@@ -113,10 +113,6 @@ def run_lm_benchmark(
         # the unpiped trainer — the pipelined head is next-token xent.
         if masked:
             raise ValueError("--pp supports the causal LM (gpt2) only")
-        if tp > 1:
-            raise ValueError("--pp does not compose with --tp yet; the "
-                             "stage body applies blocks without tensor-"
-                             "parallel sharding rules")
         if moe_experts or ep > 1:
             raise ValueError("--pp does not compose with --moe-experts/"
                              "--ep yet; the stage body applies dense "
@@ -132,9 +128,12 @@ def run_lm_benchmark(
                              "pipeline trainer already streams "
                              "microbatches; drop the flag")
         from ..train.pp_trainer import PipelineLMTrainer
-        if n % (pp * num_slices):
-            raise ValueError(f"{n} devices not divisible by pp={pp}")
-        pp_mesh = make_mesh(MeshConfig(pp=pp, dp=n // (pp * num_slices),
+        if n % (pp * tp * num_slices):
+            raise ValueError(f"{n} devices not divisible by pp={pp} × "
+                             f"tp={tp} × slices={num_slices}")
+        # tp composes via GSPMD inside each stage (train/pp_trainer.py)
+        pp_mesh = make_mesh(MeshConfig(pp=pp, tp=tp,
+                                       dp=n // (pp * tp * num_slices),
                                        dcn=num_slices))
         pp_trainer = PipelineLMTrainer(model.config, pp_mesh, tcfg)
         pp_state = pp_trainer.init_state(jax.random.PRNGKey(0))

@@ -182,6 +182,37 @@ def stack_stage_params(per_stage_params):
 # Transformer integration: a stage-sliced GPT-2 with pipelined loss
 # ---------------------------------------------------------------------------
 
+def lm_stage_tp_specs(blocks, axis_name: str = "pp", tp_axis: str = "tp"):
+    """Megatron tensor-parallel PartitionSpecs for stack_lm_params' stacked
+    block leaves: column-parallel QKV + fc_in (output dim over tp),
+    row-parallel attn-out + fc_out (input dim over tp), everything else
+    pp-only on the layer dim. Used by PipelineLMTrainer to PLACE the
+    params; pipeline_lm_loss leaves tp to GSPMD (partial-manual shard_map)
+    so the Megatron collectives appear inside each stage tick
+    automatically."""
+    def spec(path, leaf):
+        ks = jax.tree_util.keystr(path)
+        mlp_in = "fc_in" in ks
+        mlp_out = "fc_out" in ks
+        qkv = any(k in ks for k in ("query", "key", "value"))
+        attn_out = "attn" in ks and "'out'" in ks
+        kernel = "kernel" in ks
+        if mlp_in and kernel:
+            return P(axis_name, None, tp_axis)
+        if mlp_in:                                    # bias [L, mlp]
+            return P(axis_name, tp_axis)
+        if mlp_out and kernel:                        # [L, mlp, E]
+            return P(axis_name, tp_axis, None)
+        if qkv and kernel:                            # [L, E, H, D]
+            return P(axis_name, None, tp_axis, None)
+        if qkv:                                       # bias [L, H, D]
+            return P(axis_name, tp_axis, None)
+        if attn_out and kernel:                       # [L, H, D, E]
+            return P(axis_name, tp_axis, None, None)
+        return P(axis_name)
+    return jax.tree_util.tree_map_with_path(spec, blocks)
+
+
 def stack_lm_params(params, num_layers: int):
     """Restack unboxed CausalLM params (models/transformer.py) into the
     pipeline layout: blocks stacked on a leading layer dim (sharded over
@@ -324,11 +355,18 @@ def pipeline_lm_loss(cfg, pp_params, tokens, targets, mesh: Mesh,
     # two branches get different inferred variance); the error message
     # itself prescribes this workaround. Correctness is pinned by the
     # grads-vs-unpiped parity test (tests/test_parallel.py TestPipelineLM).
+    #
+    # tp stays an AUTO axis (partial-manual shard_map): in_specs describe
+    # only the manual axes, and when the caller placed the block params
+    # with lm_stage_tp_specs, GSPMD partitions each stage tick over tp —
+    # the Megatron column/row collective pair inside the pipeline for free.
+    manual = frozenset(a for a in mesh.axis_names if a != "tp")
     fn = shard_map(
         functools.partial(_lm_pipeline_local, cfg, axis_name, M, psum_axes),
         mesh=mesh,
         in_specs=(specs, stream_spec, stream_spec),
         out_specs=P(),
+        axis_names=manual,
         check_vma=False,
     )
     loss_sum = fn(pp_params, tokens, targets)
@@ -336,4 +374,4 @@ def pipeline_lm_loss(cfg, pp_params, tokens, targets, mesh: Mesh,
 
 
 __all__ = ["pipeline_apply", "stack_stage_params", "stack_lm_params",
-           "pipeline_lm_loss", "bubble_fraction"]
+           "lm_stage_tp_specs", "pipeline_lm_loss", "bubble_fraction"]

@@ -42,7 +42,12 @@ class PPTrainState(struct.PyTreeNode):
 
 
 class PipelineLMTrainer:
-    """GPipe training over mesh axes pp × (dcn, dp, fsdp).
+    """GPipe training over mesh axes pp × tp × (dcn, dp, fsdp).
+
+    tp composes via GSPMD: the block params are PLACED with Megatron
+    shardings (lm_stage_tp_specs) and pipeline_lm_loss runs tp as an auto
+    axis, so each stage tick partitions its matmuls over tp with XLA
+    inserting the collective pair — no manual tp code in the schedule.
 
     num_microbatches M must divide over pp; pick M >= 4 × pp to keep the
     bubble (P-1)/(M+P-1) small (parallel/pipeline.bubble_fraction)."""
@@ -86,8 +91,18 @@ class PipelineLMTrainer:
     # -- initialization -----------------------------------------------------
 
     def _param_shardings(self, params):
+        from ..parallel.pipeline import lm_stage_tp_specs
+        from ..parallel.sharding import _divisible_spec
+
+        # blocks: layer dim over pp, plus Megatron tp on the mlp/attn dims
+        # when tp > 1 (pipeline_lm_loss leaves tp to GSPMD, so placement IS
+        # the activation of tensor parallelism). _divisible_spec replicates
+        # any dim tp doesn't divide (tiny test configs).
+        tp_specs = lm_stage_tp_specs(params["blocks"])
         blocks_sh = jax.tree.map(
-            lambda _: NamedSharding(self.mesh, P("pp")), params["blocks"])
+            lambda leaf, spec: NamedSharding(
+                self.mesh, _divisible_spec(self.mesh, spec, leaf.shape)),
+            params["blocks"], tp_specs)
         return {"wte": self.replicated, "wpe": self.replicated,
                 "blocks": blocks_sh,
                 "ln_f": jax.tree.map(lambda _: self.replicated,

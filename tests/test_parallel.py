@@ -315,7 +315,10 @@ class TestPipelineTrainer:
     """End-to-end pp training: one PipelineLMTrainer step must equal one
     LMTrainer step on the same init, batch, and optimizer."""
 
-    def test_one_step_matches_unpiped_trainer(self):
+    def _assert_matches_unpiped(self, mesh_cfg):
+        """One PipelineLMTrainer step on `mesh_cfg` vs one LMTrainer step
+        on a dp-only mesh: same loss, same params after sgd. Returns the
+        pipeline state for sharding asserts."""
         import optax
 
         from mpi_operator_tpu.parallel import stack_lm_params
@@ -330,10 +333,10 @@ class TestPipelineTrainer:
                                   cfg.vocab_size)
         toks, tgts = toks[:, :-1], toks[:, 1:]
 
-        ppt = PipelineLMTrainer(cfg, make_mesh(MeshConfig(pp=2, dp=4)),
-                                tcfg, num_microbatches=4,
-                                tx=optax.sgd(0.1))
+        ppt = PipelineLMTrainer(cfg, make_mesh(mesh_cfg), tcfg,
+                                num_microbatches=4, tx=optax.sgd(0.1))
         s_pp = ppt.init_state(key)
+        init_state = s_pp
         s_pp, m_pp = ppt.train_step(s_pp, *ppt.microbatch(toks, tgts))
 
         lmt = LMTrainer(CausalLM(cfg), make_mesh(MeshConfig(dp=8)), tcfg,
@@ -344,12 +347,33 @@ class TestPipelineTrainer:
         np.testing.assert_allclose(float(m_pp["loss"]),
                                    float(m_lm["loss"]), atol=1e-5)
         ref = stack_lm_params(s_lm.params, cfg.num_layers)
-        flat_p, _ = jax.tree_util.tree_flatten_with_path(s_pp.params)
-        flat_r = jax.tree.leaves(ref)
-        for (path, a), b in zip(flat_p, flat_r):
+        for (path, a), b in zip(
+                jax.tree_util.tree_flatten_with_path(s_pp.params)[0],
+                jax.tree.leaves(ref)):
             np.testing.assert_allclose(
                 np.asarray(a), np.asarray(b), atol=5e-5,
                 err_msg=jax.tree_util.keystr(path))
+        return init_state
+
+    def test_one_step_matches_unpiped_trainer(self):
+        self._assert_matches_unpiped(MeshConfig(pp=2, dp=4))
+
+    def test_pp_tp_composes_with_megatron_shardings(self):
+        """pp×tp×dp: block params placed with Megatron tp shardings
+        (lm_stage_tp_specs) while pipeline_lm_loss runs tp as a GSPMD auto
+        axis — the step must still equal the unpiped LMTrainer step, and
+        every Megatron leaf must ACTUALLY be tp-sharded (a param rename
+        that silently falls through lm_stage_tp_specs' path matching must
+        fail here, not quietly lose tensor parallelism)."""
+        s_pp = self._assert_matches_unpiped(MeshConfig(pp=2, tp=2, dp=2))
+        blocks = s_pp.params["blocks"]
+        for leaf in (blocks["mlp"]["fc_in"]["kernel"],
+                     blocks["mlp"]["fc_out"]["kernel"],
+                     blocks["attn"]["query"]["kernel"],
+                     blocks["attn"]["key"]["kernel"],
+                     blocks["attn"]["value"]["kernel"],
+                     blocks["attn"]["out"]["kernel"]):
+            assert "tp" in str(leaf.sharding.spec), leaf.sharding
 
     def test_bubble_and_validation(self):
         import optax
