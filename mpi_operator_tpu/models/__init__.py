@@ -5,3 +5,4 @@ from .transformer import (  # noqa: F401,E402
     CausalLM, MaskedLM, TransformerConfig, ViT, bert_config, create_lm,
     create_vit, gpt2_config, vit_config,
 )
+from .generate import GenerateResult, generate  # noqa: F401,E402

@@ -1,0 +1,126 @@
+"""Autoregressive text generation for CausalLM — KV-cache decode.
+
+The reference framework is training-only (its data plane is an opaque
+Horovod image, SURVEY.md §2.2); this is the inference half a complete
+framework needs, built TPU-first:
+
+- ONE jitted program for the whole generation: prefill (the full prompt in
+  a single call, filling the KV cache) followed by a `lax.scan` over the
+  decode steps — static shapes and trip count, so XLA compiles it once and
+  the MXU sees batched [B, 1, E] matmuls against the cached [B, L, H, D]
+  K/V instead of recomputing the prefix every token.
+- The cache lives in flax's "cache" collection (models/transformer.py
+  Attention._decode_attend); `decode=True` adds no parameters, so trained
+  LMTrainer params load directly.
+- Sampling: greedy (temperature=0) or temperature sampling via
+  jax.random.categorical; optional `eos_id` freezes finished rows (they
+  keep emitting eos and their logits are ignored).
+
+Usage:
+    model = CausalLM(gpt2_config("medium"))
+    out = generate(model, params, prompt_tokens, max_new_tokens=64)
+    # out.tokens: [B, prompt_len + max_new_tokens]
+"""
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+class GenerateResult(NamedTuple):
+    tokens: jax.Array          # [B, prompt_len + max_new_tokens]
+    logprobs: jax.Array        # [B, max_new_tokens] logprob of each choice
+
+
+def _sample(logits, greedy, temperature, rng):
+    """[B, V] logits → ([B] token, [B] logprob of the chosen token).
+    `greedy` is static (two programs: argmax vs sampling); `temperature`
+    is a traced operand so every nonzero value shares one compile."""
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32))
+    if greedy:
+        tok = jnp.argmax(logits, axis=-1)
+    else:
+        tok = jax.random.categorical(rng, logp / temperature)
+    return tok, jnp.take_along_axis(logp, tok[:, None], axis=-1)[:, 0]
+
+
+@partial(jax.jit, static_argnums=(0, 3, 6, 7))
+def _generate_jit(dmodel, params, prompt, max_new_tokens, temperature,
+                  rng, eos_id, greedy):
+    from .transformer import _head_matmul
+
+    B, P = prompt.shape
+    table = params["wte"]["embedding"].astype(dmodel.config.dtype)
+
+    # prefill: one multi-token call fills the cache; only the LAST
+    # position's logits are needed, so run the backbone head-free and pay
+    # the vocab matmul on h[:, -1:] alone (not the full [B, P, V] tensor)
+    h, vars_ = dmodel.apply(
+        {"params": params}, prompt, with_head=False, mutable=["cache"])
+    logits = _head_matmul(h[:, -1:], table)
+    cache = vars_["cache"]
+    rng, sub = jax.random.split(rng)
+    tok, logp = _sample(logits[:, -1], greedy, temperature, sub)
+    done = jnp.zeros((B,), bool)
+    if eos_id is not None:
+        done = tok == eos_id
+
+    def step(carry, i):
+        cache, tok, rng, done = carry
+        h, vars_ = dmodel.apply(
+            {"params": params, "cache": cache}, tok[:, None],
+            positions=(P + i)[None, None], with_head=False,
+            mutable=["cache"])
+        logits = _head_matmul(h, table)
+        rng, sub = jax.random.split(rng)
+        nxt, logp = _sample(logits[:, -1], greedy, temperature, sub)
+        if eos_id is not None:
+            nxt = jnp.where(done, eos_id, nxt)
+            logp = jnp.where(done, 0.0, logp)
+            done = done | (nxt == eos_id)
+        return (vars_["cache"], nxt, rng, done), (nxt, logp)
+
+    (_, _, _, _), (toks, logps) = lax.scan(
+        step, (cache, tok, rng, done), jnp.arange(max_new_tokens - 1))
+    all_new = jnp.concatenate([tok[:, None], toks.T], axis=1)
+    all_logp = jnp.concatenate([logp[:, None], logps.T], axis=1)
+    return GenerateResult(jnp.concatenate([prompt, all_new], axis=1),
+                          all_logp)
+
+
+def generate(model, params, prompt, max_new_tokens: int,
+             temperature: float = 0.0, rng: Optional[jax.Array] = None,
+             eos_id: Optional[int] = None) -> GenerateResult:
+    """Generate `max_new_tokens` continuations of `prompt` [B, P] int32.
+
+    model — a trained CausalLM (training config; this fn builds the
+    decode-mode twin). temperature=0 is greedy argmax; otherwise softmax
+    sampling at the given temperature using `rng`. `eos_id` freezes a row
+    once it emits that token.
+    """
+    cfg = model.config
+    if not cfg.causal:
+        raise ValueError("generate() needs a causal LM")
+    B, P = prompt.shape
+    if P + max_new_tokens > cfg.max_len:
+        raise ValueError(
+            f"prompt ({P}) + max_new_tokens ({max_new_tokens}) exceeds "
+            f"max_len={cfg.max_len} (the KV cache size)")
+    if max_new_tokens < 1:
+        raise ValueError("max_new_tokens must be >= 1")
+    if temperature > 0.0 and rng is None:
+        raise ValueError("temperature sampling needs an rng key")
+    dmodel = type(model)(dataclasses.replace(
+        cfg, decode=True, attention="dense", remat=False))
+    return _generate_jit(dmodel, params, prompt, int(max_new_tokens),
+                         jnp.float32(temperature),
+                         rng if rng is not None else jax.random.PRNGKey(0),
+                         eos_id, temperature == 0.0)
+
+
+__all__ = ["generate", "GenerateResult"]

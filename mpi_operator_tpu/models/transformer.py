@@ -50,6 +50,12 @@ class TransformerConfig:
     use_token_types: bool = False      # BERT segment embeddings
     dtype: Dtype = jnp.bfloat16
     attention: str = "auto"            # auto | dense | flash | ring
+    # autoregressive decode mode (models/generate.py): attention reads and
+    # appends to a [B, max_len, H, D] KV cache ("cache" collection) instead
+    # of attending within the input window. Training configs leave this
+    # False; generate() flips it on a config copy — no extra params either
+    # way, so trained params load directly.
+    decode: bool = False
     remat: bool = False                # jax.checkpoint each block
     # what remat may KEEP: "none" recomputes everything (min memory, ~2×
     # block fwd recompute); "dots" saves matmul outputs with no batch dims
@@ -105,7 +111,10 @@ class Attention(nn.Module):
         k = proj(name="key")(x)
         v = proj(name="value")(x)
 
-        out = _attend(q, k, v, mask=mask, cfg=cfg)
+        if cfg.decode:
+            out = self._decode_attend(q, k, v)
+        else:
+            out = _attend(q, k, v, mask=mask, cfg=cfg)
 
         out = nn.DenseGeneral(
             features=E, axis=(-2, -1), dtype=cfg.dtype, name="out",
@@ -115,6 +124,33 @@ class Attention(nn.Module):
                 nn.initializers.zeros, ("embed",)),
         )(out)
         return out
+
+    def _decode_attend(self, q, k, v):
+        """KV-cache attention for autoregressive decoding: append this
+        call's K/V at the cache cursor, attend q against everything
+        written so far (positions > cursor+S masked). Handles both the
+        multi-token prefill call and the steady-state single-token steps —
+        the cursor (`cache_index`) advances by the call's length."""
+        cfg = self.config
+        B, S, H, D = q.shape
+        L = cfg.max_len
+        ck = self.variable("cache", "cached_key", jnp.zeros,
+                           (B, L, H, D), k.dtype)
+        cv = self.variable("cache", "cached_value", jnp.zeros,
+                           (B, L, H, D), v.dtype)
+        ci = self.variable("cache", "cache_index",
+                           lambda: jnp.zeros((), jnp.int32))
+        cur = ci.value
+        ck.value = jax.lax.dynamic_update_slice(ck.value, k, (0, cur, 0, 0))
+        cv.value = jax.lax.dynamic_update_slice(cv.value, v, (0, cur, 0, 0))
+        ci.value = cur + S
+        pos = cur + jnp.arange(S)                     # query positions
+        logits = jnp.einsum("bqhd,bkhd->bhqk", q, ck.value)
+        logits = logits.astype(jnp.float32) / jnp.sqrt(D)
+        visible = jnp.arange(L)[None, :] <= pos[:, None]       # [S, L]
+        logits = jnp.where(visible[None, None], logits, -1e30)
+        probs = jax.nn.softmax(logits, axis=-1).astype(cfg.dtype)
+        return jnp.einsum("bhqk,bkhd->bqhd", probs, cv.value)
 
 
 def _axis_bound(name: str) -> bool:
@@ -328,17 +364,21 @@ class CausalLM(nn.Module):
     config: TransformerConfig
 
     @nn.compact
-    def __call__(self, tokens, with_head: bool = True):
+    def __call__(self, tokens, with_head: bool = True, positions=None):
         """with_head=False returns the backbone output h [B, S, E] instead
         of logits — the chunked fused-xent path (train/lm_trainer.py)
         consumes h + the wte table directly so the full [B·S, vocab]
         logits never materialize in HBM. Both modes create identical
-        params (the tied head adds none)."""
+        params (the tied head adds none). `positions` overrides the
+        default arange(S) position ids (decode steps pass the absolute
+        position of each token past the cached prefix)."""
         cfg = self.config
         B, S = tokens.shape
         wte = _embed(cfg, cfg.vocab_size, cfg.embed_dim, "wte", "vocab")
         wpe = _pos_embed(cfg, cfg.max_len)
-        h = wte(tokens) + wpe(jnp.arange(S)[None])
+        if positions is None:
+            positions = jnp.arange(S)[None]
+        h = wte(tokens) + wpe(positions)
         h = Backbone(cfg, name="backbone")(h)
         if not with_head:
             return h
