@@ -28,12 +28,12 @@ def main() -> None:
     parser = argparse.ArgumentParser()
     parser.add_argument("--workload", default="all",
                         choices=["all", "resnet", "gpt2", "bert", "vit",
-                                 "allreduce"],
+                                 "allreduce", "generate"],
                         help="all = resnet headline + gpt2 secondary (the "
                              "driver default); gpt2/bert/vit = the BASELINE "
                              "ladder individually; allreduce = the scaling-"
                              "efficiency microbenchmark (BASELINE ≥90% "
-                             "4→32)")
+                             "4→32); generate = KV-cache decode throughput")
     parser.add_argument("--model", default="resnet101")
     # resnet default 256/device is the single-chip throughput sweet spot on
     # v5e (measured: 64→1377, 128→1408, 256→1612, 512→1442 img/s); the
@@ -96,6 +96,24 @@ def main() -> None:
             "unit": "tokens/sec",
             "vs_baseline": 0.0,     # reference publishes no LM numbers
             **mfu_fields(metrics),
+        }))
+        return
+    if args.workload == "generate":
+        from mpi_operator_tpu.examples.lm_benchmark import (
+            run_generate_benchmark)
+        gm = run_generate_benchmark(
+            size="test" if args.smoke else None,
+            batch=2 if args.smoke else 8,
+            prompt_len=16 if args.smoke else 128,
+            new_tokens=8 if args.smoke else 128,
+            num_iters=1 if args.smoke else 8,
+            dtype_name=args.dtype,
+            log=lambda s: print(s, file=sys.stderr))
+        print(json.dumps({
+            "metric": "gpt2_decode_tokens_per_sec",
+            "value": round(gm["decode_tokens_per_sec"], 0),
+            "unit": "tokens/sec",
+            "vs_baseline": 0.0,     # reference has no inference path
         }))
         return
     if args.workload == "allreduce":

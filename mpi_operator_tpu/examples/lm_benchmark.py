@@ -201,6 +201,65 @@ def run_lm_benchmark(
     return state, metrics
 
 
+def run_generate_benchmark(
+    size: Optional[str] = None,
+    batch: int = 8,
+    prompt_len: int = 128,
+    new_tokens: int = 128,
+    # enough iterations to amortize the first call's dispatch overhead on
+    # the tunneled chip (3 iters under-reports by ~2×)
+    num_iters: int = 8,
+    dtype_name: str = "bfloat16",
+    temperature: float = 0.0,
+    log: Callable[[str], None] = print,
+) -> Dict[str, float]:
+    """Inference benchmark: KV-cache autoregressive decode throughput
+    (models/generate.py). Reports end-to-end NEW tokens/sec (prefill
+    amortized in) for the gpt2 ladder — the inference half the reference
+    has no analogue for."""
+    import time
+
+    import jax
+    import jax.numpy as jnp
+
+    from ..models import create_lm, generate
+    from ..parallel.sharding import shard_init
+    from ..parallel import MeshConfig, make_mesh
+
+    dtype = jnp.bfloat16 if dtype_name == "bfloat16" else jnp.float32
+    name = f"gpt2-{size}" if size else "gpt2"
+    model = create_lm(name, dtype=dtype,
+                      max_len=max(prompt_len + new_tokens, 32))
+    mesh = make_mesh(MeshConfig(dp=jax.device_count()))
+    variables, _ = shard_init(
+        model, mesh, jax.random.PRNGKey(0),
+        jnp.zeros((1, prompt_len), jnp.int32))
+    params = variables["params"]
+    prompt = jax.random.randint(jax.random.PRNGKey(1), (batch, prompt_len),
+                                0, model.config.vocab_size)
+
+    rng = jax.random.PRNGKey(2)
+    out = generate(model, params, prompt, new_tokens,
+                   temperature=temperature, rng=rng)       # compiles
+    # host read, not block_until_ready: on the tunneled TPU only a host
+    # read is a true barrier — otherwise compile+warmup leak into the
+    # timed window
+    int(out.tokens[0, -1])
+    t0 = time.perf_counter()
+    for i in range(num_iters):
+        out = generate(model, params, prompt, new_tokens,
+                       temperature=temperature,
+                       rng=jax.random.fold_in(rng, i))
+    int(out.tokens[0, -1])                 # host read = true barrier
+    dt = time.perf_counter() - t0
+    tps = batch * new_tokens * num_iters / dt
+    log(f"generate {name}: batch={batch} prompt={prompt_len} "
+        f"new={new_tokens}: {tps:.0f} new tokens/sec")
+    return {"decode_tokens_per_sec": tps,
+            "tokens_per_iter": batch * new_tokens,
+            "wall_seconds": dt}
+
+
 def run_vit_benchmark(
     size: str = "b16",
     batch_per_device: int = 32,

@@ -113,7 +113,10 @@ def generate(model, params, prompt, max_new_tokens: int,
             f"max_len={cfg.max_len} (the KV cache size)")
     if max_new_tokens < 1:
         raise ValueError("max_new_tokens must be >= 1")
-    if temperature > 0.0 and rng is None:
+    if temperature < 0.0:
+        raise ValueError(f"temperature={temperature} must be >= 0 "
+                         f"(0 = greedy)")
+    if temperature != 0.0 and rng is None:
         raise ValueError("temperature sampling needs an rng key")
     dmodel = type(model)(dataclasses.replace(
         cfg, decode=True, attention="dense", remat=False))

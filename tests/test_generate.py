@@ -62,3 +62,5 @@ def test_generate_validation():
         generate(model, params, prompt, max_new_tokens=10)
     with pytest.raises(ValueError, match="rng"):
         generate(model, params, prompt, max_new_tokens=2, temperature=0.5)
+    with pytest.raises(ValueError, match="temperature"):
+        generate(model, params, prompt, max_new_tokens=2, temperature=-0.7)
