@@ -55,7 +55,15 @@ def _generate_jit(dmodel, params, prompt, max_new_tokens, temperature,
     from .transformer import _head_matmul
 
     B, P = prompt.shape
-    table = params["wte"]["embedding"].astype(dmodel.config.dtype)
+    # Decode is HBM-bound: every step re-reads the whole parameter set, so
+    # cast the f32 master params to the compute dtype once up front
+    # (inside the jit — XLA does it on-device, once per call). Numerically
+    # identical to the per-op casts flax would do anyway.
+    dt = dmodel.config.dtype
+    params = jax.tree.map(
+        lambda x: x.astype(dt) if jnp.issubdtype(x.dtype, jnp.floating)
+        else x, params)
+    table = params["wte"]["embedding"]        # already cast to dt above
 
     # prefill: one multi-token call fills the cache; only the LAST
     # position's logits are needed, so run the backbone head-free and pay
