@@ -24,6 +24,23 @@ sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 REFERENCE_PER_DEVICE_IPS = 132.1      # ref README.md:113-125
 
 
+def retry_infra_once(fn):
+    """Run fn(); on an infrastructure-shaped failure (the tunneled chip's
+    compile service occasionally drops a connection mid-stream), retry
+    ONCE. Workload errors (OOM, shape bugs) re-raise immediately."""
+    try:
+        return fn()
+    except Exception as exc:  # noqa: BLE001
+        msg = str(exc)
+        if not any(s in msg for s in ("remote_compile", "INTERNAL",
+                                      "UNAVAILABLE")):
+            raise
+        print(f"# infra error, retrying once: {msg[:120]}", file=sys.stderr)
+        import jax
+        jax.clear_caches()
+        return fn()
+
+
 def main() -> None:
     parser = argparse.ArgumentParser()
     parser.add_argument("--workload", default="all",
@@ -67,15 +84,17 @@ def main() -> None:
         from mpi_operator_tpu.examples.lm_benchmark import run_lm_benchmark
         size = "test" if args.smoke else None
         # measured single-v5e sweet spot (gpt2-medium, seq 512): batch 16
-        # with dots-policy remat and 512-block flash — 39.1k tok/s vs 22.6k
-        # for batch 8 + full remat and 24.6k for batch 4 no-remat
-        _state, metrics = run_lm_benchmark(
+        # NO remat — 44.5k tok/s (49.7% MFU) vs 39.4k with dots-remat and
+        # 43.2k at batch 24; batch 32 no-remat OOMs. Flash attention +
+        # bf16 LM head leave enough HBM that recompute buys nothing at
+        # seq 512 (long-seq runs still want --remat).
+        _state, metrics = retry_infra_once(lambda: run_lm_benchmark(
             workload=workload, size=size,
             batch_per_device=2 if args.smoke else (batch or 16),
             seq_len=32 if args.smoke else 512,
             num_steps=steps, warmup_steps=warmup,
-            remat=not args.smoke, remat_policy="dots",
-            dtype_name=args.dtype, log=lambda s: print(s, file=sys.stderr))
+            remat=False,
+            dtype_name=args.dtype, log=lambda s: print(s, file=sys.stderr)))
         return metrics
 
     def mfu_fields(metrics):
@@ -101,14 +120,14 @@ def main() -> None:
     if args.workload == "generate":
         from mpi_operator_tpu.examples.lm_benchmark import (
             run_generate_benchmark)
-        gm = run_generate_benchmark(
+        gm = retry_infra_once(lambda: run_generate_benchmark(
             size="test" if args.smoke else None,
             batch=2 if args.smoke else 8,
             prompt_len=16 if args.smoke else 128,
             new_tokens=8 if args.smoke else 128,
             num_iters=1 if args.smoke else 8,
             dtype_name=args.dtype,
-            log=lambda s: print(s, file=sys.stderr))
+            log=lambda s: print(s, file=sys.stderr)))
         print(json.dumps({
             "metric": "gpt2_decode_tokens_per_sec",
             "value": round(gm["decode_tokens_per_sec"], 0),
@@ -119,10 +138,10 @@ def main() -> None:
     if args.workload == "allreduce":
         from mpi_operator_tpu.examples.allreduce_bench import (
             run_allreduce_benchmark)
-        result = run_allreduce_benchmark(
+        result = retry_infra_once(lambda: run_allreduce_benchmark(
             payload_mb=[0.25, 1.0] if args.smoke else [1.0, 16.0, 64.0],
             iters=3 if args.smoke else 10,
-            log=lambda s: print(s, file=sys.stderr))
+            log=lambda s: print(s, file=sys.stderr)))
         curve = result["efficiency_curve"]
         # a single visible device measures no ring at all — report that
         # honestly instead of fabricating a perfect score
@@ -138,12 +157,12 @@ def main() -> None:
         return
     if args.workload == "vit":
         from mpi_operator_tpu.examples.lm_benchmark import run_vit_benchmark
-        _state, metrics = run_vit_benchmark(
+        _state, metrics = retry_infra_once(lambda: run_vit_benchmark(
             size="test" if args.smoke else "b16",
             batch_per_device=args.batch_per_device if not args.smoke else 2,
             image_size=args.image_size if not args.smoke else 32,
             num_steps=args.steps, warmup_steps=args.warmup,
-            dtype_name=args.dtype, log=lambda s: print(s, file=sys.stderr))
+            dtype_name=args.dtype, log=lambda s: print(s, file=sys.stderr)))
         print(json.dumps({
             "metric": "vit_images_per_sec",
             "value": round(metrics["images_per_sec"], 2),
@@ -160,14 +179,17 @@ def main() -> None:
           f"global_batch={args.batch_per_device * n} dtype={args.dtype}",
           file=sys.stderr)
 
-    state, metrics = run_benchmark(
-        model_name=args.model,
-        batch_per_device=args.batch_per_device,
-        num_steps=args.steps,
-        warmup_steps=args.warmup,
-        image_size=args.image_size,
-        dtype_name=args.dtype,
-        log=lambda s: print(s, file=sys.stderr))
+    def measure():
+        return run_benchmark(
+            model_name=args.model,
+            batch_per_device=args.batch_per_device,
+            num_steps=args.steps,
+            warmup_steps=args.warmup,
+            image_size=args.image_size,
+            dtype_name=args.dtype,
+            log=lambda s: print(s, file=sys.stderr))
+
+    state, metrics = retry_infra_once(measure)
     # release the resnet train state before the secondary LM leg compiles,
     # or its params+optimizer pin HBM and the gpt2 run OOMs
     del state
