@@ -2,3 +2,4 @@ from .synthetic import (  # noqa: F401
     SyntheticImageDataset, synthetic_image_batch, synthetic_token_batch,
 )
 from .imagefolder import NpyImageDataset, write_npy_shard  # noqa: F401,E402
+from .tokenstream import NpyTokenDataset, write_token_shard  # noqa: F401,E402

@@ -11,13 +11,12 @@ host (SURVEY §6 guidance: minimise host↔device transfers on the timed path).
 from __future__ import annotations
 
 import os
-import threading
-from queue import Queue
-from typing import Iterator, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
 import numpy as np
+
+from .prefetch import PrefetchDataset
 
 # ImageNet channel stats, matching tf_cnn_benchmarks preprocessing
 _MEAN = np.array([0.485, 0.456, 0.406], np.float32) * 255.0
@@ -40,10 +39,11 @@ def discover_shards(data_dir: str):
     return pairs
 
 
-class NpyImageDataset:
+class NpyImageDataset(PrefetchDataset):
     """Infinite iterator over on-disk npy shards with one-batch device
-    prefetch. Deterministic shard order; within-shard batches are cut
-    sequentially (epoch reshuffle is a seed bump on the shard order)."""
+    prefetch (data/prefetch.py owns the feeder thread). Deterministic
+    shard order; within-shard batches are cut sequentially (epoch
+    reshuffle is a seed bump on the shard order)."""
 
     def __init__(self, data_dir: str, batch_size: int,
                  image_size: int = 224, dtype=jnp.bfloat16,
@@ -93,10 +93,7 @@ class NpyImageDataset:
                 if use_native == "always":
                     raise
                 self._native = None
-        self._queue: Queue = Queue(maxsize=prefetch)
-        self._stop = threading.Event()
-        self._thread = threading.Thread(target=self._feeder, daemon=True)
-        self._thread.start()
+        self._start_feeder(prefetch)
 
     # -- host side ---------------------------------------------------------
 
@@ -114,63 +111,23 @@ class NpyImageDataset:
                     yield (np.asarray(images[lo:lo + self.batch_size]),
                            np.asarray(labels[lo:lo + self.batch_size]))
 
-    def _put(self, item) -> bool:
-        """put that stays responsive to close(); False once stopped."""
-        from queue import Full
-        while not self._stop.is_set():
-            try:
-                self._queue.put(item, timeout=0.2)
-                return True
-            except Full:
-                continue
-        return False
-
-    def _feeder(self):
-        try:
-            if self._native is not None:
-                for images, labels in self._native:
-                    if self._stop.is_set():
-                        return
-                    batch = (jax.device_put(images, self._sharding),
-                             jax.device_put(labels, self._sharding))
-                    if not self._put(batch):
-                        return
-                return
-            for raw_images, raw_labels in self._host_batches():
-                if self._stop.is_set():
-                    return
-                x = (raw_images.astype(np.float32) - _MEAN) / _STD
-                batch = (
-                    jax.device_put(x.astype(np.dtype(self.dtype)),
-                                   self._sharding),
-                    jax.device_put(raw_labels.astype(np.int32),
-                                   self._sharding),
-                )
-                if not self._put(batch):
-                    return
-        except BaseException as e:          # surface in __next__, don't hang
-            self._put(e)
-
-    # -- iterator ----------------------------------------------------------
-
-    def __iter__(self) -> Iterator[Tuple[jax.Array, jax.Array]]:
-        return self
-
-    def __next__(self) -> Tuple[jax.Array, jax.Array]:
-        item = self._queue.get()
-        if isinstance(item, BaseException):
-            raise RuntimeError("data feeder thread failed") from item
-        return item
+    def _produce(self):
+        if self._native is not None:
+            for images, labels in self._native:
+                yield (jax.device_put(images, self._sharding),
+                       jax.device_put(labels, self._sharding))
+            return
+        for raw_images, raw_labels in self._host_batches():
+            x = (raw_images.astype(np.float32) - _MEAN) / _STD
+            yield (
+                jax.device_put(x.astype(np.dtype(self.dtype)),
+                               self._sharding),
+                jax.device_put(raw_labels.astype(np.int32),
+                               self._sharding),
+            )
 
     def close(self):
-        self._stop.set()
-        # unblock a feeder stuck in put() and let the thread exit
-        try:
-            while True:
-                self._queue.get_nowait()
-        except Exception:
-            pass
-        self._thread.join(timeout=2.0)
+        super().close()
         if self._native is not None:
             self._native.close()
 

@@ -1,0 +1,110 @@
+"""Real-data token pipeline for the LM workloads — the `--data-dir` path.
+
+Shard format: flat pre-tokenized corpora as `<stem>_tokens.npy` — a 1-D
+integer array per shard (the standard GPT-2-style packed binary, one long
+token stream per file). Batches are cut as contiguous `[B, seq_len + 1]`
+windows; `tokens = window[:, :-1]`, `targets = window[:, 1:]` (next-token
+objective), streamed with host→device prefetch (data/prefetch.py) so the
+feed overlaps the train step.
+
+The reference delegates all data handling to the workload image (SURVEY.md
+§2.2); this module plus data/imagefolder.py are the in-repo equivalents
+for the LM and image halves of the ladder.
+"""
+from __future__ import annotations
+
+import os
+
+import jax
+import numpy as np
+
+from .prefetch import PrefetchDataset
+
+
+def discover_token_shards(data_dir: str):
+    """Sorted `<stem>_tokens.npy` shard paths under data_dir."""
+    shards = [os.path.join(data_dir, f) for f in sorted(os.listdir(data_dir))
+              if f.endswith("_tokens.npy")]
+    if not shards:
+        raise FileNotFoundError(
+            f"no <stem>_tokens.npy shards in {data_dir!r}")
+    return shards
+
+
+class NpyTokenDataset(PrefetchDataset):
+    """Infinite (tokens [B, S], targets [B, S]) iterator over packed token
+    shards. Deterministic shuffled shard order per epoch; windows within a
+    shard are cut sequentially. `vocab_size` (when given) validates every
+    batch — an out-of-range id means the shards were tokenized for a
+    different vocabulary, which would otherwise surface as a garbage
+    gather or a silent wraparound."""
+
+    def __init__(self, data_dir: str, batch_size: int, seq_len: int,
+                 sharding=None, seed: int = 0, prefetch: int = 2,
+                 vocab_size=None, host_transform=None):
+        self.batch_size = batch_size
+        self.seq_len = seq_len
+        self.vocab_size = vocab_size
+        self._sharding = sharding
+        # host_transform(window [B, S+1] np.int32) -> tuple of np arrays,
+        # each device_put with `sharding`. Default: next-token split.
+        # Runs on the FEEDER thread before placement, so objectives that
+        # rewrite tokens (BERT's MLM corruption) stay off the timed path
+        # and the consumer only ever sees correctly-placed device arrays.
+        self._host_transform = host_transform or (
+            lambda win: (win[:, :-1], win[:, 1:]))
+        self._shards = discover_token_shards(data_dir)
+        self._seed = seed
+        window = seq_len + 1
+        max_rows = 0
+        for path in self._shards:
+            arr = np.load(path, mmap_mode="r")      # header read only
+            if arr.ndim != 1 or not np.issubdtype(arr.dtype, np.integer):
+                raise ValueError(
+                    f"shard {path!r} must be a 1-D integer token stream, "
+                    f"got shape {arr.shape} dtype {arr.dtype}")
+            max_rows = max(max_rows, arr.shape[0] // window)
+        if max_rows < batch_size:
+            raise ValueError(
+                f"every shard is shorter than one batch "
+                f"({max_rows} windows of {window} tokens < batch "
+                f"{batch_size}); no batch can ever be produced")
+        self._start_feeder(prefetch)
+
+    def _host_batches(self):
+        rng = np.random.RandomState(self._seed)
+        order = np.arange(len(self._shards))
+        window = self.seq_len + 1
+        while True:
+            rng.shuffle(order)
+            for si in order:
+                stream = np.load(self._shards[si], mmap_mode="r")
+                rows = stream.shape[0] // window
+                rows -= rows % self.batch_size
+                for lo in range(0, rows, self.batch_size):
+                    flat = np.asarray(
+                        stream[lo * window:(lo + self.batch_size) * window])
+                    yield flat.reshape(self.batch_size, window)
+
+    def _produce(self):
+        for win in self._host_batches():
+            if self.vocab_size is not None:
+                lo, hi = int(win.min()), int(win.max())
+                if lo < 0 or hi >= self.vocab_size:
+                    bad = lo if lo < 0 else hi
+                    raise ValueError(
+                        f"token id {bad} out of range for vocab_size="
+                        f"{self.vocab_size}; the shards were tokenized "
+                        f"for a different vocabulary")
+            win = win.astype(np.int32)
+            yield tuple(jax.device_put(a, self._sharding)
+                        for a in self._host_transform(win))
+
+
+def write_token_shard(data_dir: str, stem: str, tokens: np.ndarray) -> None:
+    """Helper for producing the shard format (tests, dataset prep)."""
+    os.makedirs(data_dir, exist_ok=True)
+    np.save(os.path.join(data_dir, f"{stem}_tokens.npy"), tokens)
+
+
+__all__ = ["NpyTokenDataset", "discover_token_shards", "write_token_shard"]

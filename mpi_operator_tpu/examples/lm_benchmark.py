@@ -24,6 +24,12 @@ import os
 import sys
 from typing import Callable, Dict, Optional, Tuple
 
+import numpy as np
+
+#: BERT MLM objective constants, shared by the synthetic and real-data
+#: paths so they stay comparable: corruption rate, mask id = vocab - 1
+MLM_MASK_RATE = 0.15
+
 
 def run_lm_benchmark(
     workload: str = "gpt2",
@@ -44,6 +50,7 @@ def run_lm_benchmark(
     ep: int = 1,
     fused_xent: bool = False,
     accum_steps: int = 1,
+    data_dir: Optional[str] = None,
     train_dir: Optional[str] = None,
     profile_dir: Optional[str] = None,
     log: Callable[[str], None] = print,
@@ -152,9 +159,23 @@ def run_lm_benchmark(
                 return synthetic_token_batch(sub, global_batch, seq_len,
                                              cfg_vocab)
 
-        pp_state, pp_metrics = pp_trainer.benchmark(
-            pp_state, RawStream(), num_steps=num_steps,
-            warmup_steps=warmup_steps, log=log)
+            def close(self):
+                pass
+
+        if data_dir:
+            from ..data.tokenstream import NpyTokenDataset
+            # flat [B, S] pairs; the trainer's microbatch() reshapes and
+            # the jitted step's in_shardings place them
+            pp_stream = NpyTokenDataset(data_dir, global_batch, seq_len,
+                                        vocab_size=cfg_vocab)
+        else:
+            pp_stream = RawStream()
+        try:
+            pp_state, pp_metrics = pp_trainer.benchmark(
+                pp_state, pp_stream, num_steps=num_steps,
+                warmup_steps=warmup_steps, log=log)
+        finally:
+            pp_stream.close()
         maybe_save(train_dir, pp_state, log)
         return pp_state, pp_metrics
     trainer = LMTrainer(model, mesh, tcfg)
@@ -180,7 +201,7 @@ def run_lm_benchmark(
                 # mask id (last vocab slot) — without the corruption the
                 # 'loss' is a degenerate copy objective
                 self._rng, msub = jax.random.split(self._rng)
-                mask = jax.random.uniform(msub, toks.shape) < 0.15
+                mask = jax.random.uniform(msub, toks.shape) < MLM_MASK_RATE
                 tgts = toks
                 toks = jnp.where(mask, cfg_vocab - 1, toks)
                 return (jax.device_put(toks, trainer.batch_sharding),
@@ -194,9 +215,34 @@ def run_lm_benchmark(
         def close(self):
             pass
 
-    state, metrics = trainer.benchmark(
-        state, TokenStream(), num_steps=num_steps,
-        warmup_steps=warmup_steps, log=log, profile_dir=profile_dir)
+    if data_dir:
+        from ..data.tokenstream import NpyTokenDataset
+        transform = None
+        if masked:
+            # MLM over the real stream: same objective constants as the
+            # synthetic branch above (MLM_MASK_RATE, mask id); numpy on
+            # the FEEDER thread so every output tensor is device_put with
+            # the trainer's sharding (eager jax ops on already-placed
+            # global arrays would break on multi-host)
+            mlm_rng = np.random.RandomState(3)
+
+            def transform(win):
+                toks = win[:, :-1]
+                mask = mlm_rng.random_sample(toks.shape) < MLM_MASK_RATE
+                return (np.where(mask, cfg_vocab - 1, toks).astype(np.int32),
+                        toks, mask.astype(np.float32))
+        stream = NpyTokenDataset(data_dir, global_batch, seq_len,
+                                 sharding=trainer.batch_sharding,
+                                 vocab_size=cfg_vocab,
+                                 host_transform=transform)
+    else:
+        stream = TokenStream()
+    try:
+        state, metrics = trainer.benchmark(
+            state, stream, num_steps=num_steps,
+            warmup_steps=warmup_steps, log=log, profile_dir=profile_dir)
+    finally:
+        stream.close()
     maybe_save(train_dir, state, log)
     return state, metrics
 
@@ -343,6 +389,10 @@ def main(argv=None) -> int:
     parser.add_argument("--remat", action="store_true")
     parser.add_argument("--remat-policy", default="none",
                         choices=["none", "dots"])
+    parser.add_argument("--data-dir", default=None,
+                        help="directory of <stem>_tokens.npy packed token "
+                             "shards (data/tokenstream.py); omit for the "
+                             "synthetic stream")
     parser.add_argument("--train-dir", default=None)
     parser.add_argument("--profile-dir", default=None,
                         help="write a jax.profiler trace of the first "
@@ -384,6 +434,7 @@ def main(argv=None) -> int:
                 num_slices=info.num_slices,
                 attention=args.attention, remat=args.remat,
                 remat_policy=args.remat_policy,
+                data_dir=args.data_dir,
                 train_dir=args.train_dir,
                 profile_dir=args.profile_dir, log=log)
             headline = {"metric": f"{args.workload}_tokens_per_sec",

@@ -212,3 +212,69 @@ class TestNativeLoader:
                    str(tmp_path / "s0_labels.npy"))]
         with pytest.raises(RuntimeError, match="u1 or f4"):
             NativeShardLoader(shards, batch_size=4, image_shape=(8, 8, 3))
+
+
+# ---------------------------------------------------------------------------
+# token-stream shards (data/tokenstream.py — the LM --data-dir path)
+# ---------------------------------------------------------------------------
+
+def test_token_dataset_window_alignment(tmp_path):
+    """Contiguous windows with next-token alignment: targets must be the
+    inputs shifted by one WITHIN each window, and windows must tile the
+    stream in order."""
+    from mpi_operator_tpu.data.tokenstream import (NpyTokenDataset,
+                                                   write_token_shard)
+    S, B = 8, 2
+    stream = np.arange(10_000, dtype=np.int64) % 97
+    write_token_shard(str(tmp_path), "s0", stream)
+    ds = NpyTokenDataset(str(tmp_path), batch_size=B, seq_len=S,
+                         vocab_size=97)
+    toks, tgts = next(ds)
+    assert toks.shape == (B, S) and tgts.shape == (B, S)
+    np.testing.assert_array_equal(np.asarray(toks)[:, 1:],
+                                  np.asarray(tgts)[:, :-1])
+    # first window starts at the stream head
+    np.testing.assert_array_equal(np.asarray(toks)[0], stream[:S])
+    np.testing.assert_array_equal(np.asarray(tgts)[0], stream[1:S + 1])
+    ds.close()
+
+
+def test_token_dataset_vocab_validation(tmp_path):
+    from mpi_operator_tpu.data.tokenstream import (NpyTokenDataset,
+                                                   write_token_shard)
+    write_token_shard(str(tmp_path), "s0",
+                      np.full((1000,), 500, dtype=np.int32))
+    ds = NpyTokenDataset(str(tmp_path), batch_size=2, seq_len=8,
+                         vocab_size=100)
+    with pytest.raises(RuntimeError, match="feeder"):
+        next(ds)                      # out-of-range ids surface, not gather
+    ds.close()
+
+
+def test_token_dataset_rejects_undersized_and_bad_shards(tmp_path):
+    from mpi_operator_tpu.data.tokenstream import (NpyTokenDataset,
+                                                   write_token_shard)
+    write_token_shard(str(tmp_path), "s0", np.arange(10, dtype=np.int32))
+    with pytest.raises(ValueError, match="shorter"):
+        NpyTokenDataset(str(tmp_path), batch_size=4, seq_len=8)
+    np.save(tmp_path / "bad_tokens.npy", np.zeros((4, 4), np.float32))
+    with pytest.raises(ValueError, match="integer"):
+        NpyTokenDataset(str(tmp_path), batch_size=1, seq_len=2)
+
+
+def test_lm_benchmark_with_data_dir(tmp_path):
+    """End-to-end: gpt2 and bert (MLM corruption wrapper) train from real
+    token shards through the shipped benchmark entrypoint."""
+    from mpi_operator_tpu.data.tokenstream import write_token_shard
+    from mpi_operator_tpu.examples.lm_benchmark import run_lm_benchmark
+
+    rng = np.random.RandomState(0)
+    write_token_shard(str(tmp_path), "s0",
+                      rng.randint(0, 128, 200_000).astype(np.uint16))
+    for workload in ("gpt2", "bert"):
+        _state, metrics = run_lm_benchmark(
+            workload=workload, size="test", batch_per_device=1,
+            seq_len=32, num_steps=3, warmup_steps=1,
+            dtype_name="float32", data_dir=str(tmp_path),
+            log=lambda s: None)
+        assert np.isfinite(metrics["final_loss"])
