@@ -38,6 +38,7 @@ def run_lm_benchmark(
     seq_len: int = 512,
     num_steps: int = 50,
     warmup_steps: int = 5,
+    eval_steps: int = 0,
     dtype_name: str = "bfloat16",
     tp: int = 1,
     pp: int = 1,
@@ -134,6 +135,9 @@ def run_lm_benchmark(
             raise ValueError("--accum-steps is redundant with --pp: the "
                              "pipeline trainer already streams "
                              "microbatches; drop the flag")
+        if eval_steps:
+            raise ValueError("--eval-steps is not wired into the pipeline "
+                             "trainer; drop one of the flags")
         from ..train.pp_trainer import PipelineLMTrainer
         if n % (pp * tp * num_slices):
             raise ValueError(f"{n} devices not divisible by pp={pp} × "
@@ -163,10 +167,18 @@ def run_lm_benchmark(
                 pass
 
         if data_dir:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
             from ..data.tokenstream import NpyTokenDataset
-            # flat [B, S] pairs; the trainer's microbatch() reshapes and
-            # the jitted step's in_shardings place them
+            # flat [B, S] pairs placed with B over (pp, data axes): the
+            # trainer's microbatch() reshape splits B into [M, mb] with M
+            # landing on pp and mb on the data axes — exactly the step's
+            # in_shardings, so no resharding (and no single-device
+            # device_put that would break multi-host)
+            flat_sharding = NamedSharding(
+                pp_mesh, P(("pp", "dcn", "dp", "fsdp")))
             pp_stream = NpyTokenDataset(data_dir, global_batch, seq_len,
+                                        sharding=flat_sharding,
                                         vocab_size=cfg_vocab)
         else:
             pp_stream = RawStream()
@@ -241,6 +253,15 @@ def run_lm_benchmark(
         state, metrics = trainer.benchmark(
             state, stream, num_steps=num_steps,
             warmup_steps=warmup_steps, log=log, profile_dir=profile_dir)
+        if eval_steps:
+            # evaluation continues the stream past the trained batches —
+            # fresh batches for synthetic/large-shard runs; point
+            # --data-dir at held-out shards for a true validation set
+            ev = trainer.evaluate(state, stream, num_batches=eval_steps)
+            metrics.update(ev)
+            log(f"val_loss: {ev['val_loss']:.3f}  "
+                f"perplexity: {ev['perplexity']:.1f}  "
+                f"({eval_steps} batches)")
     finally:
         stream.close()
     maybe_save(train_dir, state, log)
@@ -314,11 +335,13 @@ def run_vit_benchmark(
     warmup_steps: int = 5,
     dtype_name: str = "bfloat16",
     num_slices: int = 1,
+    data_dir: Optional[str] = None,
     train_dir: Optional[str] = None,
     log: Callable[[str], None] = print,
 ) -> Tuple[object, Dict[str, float]]:
     """ViT-B/16 image benchmark; --num-slices 2 is the BASELINE multi-slice
-    config (hierarchical allreduce across the dcn axis)."""
+    config (hierarchical allreduce across the dcn axis). data_dir streams
+    npy image shards (data/imagefolder.py) instead of synthetic data."""
     import jax
     import jax.numpy as jnp
 
@@ -339,12 +362,22 @@ def run_vit_benchmark(
     state = trainer.init_state(jax.random.PRNGKey(0))
     from ..train.checkpoint import maybe_resume, maybe_save
     state = maybe_resume(train_dir, state, log)
-    dataset = SyntheticImageDataset(
-        global_batch, image_size=image_size, num_classes=1000,
-        dtype=dtype, sharding=batch_sharding(mesh))
-    state, metrics = trainer.benchmark(
-        state, dataset, num_steps=num_steps, warmup_steps=warmup_steps,
-        log=log)
+    if data_dir is not None:
+        from ..data.imagefolder import NpyImageDataset
+        dataset = NpyImageDataset(
+            data_dir, global_batch, image_size=image_size, dtype=dtype,
+            sharding=batch_sharding(mesh))
+    else:
+        dataset = SyntheticImageDataset(
+            global_batch, image_size=image_size, num_classes=1000,
+            dtype=dtype, sharding=batch_sharding(mesh))
+    try:
+        state, metrics = trainer.benchmark(
+            state, dataset, num_steps=num_steps, warmup_steps=warmup_steps,
+            log=log)
+    finally:
+        if hasattr(dataset, "close"):
+            dataset.close()
     maybe_save(train_dir, state, log)
     return state, metrics
 
@@ -361,6 +394,9 @@ def main(argv=None) -> int:
     parser.add_argument("--image-size", type=int, default=224)
     parser.add_argument("--num-steps", type=int, default=50)
     parser.add_argument("--warmup-steps", type=int, default=5)
+    parser.add_argument("--eval-steps", type=int, default=0,
+                        help="after training, report val_loss/perplexity "
+                             "over N held-out batches (gpt2/bert only)")
     parser.add_argument("--dtype", default="bfloat16",
                         choices=["bfloat16", "float32"])
     parser.add_argument("--tp", type=int, default=1)
@@ -390,9 +426,11 @@ def main(argv=None) -> int:
     parser.add_argument("--remat-policy", default="none",
                         choices=["none", "dots"])
     parser.add_argument("--data-dir", default=None,
-                        help="directory of <stem>_tokens.npy packed token "
-                             "shards (data/tokenstream.py); omit for the "
-                             "synthetic stream")
+                        help="real-data shards: <stem>_tokens.npy packed "
+                             "token streams for gpt2/bert "
+                             "(data/tokenstream.py), <stem>_images.npy "
+                             "pairs for vit (data/imagefolder.py); omit "
+                             "for synthetic data")
     parser.add_argument("--train-dir", default=None)
     parser.add_argument("--profile-dir", default=None,
                         help="write a jax.profiler trace of the first "
@@ -416,8 +454,8 @@ def main(argv=None) -> int:
                 batch_per_device=args.batch_per_device or 32,
                 image_size=args.image_size, num_steps=args.num_steps,
                 warmup_steps=args.warmup_steps, dtype_name=args.dtype,
-                num_slices=info.num_slices, train_dir=args.train_dir,
-                log=log)
+                num_slices=info.num_slices, data_dir=args.data_dir,
+                train_dir=args.train_dir, log=log)
             headline = {"metric": "vit_images_per_sec",
                         "value": round(metrics["images_per_sec"], 2),
                         "unit": "images/sec"}
@@ -426,7 +464,8 @@ def main(argv=None) -> int:
                 workload=args.workload, size=args.size,
                 batch_per_device=args.batch_per_device or 8,
                 seq_len=args.seq_len, num_steps=args.num_steps,
-                warmup_steps=args.warmup_steps, dtype_name=args.dtype,
+                warmup_steps=args.warmup_steps,
+                eval_steps=args.eval_steps, dtype_name=args.dtype,
                 tp=args.tp, pp=args.pp, sp=args.sp,
                 moe_experts=args.moe_experts,
                 ep=args.ep, fused_xent=args.fused_xent,

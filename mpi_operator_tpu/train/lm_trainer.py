@@ -57,6 +57,13 @@ class LMTrainerConfig:
     b2: float = 0.95
     grad_clip: float = 1.0
     warmup_steps: int = 100
+    # "linear": warmup then constant (the benchmark default — throughput
+    # runs never reach decay territory). "cosine": warmup then cosine
+    # decay over decay_steps down to end_lr_fraction of the peak (the
+    # standard pretraining schedule, GPT-2/BERT style).
+    lr_schedule: str = "linear"
+    decay_steps: int = 10_000
+    end_lr_fraction: float = 0.1
     moe_aux_weight: float = 0.01
     masked_lm: bool = False        # BERT-style objective over masked slots
     # chunked tied-head xent (fused_lm_loss): the full [B*S, vocab] logits
@@ -72,12 +79,26 @@ class LMTrainerConfig:
     log_every: int = 10
 
 
+def make_lr_schedule(cfg: LMTrainerConfig) -> optax.Schedule:
+    """The LR curve make_adamw drives: warmup-linear (constant after
+    warmup) or warmup-cosine decaying to end_lr_fraction of the peak."""
+    if cfg.lr_schedule == "linear":
+        return optax.linear_schedule(0.0, cfg.learning_rate,
+                                     max(1, cfg.warmup_steps))
+    if cfg.lr_schedule == "cosine":
+        return optax.warmup_cosine_decay_schedule(
+            init_value=0.0, peak_value=cfg.learning_rate,
+            warmup_steps=max(1, cfg.warmup_steps),
+            decay_steps=max(cfg.decay_steps, cfg.warmup_steps + 1),
+            end_value=cfg.learning_rate * cfg.end_lr_fraction)
+    raise ValueError(f"lr_schedule={cfg.lr_schedule!r}; expected "
+                     f"'linear' or 'cosine'")
+
+
 def make_adamw(cfg: LMTrainerConfig) -> optax.GradientTransformation:
-    sched = optax.linear_schedule(0.0, cfg.learning_rate,
-                                  max(1, cfg.warmup_steps))
     return optax.chain(
         optax.clip_by_global_norm(cfg.grad_clip),
-        optax.adamw(sched, b1=cfg.b1, b2=cfg.b2,
+        optax.adamw(make_lr_schedule(cfg), b1=cfg.b1, b2=cfg.b2,
                     weight_decay=cfg.weight_decay),
     )
 
@@ -176,6 +197,7 @@ class LMTrainer:
                 f"per-device shards (data-parallel degree {nb})")
         self.replicated = NamedSharding(mesh, P())
         self._step = None
+        self._eval = None
         self._state_shardings = None
 
     def init_state(self, rng: jax.Array) -> LMTrainState:
@@ -214,7 +236,7 @@ class LMTrainer:
                 and not self.config.masked_lm)
 
     def _loss_fn(self, params, tokens, targets, mask, denom=None,
-                 aux_scale=1.0):
+                 aux_scale=1.0, include_aux=True):
         """`denom`/`aux_scale` support exact gradient accumulation: with
         denom = the FULL-batch mask count and aux_scale = 1/accum_steps,
         the SUM of microbatch gradients equals the full-batch gradient by
@@ -232,7 +254,7 @@ class LMTrainer:
                 {"params": params}, tokens, mutable=["intermediates"])
             loss = lm_loss(logits, targets, mask, denom=denom)
         aux = jax.tree.leaves(interm.get("intermediates", {}))
-        if aux:
+        if aux and include_aux:
             loss = loss + aux_scale * self.config.moe_aux_weight * sum(
                 jnp.asarray(a).mean() for a in aux)
         return loss, logits
@@ -291,6 +313,45 @@ class LMTrainer:
                 donate_argnums=(0,),
             )
         return self._step
+
+    def _eval_fn(self, params, tokens, targets, mask):
+        # no aux term: the MoE load-balancing loss exists only to shape
+        # gradients — including it would inflate exp(val_loss) past true
+        # perplexity for MoE models
+        loss, _ = self._loss_fn(params, tokens, targets, mask,
+                                include_aux=False)
+        return loss
+
+    def compile_eval(self):
+        if self._eval is None:
+            assert self._state_shardings is not None, "call init_state first"
+            self._eval = jax.jit(
+                self._eval_fn,
+                in_shardings=(self._state_shardings.params,
+                              self.batch_sharding, self.batch_sharding,
+                              self.batch_sharding),
+                out_shardings=self.replicated,
+            )
+        return self._eval
+
+    def eval_step(self, state, tokens, targets, mask=None):
+        """Loss-only forward at the current params (no grads, no update)."""
+        if mask is None:
+            mask = jnp.ones_like(targets, jnp.float32)
+        with activation_rules_scope(self.mesh):
+            return self.compile_eval()(state.params, tokens, targets,
+                                       mask.astype(jnp.float32))
+
+    def evaluate(self, state, dataset, num_batches: int = 10
+                 ) -> Dict[str, float]:
+        """Mean held-out loss + perplexity over `num_batches` batches of
+        `dataset` (same batch contract as the training stream)."""
+        total = 0.0
+        it = iter(dataset)
+        for _ in range(num_batches):
+            total += float(self.eval_step(state, *next(it)))
+        mean = total / max(1, num_batches)
+        return {"val_loss": mean, "perplexity": math.exp(min(mean, 30.0))}
 
     def train_step(self, state, tokens, targets, mask=None):
         if mask is None:
@@ -410,4 +471,4 @@ def _opt_shardings(opt_abstract, params, param_sh, replicated):
 
 
 __all__ = ["LMTrainer", "LMTrainerConfig", "LMTrainState", "make_adamw",
-           "lm_loss", "fused_lm_loss"]
+           "make_lr_schedule", "lm_loss", "fused_lm_loss"]

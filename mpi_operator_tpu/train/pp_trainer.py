@@ -165,11 +165,18 @@ class PipelineLMTrainer:
         return self.compile_step()(state, tokens, targets)
 
     def microbatch(self, tokens, targets):
-        """Reshape a flat [B, S] batch into the [M, B/M, S] stream."""
+        """Reshape a flat [B, S] batch into the [M, B/M, S] stream, placed
+        with the trainer's batch sharding. A flat batch sharded with B
+        over (pp, data axes) — the placement data/tokenstream.py uses —
+        has EXACTLY the element distribution of the [M, mb] split, so the
+        device_put is a metadata re-spec, not a transfer; host arrays
+        (synthetic streams) get their first placement here."""
         M = self.num_microbatches
         B, S = tokens.shape
-        return (tokens.reshape(M, B // M, S),
-                targets.reshape(M, B // M, S))
+        return (jax.device_put(tokens.reshape(M, B // M, S),
+                               self.batch_sharding),
+                jax.device_put(targets.reshape(M, B // M, S),
+                               self.batch_sharding))
 
     # -- benchmark loop -----------------------------------------------------
 

@@ -278,3 +278,10 @@ def test_lm_benchmark_with_data_dir(tmp_path):
             dtype_name="float32", data_dir=str(tmp_path),
             log=lambda s: None)
         assert np.isfinite(metrics["final_loss"])
+    # pipeline path: flat [B, S] pairs placed with B over (pp, data axes)
+    _state, metrics = run_lm_benchmark(
+        workload="gpt2", size="test", batch_per_device=4, pp=2,
+        seq_len=32, num_steps=2, warmup_steps=1,
+        dtype_name="float32", data_dir=str(tmp_path),
+        log=lambda s: None)
+    assert np.isfinite(metrics["final_loss"])

@@ -166,6 +166,63 @@ def test_ring_without_sp_context_raises():
         tr.init_state(jax.random.PRNGKey(0))
 
 
+def test_eval_step_matches_train_loss():
+    """eval_step at the current params equals the loss train_step reports
+    (train computes loss BEFORE applying the update) — pins that the eval
+    path shares the exact objective, sharded the same way."""
+    tr = _trainer(MeshConfig(dp=2, fsdp=2, tp=2))
+    state = tr.init_state(jax.random.PRNGKey(0))
+    toks, tgts = _batch(tr)
+    ev = float(tr.eval_step(state, toks, tgts))
+    _, m = tr.train_step(state, toks, tgts)
+    np.testing.assert_allclose(ev, float(m["loss"]), atol=1e-5)
+
+
+def test_evaluate_reports_perplexity():
+    tr = _trainer(MeshConfig(dp=8))
+    state = tr.init_state(jax.random.PRNGKey(0))
+
+    class Stream:
+        def __iter__(self):
+            return self
+
+        def __next__(self):
+            return _batch(tr)
+
+    out = tr.evaluate(state, Stream(), num_batches=2)
+    assert np.isfinite(out["val_loss"])
+    np.testing.assert_allclose(out["perplexity"], np.exp(out["val_loss"]),
+                               rtol=1e-6)
+
+
+def test_cosine_schedule_option():
+    """The schedule make_adamw actually drives: warmup to peak, cosine
+    decay to the floor, warmup-clamped decay horizon; unknown names are
+    rejected."""
+    import pytest
+
+    from mpi_operator_tpu.train.lm_trainer import (LMTrainerConfig,
+                                                   make_lr_schedule)
+
+    cfg = LMTrainerConfig(learning_rate=1e-3, warmup_steps=10,
+                          lr_schedule="cosine", decay_steps=100,
+                          end_lr_fraction=0.1)
+    sched = make_lr_schedule(cfg)
+    assert float(sched(10)) == pytest.approx(1e-3)          # peak
+    assert float(sched(100)) == pytest.approx(1e-4, rel=1e-3)  # floor
+    # decay_steps <= warmup_steps clamps instead of crashing optax
+    clamped = make_lr_schedule(LMTrainerConfig(
+        learning_rate=1e-3, warmup_steps=10, lr_schedule="cosine",
+        decay_steps=5))
+    assert float(clamped(10)) == pytest.approx(1e-3, rel=1e-2)
+    lin = make_lr_schedule(LMTrainerConfig(learning_rate=1e-3,
+                                           warmup_steps=10))
+    assert float(lin(10)) == pytest.approx(1e-3)
+    assert float(lin(1000)) == pytest.approx(1e-3)          # constant after
+    with pytest.raises(ValueError, match="lr_schedule"):
+        make_lr_schedule(LMTrainerConfig(lr_schedule="nope"))
+
+
 def test_grad_accumulation_matches_single_step():
     """accum_steps=2 must produce the SAME update as the unaccumulated
     step on the same global batch: mean of microbatch mean-grads equals
