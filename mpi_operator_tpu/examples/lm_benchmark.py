@@ -53,6 +53,7 @@ def run_lm_benchmark(
     accum_steps: int = 1,
     data_dir: Optional[str] = None,
     train_dir: Optional[str] = None,
+    ckpt_every: int = 0,
     profile_dir: Optional[str] = None,
     log: Callable[[str], None] = print,
 ) -> Tuple[object, Dict[str, float]]:
@@ -167,25 +168,31 @@ def run_lm_benchmark(
                 pass
 
         if data_dir:
-            from jax.sharding import NamedSharding, PartitionSpec as P
-
             from ..data.tokenstream import NpyTokenDataset
-            # flat [B, S] pairs placed with B over (pp, data axes): the
-            # trainer's microbatch() reshape splits B into [M, mb] with M
-            # landing on pp and mb on the data axes — exactly the step's
-            # in_shardings, so no resharding (and no single-device
-            # device_put that would break multi-host)
-            flat_sharding = NamedSharding(
-                pp_mesh, P(("pp", "dcn", "dp", "fsdp")))
+            # the feeder reshapes each window into the [M, mb, S] stream
+            # and device_puts it with the TRAINER's 3-D batch sharding —
+            # no flat PartitionSpec matches the [M, mb] split's element
+            # distribution, so placing the final layout directly is the
+            # only transfer-free option
+            M = pp_trainer.num_microbatches
+            mb = global_batch // M
+
+            def pp_transform(win):
+                return (win[:, :-1].reshape(M, mb, seq_len),
+                        win[:, 1:].reshape(M, mb, seq_len))
+
             pp_stream = NpyTokenDataset(data_dir, global_batch, seq_len,
-                                        sharding=flat_sharding,
+                                        sharding=pp_trainer.batch_sharding,
+                                        host_transform=pp_transform,
                                         vocab_size=cfg_vocab)
         else:
             pp_stream = RawStream()
+        from ..train.checkpoint import periodic_saver
         try:
             pp_state, pp_metrics = pp_trainer.benchmark(
                 pp_state, pp_stream, num_steps=num_steps,
-                warmup_steps=warmup_steps, log=log)
+                warmup_steps=warmup_steps, log=log,
+                step_hook=periodic_saver(train_dir, ckpt_every, log))
         finally:
             pp_stream.close()
         maybe_save(train_dir, pp_state, log)
@@ -249,10 +256,12 @@ def run_lm_benchmark(
                                  host_transform=transform)
     else:
         stream = TokenStream()
+    from ..train.checkpoint import periodic_saver
     try:
         state, metrics = trainer.benchmark(
             state, stream, num_steps=num_steps,
-            warmup_steps=warmup_steps, log=log, profile_dir=profile_dir)
+            warmup_steps=warmup_steps, log=log, profile_dir=profile_dir,
+            step_hook=periodic_saver(train_dir, ckpt_every, log))
         if eval_steps:
             # evaluation continues the stream past the trained batches —
             # fresh batches for synthetic/large-shard runs; point
@@ -337,6 +346,7 @@ def run_vit_benchmark(
     num_slices: int = 1,
     data_dir: Optional[str] = None,
     train_dir: Optional[str] = None,
+    ckpt_every: int = 0,
     log: Callable[[str], None] = print,
 ) -> Tuple[object, Dict[str, float]]:
     """ViT-B/16 image benchmark; --num-slices 2 is the BASELINE multi-slice
@@ -371,10 +381,11 @@ def run_vit_benchmark(
         dataset = SyntheticImageDataset(
             global_batch, image_size=image_size, num_classes=1000,
             dtype=dtype, sharding=batch_sharding(mesh))
+    from ..train.checkpoint import periodic_saver
     try:
         state, metrics = trainer.benchmark(
             state, dataset, num_steps=num_steps, warmup_steps=warmup_steps,
-            log=log)
+            log=log, step_hook=periodic_saver(train_dir, ckpt_every, log))
     finally:
         if hasattr(dataset, "close"):
             dataset.close()
@@ -432,6 +443,10 @@ def main(argv=None) -> int:
                              "pairs for vit (data/imagefolder.py); omit "
                              "for synthetic data")
     parser.add_argument("--train-dir", default=None)
+    parser.add_argument("--ckpt-every", type=int, default=0,
+                        help="async checkpoint every N steps into "
+                             "--train-dir (mid-run gang restarts resume "
+                             "from the last one; 0 = final only)")
     parser.add_argument("--profile-dir", default=None,
                         help="write a jax.profiler trace of the first "
                              "measurement window here (XProf format)")
@@ -455,7 +470,8 @@ def main(argv=None) -> int:
                 image_size=args.image_size, num_steps=args.num_steps,
                 warmup_steps=args.warmup_steps, dtype_name=args.dtype,
                 num_slices=info.num_slices, data_dir=args.data_dir,
-                train_dir=args.train_dir, log=log)
+                train_dir=args.train_dir, ckpt_every=args.ckpt_every,
+                log=log)
             headline = {"metric": "vit_images_per_sec",
                         "value": round(metrics["images_per_sec"], 2),
                         "unit": "images/sec"}
@@ -475,6 +491,7 @@ def main(argv=None) -> int:
                 remat_policy=args.remat_policy,
                 data_dir=args.data_dir,
                 train_dir=args.train_dir,
+                ckpt_every=args.ckpt_every,
                 profile_dir=args.profile_dir, log=log)
             headline = {"metric": f"{args.workload}_tokens_per_sec",
                         "value": round(metrics["tokens_per_sec"], 0),

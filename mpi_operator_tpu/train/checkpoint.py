@@ -33,17 +33,50 @@ def _state_payload(state):
     return payload
 
 
-def save_checkpoint(directory: str, state, step: Optional[int] = None) -> str:
-    """Write a checkpoint under `directory/step_<n>`; returns the path."""
+# One async checkpointer per process: saves return once the on-device
+# arrays are snapshotted and the serialize/write continues on background
+# threads — training overlaps the IO instead of stalling on it. A second
+# save (or wait_for_checkpoints) joins the previous write first, so at
+# most one write is in flight and step_N directories appear atomically
+# (orbax commit semantics).
+_ASYNC_CKPTR: Optional[ocp.AsyncCheckpointer] = None
+
+
+def _async_checkpointer() -> ocp.AsyncCheckpointer:
+    global _ASYNC_CKPTR
+    if _ASYNC_CKPTR is None:
+        _ASYNC_CKPTR = ocp.AsyncCheckpointer(
+            ocp.StandardCheckpointHandler())
+    return _ASYNC_CKPTR
+
+
+def wait_for_checkpoints() -> None:
+    """Join any in-flight async checkpoint write (no-op when none)."""
+    if _ASYNC_CKPTR is not None:
+        _ASYNC_CKPTR.wait_until_finished()
+
+
+def save_checkpoint(directory: str, state, step: Optional[int] = None,
+                    block: bool = True) -> str:
+    """Write a checkpoint under `directory/step_<n>`; returns the path.
+    block=False returns as soon as the device arrays are snapshotted and
+    lets the write complete in the background (call wait_for_checkpoints
+    — or any later save — to join it)."""
     step = int(state.step) if step is None else step
     path = os.path.join(os.path.abspath(directory), f"step_{step}")
-    ckptr = ocp.StandardCheckpointer()
-    ckptr.save(path, _state_payload(state), force=True)
-    ckptr.wait_until_finished()
+    ckptr = _async_checkpointer()
+    ckptr.save(path, args=ocp.args.StandardSave(_state_payload(state)),
+               force=True)
+    if block:
+        ckptr.wait_until_finished()
     return path
 
 
 def latest_checkpoint(directory: str) -> Optional[str]:
+    # join any in-flight async write FIRST: an uncommitted step_N still
+    # lives under its orbax tmp name and would be invisible to listdir,
+    # silently resolving "latest" to an older checkpoint
+    wait_for_checkpoints()
     directory = os.path.abspath(directory)
     if not os.path.isdir(directory):
         return None
@@ -60,6 +93,7 @@ def restore_checkpoint(directory_or_path: str, state):
     """Restore into the structure (and shardings) of `state` — sharded
     arrays land back on the mesh in their recorded layout. Accepts either a
     checkpoint path or a directory of step_N checkpoints (takes latest)."""
+    wait_for_checkpoints()      # never read behind an in-flight write
     path = directory_or_path
     if not os.path.basename(path).startswith("step_"):
         latest = latest_checkpoint(path)
@@ -75,7 +109,8 @@ def restore_checkpoint(directory_or_path: str, state):
     return state.replace(**fields)
 
 
-__all__ = ["save_checkpoint", "restore_checkpoint", "latest_checkpoint"]
+__all__ = ["save_checkpoint", "restore_checkpoint", "latest_checkpoint",
+           "wait_for_checkpoints", "periodic_saver"]
 
 
 def maybe_resume(train_dir, state, log=print):
@@ -94,8 +129,35 @@ def maybe_resume(train_dir, state, log=print):
 
 def maybe_save(train_dir, state, log=print):
     """Write a checkpoint when train_dir is set (collective across all
-    processes — see examples/benchmark.py for why every rank must call)."""
+    processes — see examples/benchmark.py for why every rank must call).
+    Skips the write when the latest checkpoint already covers this step
+    (a periodic async save on the final step) — rewriting it with
+    force=True would delete the committed copy first, so a crash mid-
+    rewrite would destroy the newest checkpoint for nothing."""
     if not train_dir:
+        return
+    step = int(state.step)
+    latest = latest_checkpoint(train_dir)     # joins in-flight writes
+    if latest is not None and os.path.basename(latest) == f"step_{step}":
+        log(f"checkpoint for step {step} already written ({latest})")
         return
     path = save_checkpoint(train_dir, state)
     log(f"checkpoint written to {path}")
+
+
+def periodic_saver(train_dir, every: int, log=print):
+    """A `hook(state, step)` for training loops: every `every` steps it
+    fires a NON-blocking async checkpoint (training overlaps the write —
+    this is what makes mid-run gang restarts resumable instead of losing
+    the whole run). None when disabled; pair with wait_for_checkpoints()
+    (or the final maybe_save, which joins implicitly) before exit."""
+    if not train_dir or every <= 0:
+        return None
+
+    def hook(state, step: int) -> None:
+        if step % every == 0:
+            # explicit step: save_checkpoint(step=None) would host-read
+            # state.step, a device sync the training loop must not pay
+            path = save_checkpoint(train_dir, state, step=step, block=False)
+            log(f"async checkpoint -> {path}")
+    return hook

@@ -394,9 +394,12 @@ class LMTrainer:
     def benchmark(self, state, dataset, num_steps: int = 50,
                   warmup_steps: int = 5, log: Callable[[str], None] = print,
                   profile_dir: Optional[str] = None,
+                  step_hook: Optional[Callable] = None,
                   ) -> Tuple[LMTrainState, Dict[str, float]]:
         """tokens/sec measurement, same windowed protocol as
-        train.trainer.Trainer.benchmark (ref README.md:113-131 format)."""
+        train.trainer.Trainer.benchmark (ref README.md:113-131 format).
+        step_hook(state, step) fires after every step (periodic async
+        checkpointing — train/checkpoint.periodic_saver)."""
         cfg = self.config
         it = iter(dataset)
         probe = next(it)
@@ -406,6 +409,7 @@ class LMTrainer:
             batch = next(it)
             state, metrics = self.train_step(state, *batch)
         float(metrics["loss"])
+        base_step = int(state.step)       # one host read, OUTSIDE the loop
         tokens_per_step = cfg.global_batch_size * cfg.seq_len
         log_every = max(1, min(cfg.log_every, num_steps))
         windows = []
@@ -417,6 +421,8 @@ class LMTrainer:
             for i in range(1, num_steps + 1):
                 batch = next(it)
                 state, metrics = self.train_step(state, *batch)
+                if step_hook is not None:
+                    step_hook(state, base_step + i)
                 if i % log_every == 0:
                     loss = float(metrics["loss"])
                     t1 = time.perf_counter()       # BEFORE the trace write

@@ -165,36 +165,49 @@ class PipelineLMTrainer:
         return self.compile_step()(state, tokens, targets)
 
     def microbatch(self, tokens, targets):
-        """Reshape a flat [B, S] batch into the [M, B/M, S] stream, placed
-        with the trainer's batch sharding. A flat batch sharded with B
-        over (pp, data axes) — the placement data/tokenstream.py uses —
-        has EXACTLY the element distribution of the [M, mb] split, so the
-        device_put is a metadata re-spec, not a transfer; host arrays
-        (synthetic streams) get their first placement here."""
+        """Reshape a flat [B, S] batch into the [M, B/M, S] stream. For
+        host arrays (synthetic streams) the jitted step's in_shardings do
+        the placement. Device-committed flat batches should NOT come
+        through here — no flat PartitionSpec matches the [M, mb] split's
+        two-level element distribution, so re-placement would be a real
+        per-step all-to-all; real-data streams instead yield the 3-D
+        stream pre-placed (benchmark() accepts it directly)."""
         M = self.num_microbatches
         B, S = tokens.shape
-        return (jax.device_put(tokens.reshape(M, B // M, S),
-                               self.batch_sharding),
-                jax.device_put(targets.reshape(M, B // M, S),
-                               self.batch_sharding))
+        return (tokens.reshape(M, B // M, S),
+                targets.reshape(M, B // M, S))
 
     # -- benchmark loop -----------------------------------------------------
 
     def benchmark(self, state, dataset, num_steps: int = 50,
                   warmup_steps: int = 5, log: Callable[[str], None] = print,
+                  step_hook: Optional[Callable] = None,
                   ) -> Tuple[PPTrainState, Dict[str, float]]:
+        """The stream may yield flat [B, S] pairs (microbatched and placed
+        here) or pre-placed [M, mb, S] streams (real-data pipelines).
+        step_hook(state, step) fires after every timed step (periodic
+        async checkpointing, train/checkpoint.periodic_saver)."""
         cfg = self.config
+
+        def prepare(toks, tgts):
+            if toks.ndim == 2:
+                return self.microbatch(toks, tgts)
+            return toks, tgts
+
         it = iter(dataset)
         step = self.compile_step()
         for _ in range(max(1, warmup_steps)):
             toks, tgts = next(it)
-            state, metrics = step(state, *self.microbatch(toks, tgts))
+            state, metrics = step(state, *prepare(toks, tgts))
         float(metrics["loss"])
+        base_step = int(state.step)      # one host read, OUTSIDE the loop
         tokens_per_step = cfg.global_batch_size * cfg.seq_len
         t0 = time.perf_counter()
-        for _ in range(num_steps):
+        for i in range(1, num_steps + 1):
             toks, tgts = next(it)
-            state, metrics = step(state, *self.microbatch(toks, tgts))
+            state, metrics = step(state, *prepare(toks, tgts))
+            if step_hook is not None:
+                step_hook(state, base_step + i)
         final_loss = float(metrics["loss"])         # host read barrier
         dt = time.perf_counter() - t0
         tps = tokens_per_step * num_steps / dt

@@ -164,6 +164,7 @@ class Trainer:
                   warmup_steps: int = 10,
                   log: Callable[[str], None] = print,
                   profile_dir: Optional[str] = None,
+                  step_hook: Optional[Callable] = None,
                   ) -> Tuple[TrainState, Dict[str, float]]:
         """Windowed throughput measurement, tf_cnn_benchmarks-style.
         Returns (final_state, metrics) — the input state is DONATED by the
@@ -195,6 +196,7 @@ class Trainer:
             images, labels = next(it)
             state, metrics = step_fn(state, images, labels)
         float(metrics["loss"])       # true barrier (see docstring)
+        base_step = int(state.step)  # one host read, OUTSIDE the loop
 
         window_ips = []
         profiler = WindowProfiler(profile_dir, log)
@@ -205,6 +207,10 @@ class Trainer:
             for i in range(1, num_steps + 1):
                 images, labels = next(it)
                 state, metrics = step_fn(state, images, labels)
+                if step_hook is not None:
+                    # periodic async checkpointing
+                    # (train/checkpoint.periodic_saver)
+                    step_hook(state, base_step + i)
                 if i % log_every == 0:
                     loss = float(metrics["loss"])  # sync: closes the window
                     t1 = time.perf_counter()       # BEFORE the trace write

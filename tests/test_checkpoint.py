@@ -151,3 +151,56 @@ def test_pp_trainer_checkpoint_roundtrip(tmp_path):
                     jax.tree.leaves(state.params)):
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
         assert b.sharding.is_equivalent_to(a.sharding, a.ndim)
+
+
+def test_periodic_async_checkpointing(tmp_path):
+    """Mid-run resumability: periodic_saver fires non-blocking async
+    checkpoints every N steps during the benchmark loop; a mid-run
+    checkpoint exists (not just the final one), restores cleanly after
+    wait_for_checkpoints, and carries the right step counter."""
+    import optax
+
+    from mpi_operator_tpu.models.transformer import CausalLM, gpt2_config
+    from mpi_operator_tpu.train import LMTrainer, LMTrainerConfig
+    from mpi_operator_tpu.train.checkpoint import (
+        periodic_saver, wait_for_checkpoints)
+
+    cfg = gpt2_config("test", attention="dense", dtype=jnp.float32,
+                      vocab_size=128, max_len=64)
+    tr = LMTrainer(CausalLM(cfg), make_mesh(MeshConfig(dp=8)),
+                   LMTrainerConfig(global_batch_size=8, seq_len=32,
+                                   log_every=2),
+                   tx=optax.sgd(0.1))
+    state = tr.init_state(jax.random.PRNGKey(0))
+
+    class Stream:
+        def __iter__(self):
+            return self
+
+        def __next__(self):
+            toks = jax.random.randint(jax.random.PRNGKey(1), (8, 32), 0, 128)
+            return (jax.device_put(toks, tr.batch_sharding),
+                    jax.device_put(jnp.roll(toks, -1, 1),
+                                   tr.batch_sharding))
+
+    hook = periodic_saver(str(tmp_path), every=2, log=lambda s: None)
+    state, _ = tr.benchmark(state, Stream(), num_steps=6, warmup_steps=1,
+                            log=lambda s: None, step_hook=hook)
+    wait_for_checkpoints()
+    steps = sorted(int(p.name[5:]) for p in tmp_path.iterdir()
+                   if p.name.startswith("step_"))
+    assert steps == [2, 4, 6], steps          # every 2, warmup excluded
+    mid = restore_checkpoint(str(tmp_path / "step_4"),
+                             tr.init_state(jax.random.PRNGKey(0)))
+    assert int(mid.step) == 4
+    # disabled modes
+    assert periodic_saver(None, 2) is None
+    assert periodic_saver(str(tmp_path), 0) is None
+    # the final maybe_save must SKIP (not delete-and-rewrite) a step the
+    # periodic hook already committed
+    from mpi_operator_tpu.train.checkpoint import maybe_save
+    logs = []
+    maybe_save(str(tmp_path), state, log=logs.append)   # step 7: writes
+    assert "step_7" in logs[-1] and "already" not in logs[-1]
+    maybe_save(str(tmp_path), state, log=logs.append)   # step 7 again
+    assert "already written" in logs[-1]
