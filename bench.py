@@ -203,10 +203,11 @@ def main() -> None:
         **mfu_fields(metrics),
     }
     if args.workload == "all":
-        # secondary line item: the GPT-2 ladder entry (BASELINE configs[3]),
-        # folded into the single JSON line the driver records. Best-effort:
-        # a failure here (OOM on a small chip, compile error) must not
-        # discard the already-measured resnet headline number.
+        # secondary line items folded into the single JSON line the driver
+        # records: the GPT-2 train ladder entry (BASELINE configs[3]) and
+        # the KV-cache decode throughput. Best-effort: a failure here
+        # (OOM on a small chip, compile error) must not discard the
+        # already-measured resnet headline number.
         try:
             gm = run_lm("gpt2", steps=min(args.steps, 30),
                         warmup=min(args.warmup, 3))
@@ -215,6 +216,23 @@ def main() -> None:
         except Exception as exc:  # noqa: BLE001
             print(f"# gpt2 secondary bench failed: {exc!r}", file=sys.stderr)
             line["gpt2_error"] = type(exc).__name__
+        try:
+            from mpi_operator_tpu.examples.lm_benchmark import (
+                run_generate_benchmark)
+            dm = retry_infra_once(lambda: run_generate_benchmark(
+                size="test" if args.smoke else None,
+                batch=2 if args.smoke else 8,
+                prompt_len=16 if args.smoke else 128,
+                new_tokens=8 if args.smoke else 128,
+                num_iters=1 if args.smoke else 8,
+                dtype_name=args.dtype,
+                log=lambda s: print(s, file=sys.stderr)))
+            line["gpt2_decode_tokens_per_sec"] = round(
+                dm["decode_tokens_per_sec"], 0)
+        except Exception as exc:  # noqa: BLE001
+            print(f"# decode secondary bench failed: {exc!r}",
+                  file=sys.stderr)
+            line["decode_error"] = type(exc).__name__
     print(json.dumps(line))
 
 

@@ -37,21 +37,40 @@ class GenerateResult(NamedTuple):
     logprobs: jax.Array        # [B, max_new_tokens] logprob of each choice
 
 
-def _sample(logits, greedy, temperature, rng):
+def _sample(logits, greedy, temperature, rng, top_k, use_top_p, top_p):
     """[B, V] logits → ([B] token, [B] logprob of the chosen token).
-    `greedy` is static (two programs: argmax vs sampling); `temperature`
-    is a traced operand so every nonzero value shares one compile."""
+    `greedy`/`top_k`/`use_top_p` are static (they change the program);
+    `temperature` and the `top_p` threshold are traced operands so value
+    sweeps share one compile (top_k stays static — it is a slice index).
+    Reported logprobs are from the UNfiltered distribution (what the
+    model assigned), not the renormalized sampling distribution."""
     logp = jax.nn.log_softmax(logits.astype(jnp.float32))
     if greedy:
         tok = jnp.argmax(logits, axis=-1)
-    else:
-        tok = jax.random.categorical(rng, logp / temperature)
+        return tok, jnp.take_along_axis(logp, tok[:, None], axis=-1)[:, 0]
+    scaled = logp / temperature
+    if top_k is not None:
+        # keep the k highest-scoring tokens, mask the rest
+        kth = jnp.sort(scaled, axis=-1)[:, -top_k][:, None]
+        scaled = jnp.where(scaled < kth, -jnp.inf, scaled)
+    if use_top_p:
+        # nucleus: smallest prefix of the sorted distribution with
+        # cumulative probability >= top_p (the kept set always includes
+        # the most likely token)
+        sorted_p = jnp.sort(jax.nn.softmax(scaled), axis=-1)[:, ::-1]
+        cum = jnp.cumsum(sorted_p, axis=-1)
+        cutoff_idx = jnp.sum(cum < top_p, axis=-1)       # [B]
+        cutoff = jnp.take_along_axis(sorted_p, cutoff_idx[:, None],
+                                     axis=-1)            # prob threshold
+        probs = jax.nn.softmax(scaled)
+        scaled = jnp.where(probs < cutoff, -jnp.inf, scaled)
+    tok = jax.random.categorical(rng, scaled)
     return tok, jnp.take_along_axis(logp, tok[:, None], axis=-1)[:, 0]
 
 
-@partial(jax.jit, static_argnums=(0, 3, 6, 7))
+@partial(jax.jit, static_argnums=(0, 3, 6, 7, 8, 9))
 def _generate_jit(dmodel, params, prompt, max_new_tokens, temperature,
-                  rng, eos_id, greedy):
+                  rng, eos_id, greedy, top_k, use_top_p, top_p):
     from .transformer import _head_matmul
 
     B, P = prompt.shape
@@ -73,7 +92,8 @@ def _generate_jit(dmodel, params, prompt, max_new_tokens, temperature,
     logits = _head_matmul(h[:, -1:], table)
     cache = vars_["cache"]
     rng, sub = jax.random.split(rng)
-    tok, logp = _sample(logits[:, -1], greedy, temperature, sub)
+    tok, logp = _sample(logits[:, -1], greedy, temperature, sub,
+                        top_k, use_top_p, top_p)
     done = jnp.zeros((B,), bool)
     if eos_id is not None:
         done = tok == eos_id
@@ -86,7 +106,8 @@ def _generate_jit(dmodel, params, prompt, max_new_tokens, temperature,
             mutable=["cache"])
         logits = _head_matmul(h, table)
         rng, sub = jax.random.split(rng)
-        nxt, logp = _sample(logits[:, -1], greedy, temperature, sub)
+        nxt, logp = _sample(logits[:, -1], greedy, temperature, sub,
+                            top_k, use_top_p, top_p)
         if eos_id is not None:
             nxt = jnp.where(done, eos_id, nxt)
             logp = jnp.where(done, 0.0, logp)
@@ -103,13 +124,15 @@ def _generate_jit(dmodel, params, prompt, max_new_tokens, temperature,
 
 def generate(model, params, prompt, max_new_tokens: int,
              temperature: float = 0.0, rng: Optional[jax.Array] = None,
-             eos_id: Optional[int] = None) -> GenerateResult:
+             eos_id: Optional[int] = None, top_k: Optional[int] = None,
+             top_p: Optional[float] = None) -> GenerateResult:
     """Generate `max_new_tokens` continuations of `prompt` [B, P] int32.
 
     model — a trained CausalLM (training config; this fn builds the
     decode-mode twin). temperature=0 is greedy argmax; otherwise softmax
-    sampling at the given temperature using `rng`. `eos_id` freezes a row
-    once it emits that token.
+    sampling at the given temperature using `rng`, optionally filtered to
+    the `top_k` most likely tokens and/or the `top_p` nucleus. `eos_id`
+    freezes a row once it emits that token.
     """
     cfg = model.config
     if not cfg.causal:
@@ -126,12 +149,22 @@ def generate(model, params, prompt, max_new_tokens: int,
                          f"(0 = greedy)")
     if temperature != 0.0 and rng is None:
         raise ValueError("temperature sampling needs an rng key")
+    if (top_k is not None or top_p is not None) and temperature == 0.0:
+        raise ValueError("top_k/top_p filter the SAMPLING distribution; "
+                         "set temperature > 0 (greedy ignores them)")
+    if top_k is not None and not 1 <= top_k <= cfg.vocab_size:
+        raise ValueError(f"top_k={top_k} must be in [1, vocab_size="
+                         f"{cfg.vocab_size}]")
+    if top_p is not None and not 0.0 < top_p <= 1.0:
+        raise ValueError(f"top_p={top_p} must be in (0, 1]")
     dmodel = type(model)(dataclasses.replace(
         cfg, decode=True, attention="dense", remat=False))
     return _generate_jit(dmodel, params, prompt, int(max_new_tokens),
                          jnp.float32(temperature),
                          rng if rng is not None else jax.random.PRNGKey(0),
-                         eos_id, temperature == 0.0)
+                         eos_id, temperature == 0.0, top_k,
+                         top_p is not None,
+                         jnp.float32(top_p if top_p is not None else 1.0))
 
 
 __all__ = ["generate", "GenerateResult"]

@@ -77,6 +77,53 @@ def test_generate_with_tp_sharded_params():
                           np.array(out_ref.tokens))
 
 
+def test_top_k_one_equals_greedy():
+    """top_k=1 sampling degenerates to argmax regardless of temperature —
+    pins the filter against the greedy reference."""
+    model, params, prompt = _setup()
+    greedy = generate(model, params, prompt, max_new_tokens=6)
+    k1 = generate(model, params, prompt, max_new_tokens=6, temperature=1.0,
+                  rng=jax.random.PRNGKey(7), top_k=1)
+    assert np.array_equal(np.array(greedy.tokens), np.array(k1.tokens))
+
+
+def test_top_k_restricts_support():
+    """Every top_k-sampled token must be among the k most likely under the
+    model at its position (checked teacher-forced)."""
+    model, params, prompt = _setup()
+    k = 3
+    out = generate(model, params, prompt, max_new_tokens=5, temperature=1.5,
+                   rng=jax.random.PRNGKey(9), top_k=k)
+    toks = np.array(out.tokens)
+    P = prompt.shape[1]
+    for t in range(5):
+        logits = np.array(model.apply({"params": params},
+                                      out.tokens[:, :P + t]))[:, -1]
+        topk = np.argsort(logits, axis=-1)[:, -k:]
+        for b in range(toks.shape[0]):
+            assert toks[b, P + t] in topk[b]
+
+
+def test_top_p_one_is_unfiltered_and_validation():
+    import pytest
+
+    model, params, prompt = _setup()
+    rng = jax.random.PRNGKey(11)
+    full = generate(model, params, prompt, max_new_tokens=6,
+                    temperature=1.0, rng=rng)
+    p1 = generate(model, params, prompt, max_new_tokens=6,
+                  temperature=1.0, rng=rng, top_p=1.0)
+    assert np.array_equal(np.array(full.tokens), np.array(p1.tokens))
+    with pytest.raises(ValueError, match="temperature"):
+        generate(model, params, prompt, max_new_tokens=2, top_k=5)
+    with pytest.raises(ValueError, match="top_p"):
+        generate(model, params, prompt, max_new_tokens=2, temperature=1.0,
+                 rng=rng, top_p=1.5)
+    with pytest.raises(ValueError, match="top_k"):
+        generate(model, params, prompt, max_new_tokens=2, temperature=1.0,
+                 rng=rng, top_k=0)
+
+
 def test_generate_validation():
     model, params, prompt = _setup(max_len=8)
     with pytest.raises(ValueError, match="max_len"):
