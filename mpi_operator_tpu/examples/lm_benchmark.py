@@ -122,6 +122,8 @@ def run_lm_benchmark(
         # the unpiped trainer — the pipelined head is next-token xent.
         if masked:
             raise ValueError("--pp supports the causal LM (gpt2) only")
+        # learned-position requirement is validated by PipelineLMTrainer
+        # itself (the invariant lives there)
         if moe_experts or ep > 1:
             raise ValueError("--pp does not compose with --moe-experts/"
                              "--ep yet; the stage body applies dense "
@@ -396,9 +398,10 @@ def run_vit_benchmark(
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(prog="tpu-lm-benchmarks")
     parser.add_argument("--workload", default="gpt2",
-                        choices=["gpt2", "bert", "vit"])
+                        choices=["gpt2", "llama", "bert", "vit"])
     parser.add_argument("--size", default=None,
-                        help="gpt2: small|medium|large|xl; bert: base|large; "
+                        help="gpt2: small|medium|large|xl; llama: 1b|7b "
+                             "(RoPE+RMSNorm+SwiGLU+GQA); bert: base|large; "
                              "vit: b16|l16 (defaults = BASELINE configs)")
     parser.add_argument("--batch-per-device", type=int, default=None)
     parser.add_argument("--seq-len", type=int, default=512)

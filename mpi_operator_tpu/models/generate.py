@@ -50,8 +50,9 @@ def _sample(logits, greedy, temperature, rng, top_k, use_top_p, top_p):
         return tok, jnp.take_along_axis(logp, tok[:, None], axis=-1)[:, 0]
     scaled = logp / temperature
     if top_k is not None:
-        # keep the k highest-scoring tokens, mask the rest
-        kth = jnp.sort(scaled, axis=-1)[:, -top_k][:, None]
+        # keep the k highest-scoring tokens, mask the rest (lax.top_k,
+        # not a full vocab sort — this runs every decode step)
+        kth = jax.lax.top_k(scaled, top_k)[0][:, -1][:, None]
         scaled = jnp.where(scaled < kth, -jnp.inf, scaled)
     if use_top_p:
         # nucleus: smallest prefix of the sorted distribution with

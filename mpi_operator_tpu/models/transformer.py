@@ -48,6 +48,20 @@ class TransformerConfig:
     dropout_rate: float = 0.0
     causal: bool = True
     use_token_types: bool = False      # BERT segment embeddings
+    # the modern-LM knobs (Llama-style family; defaults = GPT-2/BERT):
+    #   pos_embedding: "learned" (wpe table) | "rope" (rotary, applied to
+    #     q/k inside attention — no position parameters at all)
+    #   norm: "layernorm" | "rmsnorm"
+    #   activation: "gelu" (fc_in→gelu→fc_out) | "swiglu"
+    #     (silu(gate)·up→fc_out, the Llama FFN)
+    #   num_kv_heads: grouped-query attention — K/V projected to this many
+    #     heads and shared across num_heads//num_kv_heads query groups
+    #     (None = num_heads = standard MHA). Shrinks the decode KV cache
+    #     and its per-step HBM reads by the group factor.
+    pos_embedding: str = "learned"
+    norm: str = "layernorm"
+    activation: str = "gelu"
+    num_kv_heads: Optional[int] = None
     dtype: Dtype = jnp.bfloat16
     attention: str = "auto"            # auto | dense | flash | ring
     # autoregressive decode mode (models/generate.py): attention reads and
@@ -74,6 +88,12 @@ class TransformerConfig:
         assert self.embed_dim % self.num_heads == 0
         return self.embed_dim // self.num_heads
 
+    @property
+    def kv_heads(self) -> int:
+        kv = self.num_kv_heads or self.num_heads
+        assert self.num_heads % kv == 0, (self.num_heads, kv)
+        return kv
+
 
 def _dense(features, name, logical_axes, dtype):
     return nn.Dense(
@@ -84,36 +104,69 @@ def _dense(features, name, logical_axes, dtype):
     )
 
 
+def rope(x, positions, base: float = 10000.0):
+    """Rotary position embedding (rotate-half convention): x [.., S, H, D]
+    rotated by per-position angles; positions [S] or [B, S] absolute ids.
+    Applied to q AND k, so attention scores depend only on relative
+    offsets — no position table, and decode steps just pass the absolute
+    position past the cached prefix."""
+    D = x.shape[-1]
+    half = D // 2
+    freqs = base ** (-jnp.arange(0, half, dtype=jnp.float32) / half)
+    angles = positions[..., None].astype(jnp.float32) * freqs   # [.., S, half]
+    cos = jnp.cos(angles)[..., None, :]                         # [.., S, 1, half]
+    sin = jnp.sin(angles)[..., None, :]
+    x1, x2 = x[..., :half].astype(jnp.float32), \
+        x[..., half:].astype(jnp.float32)
+    return jnp.concatenate(
+        [x1 * cos - x2 * sin, x1 * sin + x2 * cos], -1).astype(x.dtype)
+
+
 class Attention(nn.Module):
     """Multi-head self-attention, heads sharded over tp.
 
     QKV projections are column-parallel ("embed" → "heads"/"kv"), the output
     projection row-parallel ("heads" → "embed") — with params replicated this
     reduces to plain MHA; with tp rules active XLA emits the Megatron
-    collective pair automatically.
+    collective pair automatically. K/V project to cfg.kv_heads (GQA) and
+    are repeated across query groups for the attention kernels; the decode
+    cache stores the UNrepeated kv_heads (the GQA memory win).
     """
     config: TransformerConfig
 
     @nn.compact
-    def __call__(self, x, mask=None):
+    def __call__(self, x, mask=None, positions=None):
         cfg = self.config
         B, S, E = x.shape
         H, D = cfg.num_heads, cfg.head_dim
-        proj = partial(
-            nn.DenseGeneral, axis=-1, dtype=cfg.dtype,
-            features=(H, D),
-            kernel_init=nn.with_logical_partitioning(
-                kernel_init, ("embed", "heads", "kv")),
-            bias_init=nn.with_logical_partitioning(
-                nn.initializers.zeros, ("heads", "kv")),
-        )
-        q = proj(name="query")(x)
-        k = proj(name="key")(x)
-        v = proj(name="value")(x)
+        KV = cfg.kv_heads
 
+        def proj(heads, name):
+            return nn.DenseGeneral(
+                axis=-1, dtype=cfg.dtype, features=(heads, D), name=name,
+                kernel_init=nn.with_logical_partitioning(
+                    kernel_init, ("embed", "heads", "kv")),
+                bias_init=nn.with_logical_partitioning(
+                    nn.initializers.zeros, ("heads", "kv")),
+            )
+        q = proj(H, "query")(x)
+        k = proj(KV, "key")(x)
+        v = proj(KV, "value")(x)
+
+        if cfg.pos_embedding == "rope" and not cfg.decode:
+            pos = jnp.arange(S) if positions is None else positions
+            q = rope(q, pos)
+            k = rope(k, pos)
         if cfg.decode:
             out = self._decode_attend(q, k, v)
         else:
+            if KV != H:
+                # repeat K/V across query groups for the shared kernels
+                # (flash/ring/dense all take matching head counts); the
+                # repeat is a transient — parameters and the decode cache
+                # stay at KV heads
+                k = jnp.repeat(k, H // KV, axis=2)
+                v = jnp.repeat(v, H // KV, axis=2)
             out = _attend(q, k, v, mask=mask, cfg=cfg)
 
         out = nn.DenseGeneral(
@@ -130,27 +183,38 @@ class Attention(nn.Module):
         call's K/V at the cache cursor, attend q against everything
         written so far (positions > cursor+S masked). Handles both the
         multi-token prefill call and the steady-state single-token steps —
-        the cursor (`cache_index`) advances by the call's length."""
+        the cursor (`cache_index`) advances by the call's length. RoPE is
+        applied HERE (cursor-offset absolute positions) so cached keys
+        are pre-rotated; GQA caches the unrepeated kv_heads and repeats
+        only the transient attend operands."""
         cfg = self.config
         B, S, H, D = q.shape
+        KV = k.shape[2]
         L = cfg.max_len
-        ck = self.variable("cache", "cached_key", jnp.zeros,
-                           (B, L, H, D), k.dtype)
-        cv = self.variable("cache", "cached_value", jnp.zeros,
-                           (B, L, H, D), v.dtype)
         ci = self.variable("cache", "cache_index",
                            lambda: jnp.zeros((), jnp.int32))
         cur = ci.value
+        pos = cur + jnp.arange(S)                     # query positions
+        if cfg.pos_embedding == "rope":
+            q = rope(q, pos)
+            k = rope(k, pos)
+        ck = self.variable("cache", "cached_key", jnp.zeros,
+                           (B, L, KV, D), k.dtype)
+        cv = self.variable("cache", "cached_value", jnp.zeros,
+                           (B, L, KV, D), v.dtype)
         ck.value = jax.lax.dynamic_update_slice(ck.value, k, (0, cur, 0, 0))
         cv.value = jax.lax.dynamic_update_slice(cv.value, v, (0, cur, 0, 0))
         ci.value = cur + S
-        pos = cur + jnp.arange(S)                     # query positions
-        logits = jnp.einsum("bqhd,bkhd->bhqk", q, ck.value)
+        keys, values = ck.value, cv.value
+        if KV != H:
+            keys = jnp.repeat(keys, H // KV, axis=2)
+            values = jnp.repeat(values, H // KV, axis=2)
+        logits = jnp.einsum("bqhd,bkhd->bhqk", q, keys)
         logits = logits.astype(jnp.float32) / jnp.sqrt(D)
         visible = jnp.arange(L)[None, :] <= pos[:, None]       # [S, L]
         logits = jnp.where(visible[None, None], logits, -1e30)
         probs = jax.nn.softmax(logits, axis=-1).astype(cfg.dtype)
-        return jnp.einsum("bhqk,bkhd->bqhd", probs, cv.value)
+        return jnp.einsum("bhqk,bkhd->bqhd", probs, values)
 
 
 def _axis_bound(name: str) -> bool:
@@ -261,18 +325,39 @@ def dense_attention(q, k, v, mask=None, causal=True, dtype=jnp.float32):
 
 
 class Mlp(nn.Module):
-    """FFN: column-parallel in ("embed"→"mlp"), row-parallel out."""
+    """FFN, column-parallel in ("embed"→"mlp"), row-parallel out. Two
+    bodies: "gelu" (fc_in→gelu→fc_out, GPT-2/BERT) or "swiglu"
+    (silu(gate)·up→fc_out, the Llama FFN — one extra column-parallel
+    matmul, same sharding recipe)."""
     config: TransformerConfig
 
     @nn.compact
     def __call__(self, x):
         cfg = self.config
-        h = _dense(cfg.mlp_dim, "fc_in", ("embed", "mlp"), cfg.dtype)(x)
-        h = nn.gelu(h)
+        if cfg.activation == "swiglu":
+            gate = _dense(cfg.mlp_dim, "fc_gate", ("embed", "mlp"),
+                          cfg.dtype)(x)
+            up = _dense(cfg.mlp_dim, "fc_in", ("embed", "mlp"),
+                        cfg.dtype)(x)
+            h = nn.silu(gate) * up
+        elif cfg.activation == "gelu":
+            h = nn.gelu(_dense(cfg.mlp_dim, "fc_in", ("embed", "mlp"),
+                               cfg.dtype)(x))
+        else:
+            raise ValueError(f"activation={cfg.activation!r}; expected "
+                             f"'gelu' or 'swiglu'")
         return _dense(cfg.embed_dim, "fc_out", ("mlp", "embed"), cfg.dtype)(h)
 
 
 def _layer_norm(cfg, name):
+    if cfg.norm == "rmsnorm":
+        return nn.RMSNorm(
+            dtype=cfg.dtype, name=name, epsilon=1e-5,
+            scale_init=nn.with_logical_partitioning(nn.initializers.ones,
+                                                    ("norm",)))
+    if cfg.norm != "layernorm":
+        raise ValueError(f"norm={cfg.norm!r}; expected 'layernorm' or "
+                         f"'rmsnorm'")
     return nn.LayerNorm(
         dtype=cfg.dtype, name=name, epsilon=1e-5,
         scale_init=nn.with_logical_partitioning(nn.initializers.ones,
@@ -296,14 +381,22 @@ class Block(nn.Module):
     use_moe: bool = False
 
     @nn.compact
-    def __call__(self, x, mask=None):
+    def __call__(self, x, mask=None, positions=None):
         cfg = self.config
         x = _constrain(x)
         y = _layer_norm(cfg, "ln_1")(x)
-        x = _constrain(x + Attention(cfg, name="attn")(y, mask=mask))
+        x = _constrain(x + Attention(cfg, name="attn")(y, mask=mask,
+                                                       positions=positions))
         y = _layer_norm(cfg, "ln_2")(x)
         if self.use_moe:
             from ..parallel.moe import MoeMlp
+            if cfg.activation != "gelu":
+                # MoeMlp's experts are gelu FFNs; silently building gelu
+                # experts inside a swiglu-configured model would mislabel
+                # every benchmark of it
+                raise ValueError(
+                    f"num_experts>0 requires activation='gelu' (MoeMlp "
+                    f"experts are gelu FFNs); got {cfg.activation!r}")
             ff, aux = MoeMlp(
                 num_experts=cfg.num_experts, top_k=cfg.moe_top_k,
                 embed_dim=cfg.embed_dim, mlp_dim=cfg.mlp_dim,
@@ -319,7 +412,7 @@ class Backbone(nn.Module):
     config: TransformerConfig
 
     @nn.compact
-    def __call__(self, h, mask=None):
+    def __call__(self, h, mask=None, positions=None):
         cfg = self.config
         block = Block
         if cfg.remat:
@@ -337,7 +430,8 @@ class Backbone(nn.Module):
         for i in range(cfg.num_layers):
             use_moe = (cfg.num_experts > 0
                        and i % cfg.moe_every == cfg.moe_every - 1)
-            h = block(cfg, use_moe=use_moe, name=f"block_{i}")(h, mask=mask)
+            h = block(cfg, use_moe=use_moe, name=f"block_{i}")(
+                h, mask=mask, positions=positions)
         return _constrain(_layer_norm(cfg, "ln_f")(h))
 
 
@@ -375,11 +469,15 @@ class CausalLM(nn.Module):
         cfg = self.config
         B, S = tokens.shape
         wte = _embed(cfg, cfg.vocab_size, cfg.embed_dim, "wte", "vocab")
-        wpe = _pos_embed(cfg, cfg.max_len)
         if positions is None:
             positions = jnp.arange(S)[None]
-        h = wte(tokens) + wpe(positions)
-        h = Backbone(cfg, name="backbone")(h)
+        h = wte(tokens)
+        if cfg.pos_embedding == "learned":
+            h = h + _pos_embed(cfg, cfg.max_len)(positions)
+        # rope: no position table — rotations happen inside attention;
+        # positions pass through UNsliced (rope broadcasts [S] or [B, S],
+        # so per-row ids — left-padded prompts — stay per-row)
+        h = Backbone(cfg, name="backbone")(h, positions=positions)
         if not with_head:
             return h
         # tied LM head; bf16 MXU matmul, f32 accumulation (tied_logits)
@@ -477,6 +575,26 @@ def gpt2_config(size: str = "medium", **overrides) -> TransformerConfig:
     return TransformerConfig(**base)
 
 
+def llama_config(size: str = "1b", **overrides) -> TransformerConfig:
+    """Llama-style decoder: RoPE + RMSNorm + SwiGLU + grouped-query
+    attention — the modern-LM stack as config knobs over the same
+    sharded backbone (no reference analogue; the reference ships no
+    models at all, SURVEY.md §2.2)."""
+    # (layers, q heads, kv heads, embed, mlp) — mlp ≈ 8/3·E rounded to a
+    # multiple of 256 (MXU-aligned), the SwiGLU sizing convention
+    dims = {
+        "test": (2, 4, 2, 128, 256),
+        "1b": (16, 32, 8, 2048, 5504),
+        "7b": (32, 32, 8, 4096, 11008),
+    }[size]
+    L, H, KV, E, M = dims
+    base = dict(vocab_size=32000, max_len=2048, num_layers=L, num_heads=H,
+                num_kv_heads=KV, embed_dim=E, mlp_dim=M, causal=True,
+                pos_embedding="rope", norm="rmsnorm", activation="swiglu")
+    base.update(overrides)
+    return TransformerConfig(**base)
+
+
 def bert_config(size: str = "large", **overrides) -> TransformerConfig:
     dims = {
         "base": (12, 12, 768),
@@ -511,6 +629,8 @@ def create_lm(name: str = "gpt2-medium", **overrides):
     size = size or None
     if family == "gpt2":
         return CausalLM(gpt2_config(size or "medium", **overrides))
+    if family == "llama":
+        return CausalLM(llama_config(size or "1b", **overrides))
     if family == "bert":
         return MaskedLM(bert_config(size or "large", **overrides))
     raise ValueError(f"unknown LM {name!r}")
@@ -523,6 +643,7 @@ def create_vit(name: str = "vit-b16", num_classes: int = 1000, **overrides):
 
 __all__ = [
     "TransformerConfig", "Attention", "Mlp", "Block", "Backbone",
-    "CausalLM", "MaskedLM", "ViT", "dense_attention",
-    "gpt2_config", "bert_config", "vit_config", "create_lm", "create_vit",
+    "CausalLM", "MaskedLM", "ViT", "dense_attention", "rope",
+    "gpt2_config", "llama_config", "bert_config", "vit_config",
+    "create_lm", "create_vit",
 ]

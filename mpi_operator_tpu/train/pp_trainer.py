@@ -59,6 +59,11 @@ class PipelineLMTrainer:
         self.cfg = cfg
         self.mesh = mesh
         self.config = config or LMTrainerConfig()
+        if cfg.pos_embedding != "learned":
+            raise ValueError(
+                f"the pipeline trainer supports learned-position models "
+                f"only (the stage embed reads the wpe table); got "
+                f"pos_embedding={cfg.pos_embedding!r}")
         self.pp = mesh.shape["pp"]
         self.num_microbatches = num_microbatches or max(4 * self.pp, self.pp)
         if self.num_microbatches % self.pp:
