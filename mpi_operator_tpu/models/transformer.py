@@ -91,7 +91,10 @@ class TransformerConfig:
     @property
     def kv_heads(self) -> int:
         kv = self.num_kv_heads or self.num_heads
-        assert self.num_heads % kv == 0, (self.num_heads, kv)
+        if self.num_heads % kv:
+            raise ValueError(
+                f"num_kv_heads={kv} must divide num_heads="
+                f"{self.num_heads} (each query group shares one KV head)")
         return kv
 
 

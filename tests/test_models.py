@@ -250,3 +250,5 @@ def test_modern_lm_config_validation():
     with pytest.raises(ValueError, match="norm"):
         CausalLM(bad_norm).init(jax.random.PRNGKey(0),
                                    jnp.zeros((1, 8), jnp.int32))
+    with pytest.raises(ValueError, match="num_kv_heads"):
+        llama_config("test", num_kv_heads=3).kv_heads
