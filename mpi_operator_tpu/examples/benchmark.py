@@ -33,6 +33,8 @@ def run_benchmark(
     learning_rate: float = 0.1,
     data_dir: Optional[str] = None,
     profile_dir: Optional[str] = None,
+    train_dir: Optional[str] = None,
+    ckpt_every: int = 0,
     log: Callable[[str], None] = print,
 ) -> Tuple[object, Dict[str, float]]:
     """Shared wiring for every benchmark surface (bench.py, the container
@@ -67,10 +69,13 @@ def run_benchmark(
         dataset = SyntheticImageDataset(
             global_batch, image_size=image_size, num_classes=1000,
             dtype=dtype, sharding=batch_sharding(mesh))
+    from ..train.checkpoint import maybe_resume, periodic_saver
+    state = maybe_resume(train_dir, state, log)
     try:
-        return trainer.benchmark(state, dataset, num_steps=num_steps,
-                                 warmup_steps=warmup_steps, log=log,
-                                 profile_dir=profile_dir)
+        return trainer.benchmark(
+            state, dataset, num_steps=num_steps,
+            warmup_steps=warmup_steps, log=log, profile_dir=profile_dir,
+            step_hook=periodic_saver(train_dir, ckpt_every, log))
     finally:
         if hasattr(dataset, "close"):
             dataset.close()
@@ -100,7 +105,11 @@ def main(argv=None) -> int:
                         help="real-data directory; synthetic when absent "
                              "(the reference benchmark's default too)")
     parser.add_argument("--train-dir", default=None,
-                        help="checkpoint directory (orbax)")
+                        help="checkpoint directory (orbax); resumes from "
+                             "the latest checkpoint when one exists")
+    parser.add_argument("--ckpt-every", type=int, default=0,
+                        help="async checkpoint every N steps into "
+                             "--train-dir (0 = final only)")
     parser.add_argument("--learning-rate", type=float, default=0.1)
     parser.add_argument("--profile-dir", default=None,
                         help="write a jax.profiler trace of the first "
@@ -145,17 +154,18 @@ def main(argv=None) -> int:
             learning_rate=args.learning_rate,
             data_dir=args.data_dir,
             profile_dir=args.profile_dir,
+            train_dir=args.train_dir,
+            ckpt_every=args.ckpt_every,
             log=print if info.is_coordinator else (lambda s: None))
 
-        if args.train_dir:
-            # EVERY process must enter the save: orbax's save is a collective
-            # over all JAX processes (it barriers internally); gating it on
-            # the coordinator deadlocks multi-host jobs. Orbax itself
-            # restricts the actual write to the primary host.
-            from ..train.checkpoint import save_checkpoint
-            save_checkpoint(args.train_dir, state)
-            if info.is_coordinator:
-                print(f"checkpoint written to {args.train_dir}")
+        # EVERY process must enter the save: orbax's save is a collective
+        # over all JAX processes (it barriers internally); gating it on
+        # the coordinator deadlocks multi-host jobs. Orbax itself
+        # restricts the actual write to the primary host. maybe_save also
+        # skips a step the periodic hook already committed.
+        from ..train.checkpoint import maybe_save
+        maybe_save(args.train_dir, state,
+                   log=print if info.is_coordinator else (lambda s: None))
         exit_code = 0
         return 0
     finally:

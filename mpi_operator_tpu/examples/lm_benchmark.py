@@ -54,10 +54,13 @@ def run_lm_benchmark(
     data_dir: Optional[str] = None,
     train_dir: Optional[str] = None,
     ckpt_every: int = 0,
+    lr_schedule: str = "linear",
+    decay_steps: int = 10_000,
     profile_dir: Optional[str] = None,
     log: Callable[[str], None] = print,
 ) -> Tuple[object, Dict[str, float]]:
-    """GPT-2 / BERT token-stream benchmark on a dcn×dp×fsdp×tp mesh."""
+    """GPT-2 / llama / BERT token-stream benchmark on a dcn×dp×fsdp×tp
+    mesh."""
     import jax
     import jax.numpy as jnp
 
@@ -115,7 +118,8 @@ def run_lm_benchmark(
     global_batch = batch_per_device * n
     tcfg = LMTrainerConfig(global_batch_size=global_batch, seq_len=seq_len,
                            masked_lm=masked, fused_xent=fused_xent,
-                           accum_steps=accum_steps)
+                           accum_steps=accum_steps,
+                           lr_schedule=lr_schedule, decay_steps=decay_steps)
     if pp > 1:
         # GPipe over the pp axis: stage-sliced CausalLM with a pp-sharded
         # microbatch stream (train/pp_trainer.py). bert (masked) stays on
@@ -450,6 +454,11 @@ def main(argv=None) -> int:
                         help="async checkpoint every N steps into "
                              "--train-dir (mid-run gang restarts resume "
                              "from the last one; 0 = final only)")
+    parser.add_argument("--lr-schedule", default="linear",
+                        choices=["linear", "cosine"],
+                        help="warmup-linear (constant after warmup) or "
+                             "warmup-cosine decaying over --decay-steps")
+    parser.add_argument("--decay-steps", type=int, default=10_000)
     parser.add_argument("--profile-dir", default=None,
                         help="write a jax.profiler trace of the first "
                              "measurement window here (XProf format)")
@@ -495,6 +504,8 @@ def main(argv=None) -> int:
                 data_dir=args.data_dir,
                 train_dir=args.train_dir,
                 ckpt_every=args.ckpt_every,
+                lr_schedule=args.lr_schedule,
+                decay_steps=args.decay_steps,
                 profile_dir=args.profile_dir, log=log)
             headline = {"metric": f"{args.workload}_tokens_per_sec",
                         "value": round(metrics["tokens_per_sec"], 0),

@@ -41,6 +41,13 @@ def _state_payload(state):
 # (orbax commit semantics).
 _ASYNC_CKPTR: Optional[ocp.AsyncCheckpointer] = None
 
+# The last step THIS process saved. Every rank executes the same periodic
+# hooks in the same order, so the value is identical across processes by
+# construction — the safe way to decide whether to enter a COLLECTIVE
+# save (gating one on local os.listdir diverges on per-host filesystems
+# and deadlocks the ranks that enter against the ones that skip).
+_LAST_SAVED_STEP: Optional[int] = None
+
 
 def _async_checkpointer() -> ocp.AsyncCheckpointer:
     global _ASYNC_CKPTR
@@ -62,11 +69,13 @@ def save_checkpoint(directory: str, state, step: Optional[int] = None,
     block=False returns as soon as the device arrays are snapshotted and
     lets the write complete in the background (call wait_for_checkpoints
     — or any later save — to join it)."""
+    global _LAST_SAVED_STEP
     step = int(state.step) if step is None else step
     path = os.path.join(os.path.abspath(directory), f"step_{step}")
     ckptr = _async_checkpointer()
     ckptr.save(path, args=ocp.args.StandardSave(_state_payload(state)),
                force=True)
+    _LAST_SAVED_STEP = step
     if block:
         ckptr.wait_until_finished()
     return path
@@ -116,7 +125,12 @@ __all__ = ["save_checkpoint", "restore_checkpoint", "latest_checkpoint",
 def maybe_resume(train_dir, state, log=print):
     """Restore the latest checkpoint under train_dir into `state` (no-op
     when train_dir is falsy or empty). The single resume path every
-    benchmark entrypoint shares."""
+    benchmark entrypoint shares.
+
+    Multi-host: train_dir MUST be a filesystem every host shares (PVC/
+    NFS/GCS — the shipped manifests mount a PVC). Restore is a collective;
+    per-pod paths make the has-a-checkpoint decision diverge across ranks
+    and deadlock the ranks that enter against the ones that skip."""
     if not train_dir:
         return state
     latest = latest_checkpoint(train_dir)
@@ -130,16 +144,19 @@ def maybe_resume(train_dir, state, log=print):
 def maybe_save(train_dir, state, log=print):
     """Write a checkpoint when train_dir is set (collective across all
     processes — see examples/benchmark.py for why every rank must call).
-    Skips the write when the latest checkpoint already covers this step
-    (a periodic async save on the final step) — rewriting it with
-    force=True would delete the committed copy first, so a crash mid-
-    rewrite would destroy the newest checkpoint for nothing."""
+    Skips the write when THIS process already saved this step (the
+    periodic hook fired on the final step) — rewriting with force=True
+    would delete the committed copy first, so a crash mid-rewrite would
+    destroy the newest checkpoint for nothing. The skip decision uses the
+    in-process _LAST_SAVED_STEP, replicated across ranks by construction
+    (same hook sequence everywhere) — NEVER the local filesystem, which
+    diverges on per-host paths and would deadlock the collective."""
     if not train_dir:
         return
     step = int(state.step)
-    latest = latest_checkpoint(train_dir)     # joins in-flight writes
-    if latest is not None and os.path.basename(latest) == f"step_{step}":
-        log(f"checkpoint for step {step} already written ({latest})")
+    if _LAST_SAVED_STEP == step:
+        wait_for_checkpoints()                # join the in-flight write
+        log(f"checkpoint for step {step} already written")
         return
     path = save_checkpoint(train_dir, state)
     log(f"checkpoint written to {path}")
