@@ -50,9 +50,14 @@ def _valid_tpu_count(n: int) -> bool:
     return n in V5E_VALID_SLICE_CHIPS
 
 
-def validate_spec(spec: TPUJobSpec) -> None:
+def validate_spec(spec: TPUJobSpec,
+                  default_resource_type: str = RESOURCE_TPU) -> None:
     """Raises ValidationError listing every violation (the reference's schema
-    reports oneOf failure wholesale; we itemize for developer ergonomics)."""
+    reports oneOf failure wholesale; we itemize for developer ergonomics).
+
+    `default_resource_type` is the operator's effective default for specs
+    that leave processingResourceType unset (the --processing-resource-type
+    flag) — admission must agree with the controller's allocation."""
     errs: List[str] = []
 
     modes = [
@@ -94,6 +99,27 @@ def validate_spec(spec: TPUJobSpec) -> None:
 
     if spec.replicas is not None and spec.replicas < 1:
         errs.append(f"spec.replicas must be >= 1, got {spec.replicas}")
+    elif spec.replicas is not None:
+        # Mode B sizes each worker from the container's resource limit.
+        # The reference silently allocates ZERO units per worker when the
+        # limit is absent (mpi_job_controller.go:587-593) and the job then
+        # fails at runtime; we reject at admission instead — "fail at
+        # admission, not at runtime" (documented divergence).
+        rtype = spec.processing_resource_type or default_resource_type
+        if rtype == RESOURCE_TPU:
+            if not spec.template.containers:
+                errs.append(
+                    "spec.replicas mode requires a worker container with a "
+                    f"{rtype!r} resource limit; the pod template has no "
+                    "containers"
+                )
+            elif not spec.template.main_container().limits.get(rtype, 0):
+                errs.append(
+                    f"spec.replicas mode requires a {rtype!r} resource "
+                    f"limit on the worker container (each worker would "
+                    f"otherwise get zero chips; ref mpi_job_controller.go"
+                    f":587-593 allocates 0 silently — rejected here)"
+                )
 
     if spec.tpus_per_worker is not None and spec.tpus_per_worker < 1:
         errs.append(f"spec.tpusPerWorker must be >= 1, got {spec.tpus_per_worker}")

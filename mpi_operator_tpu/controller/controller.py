@@ -153,7 +153,9 @@ class TPUJobController:
         # shapes must fail at admission, not at runtime (SURVEY §7).
         from ..api.validation import validate_spec
         api_server.register_admission_validator(
-            api.KIND, lambda obj: validate_spec(obj.spec)
+            api.KIND, lambda obj: validate_spec(
+                obj.spec,
+                default_resource_type=self.config.processing_resource_type)
         )
 
         # 8 informers, matching the reference's registration (:204-321)

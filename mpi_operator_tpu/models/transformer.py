@@ -144,6 +144,20 @@ class Attention(nn.Module):
         H, D = cfg.num_heads, cfg.head_dim
         KV = cfg.kv_heads
 
+        from ..parallel.sharding import current_mesh
+        mesh = current_mesh()
+        tp = dict(mesh.shape).get("tp", 1) if mesh is not None else 1
+        if tp > 1 and H % tp == 0 and KV % tp:
+            # fail with a clear message at trace time: when query heads
+            # shard over tp but kv_heads can't (e.g. llama 64q/8kv on
+            # tp=16), the mismatch otherwise surfaces as an opaque GSPMD
+            # placement error. H % tp != 0 configs replicate everything
+            # (small test meshes) and stay valid.
+            raise ValueError(
+                f"num_kv_heads={KV} must be divisible by the mesh's tp={tp}"
+                f" when num_heads={H} is (K/V heads shard over tp); choose "
+                f"tp from the divisors of num_kv_heads")
+
         def proj(heads, name):
             return nn.DenseGeneral(
                 axis=-1, dtype=cfg.dtype, features=(heads, D), name=name,

@@ -41,12 +41,15 @@ def _state_payload(state):
 # (orbax commit semantics).
 _ASYNC_CKPTR: Optional[ocp.AsyncCheckpointer] = None
 
-# The last step THIS process saved. Every rank executes the same periodic
-# hooks in the same order, so the value is identical across processes by
-# construction — the safe way to decide whether to enter a COLLECTIVE
-# save (gating one on local os.listdir diverges on per-host filesystems
-# and deadlocks the ranks that enter against the ones that skip).
-_LAST_SAVED_STEP: Optional[int] = None
+# Per-directory last step THIS process saved. Every rank executes the
+# same periodic hooks in the same order, so the value is identical across
+# processes by construction — the safe way to decide whether to enter a
+# COLLECTIVE save (gating one on local os.listdir diverges on per-host
+# filesystems and deadlocks the ranks that enter against the ones that
+# skip). A dict (not a single slot) so interleaved saves to different
+# directories can't evict each other's record and trigger a needless
+# force-rewrite of a committed checkpoint.
+_LAST_SAVED: dict = {}
 
 
 def _async_checkpointer() -> ocp.AsyncCheckpointer:
@@ -69,13 +72,12 @@ def save_checkpoint(directory: str, state, step: Optional[int] = None,
     block=False returns as soon as the device arrays are snapshotted and
     lets the write complete in the background (call wait_for_checkpoints
     — or any later save — to join it)."""
-    global _LAST_SAVED_STEP
     step = int(state.step) if step is None else step
     path = os.path.join(os.path.abspath(directory), f"step_{step}")
     ckptr = _async_checkpointer()
     ckptr.save(path, args=ocp.args.StandardSave(_state_payload(state)),
                force=True)
-    _LAST_SAVED_STEP = step
+    _LAST_SAVED[os.path.abspath(directory)] = step
     if block:
         ckptr.wait_until_finished()
     return path
@@ -148,13 +150,13 @@ def maybe_save(train_dir, state, log=print):
     periodic hook fired on the final step) — rewriting with force=True
     would delete the committed copy first, so a crash mid-rewrite would
     destroy the newest checkpoint for nothing. The skip decision uses the
-    in-process _LAST_SAVED_STEP, replicated across ranks by construction
+    in-process _LAST_SAVED pair, replicated across ranks by construction
     (same hook sequence everywhere) — NEVER the local filesystem, which
     diverges on per-host paths and would deadlock the collective."""
     if not train_dir:
         return
     step = int(state.step)
-    if _LAST_SAVED_STEP == step:
+    if _LAST_SAVED.get(os.path.abspath(train_dir)) == step:
         wait_for_checkpoints()                # join the in-flight write
         log(f"checkpoint for step {step} already written")
         return

@@ -130,3 +130,26 @@ def test_multislice_validation_is_per_slice():
     with pytest.raises(ValidationError, match="processingUnits"):
         validate_spec(TPUJobSpec(processing_units=9, num_slices=2,
                                  slice_topology="2x2"))
+
+
+def test_mode_b_zero_chip_rejected_at_admission():
+    """replicas mode with TPU resource type and NO google.com/tpu limit
+    would give every worker zero chips. The reference allocates 0 silently
+    (mpi_job_controller.go:587-593) and the job fails at runtime; we
+    reject at admission instead (documented divergence — "fail at
+    admission, not at runtime")."""
+    from mpi_operator_tpu.api.types import RESOURCE_CPU, RESOURCE_TPU
+
+    with pytest.raises(ValidationError, match="resource limit"):
+        validate_spec(TPUJobSpec(replicas=2))
+    # an explicit TPU resource type without the limit is equally invalid
+    with pytest.raises(ValidationError, match="resource limit"):
+        validate_spec(TPUJobSpec(replicas=2,
+                                 processing_resource_type=RESOURCE_TPU))
+    # with the limit present the spec is fine
+    spec = TPUJobSpec(replicas=2)
+    spec.template.main_container().limits = {RESOURCE_TPU: 4}
+    validate_spec(spec)
+    # cpu-resource jobs carry no chips by design — not rejected
+    validate_spec(TPUJobSpec(replicas=2,
+                             processing_resource_type=RESOURCE_CPU))
