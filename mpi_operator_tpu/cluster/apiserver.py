@@ -119,8 +119,14 @@ class InMemoryAPIServer:
             self.actions.clear()
 
     def write_actions(self) -> List[Action]:
-        """Actions excluding reads — the test oracle's view."""
-        return [a for a in self.actions if a.verb not in self.READ_VERBS]
+        """Actions excluding reads AND Event posts — the test oracle's view.
+        The reference tests never see events because they swap in a
+        record.FakeRecorder (mpi_job_controller_test.go:177); here the
+        recorder posts through this same server, so the oracle filters the
+        Event kind instead (the filterInformerActions analogue). Tests that
+        assert on events read them via list("Event") or recorder.events."""
+        return [a for a in self.actions
+                if a.verb not in self.READ_VERBS and a.kind != "Event"]
 
     # -- admission ----------------------------------------------------------
 
@@ -212,12 +218,20 @@ class InMemoryAPIServer:
         except NotFoundError:
             return None
 
-    def list(self, kind: str, namespace: Optional[str] = None) -> List[object]:
+    def list(self, kind: str, namespace: Optional[str] = None,
+             label_selector: Optional[str] = None) -> List[object]:
+        selector = {}
+        for clause in (label_selector or "").split(","):
+            if "=" in clause:
+                k, _, v = clause.partition("=")
+                selector[k.strip()] = v.strip()
         with self._lock:
             return [
                 deepcopy_resource(o)
                 for (k, ns, _), o in sorted(self._store.items())
                 if k == kind and (namespace is None or ns == namespace)
+                and all(o.metadata.labels.get(sk) == sv
+                        for sk, sv in selector.items())
             ]
 
     def delete(self, kind: str, namespace: str, name: str) -> None:

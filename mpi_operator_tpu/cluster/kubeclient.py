@@ -370,13 +370,12 @@ class KubeAPIServer:
                            job_obj.metadata.name, e)
             return None
         for item in got.get("items") or []:
-            statuses = ((item.get("status") or {})
-                        .get("containerStatuses") or [])
-            for cs in statuses:
-                term = (cs.get("state") or {}).get("terminated") or {}
-                code = term.get("exitCode")
-                if code:
-                    return int(code)
+            # one canonical containerStatuses parser (serialize.Pod):
+            # first non-zero terminated exitCode wins
+            item.setdefault("kind", "Pod")
+            pod = from_manifest(item)
+            if pod.status.exit_code:
+                return pod.status.exit_code
         return None
 
     def try_get(self, kind: str, namespace: str, name: str):
@@ -385,12 +384,18 @@ class KubeAPIServer:
         except NotFoundError:
             return None
 
-    def list(self, kind: str, namespace: Optional[str] = None):
-        objs, _ = self._list_with_rv(kind, namespace)
+    def list(self, kind: str, namespace: Optional[str] = None,
+             label_selector: Optional[str] = None):
+        objs, _ = self._list_with_rv(kind, namespace,
+                                     label_selector=label_selector)
         return objs
 
-    def _list_with_rv(self, kind: str, namespace: Optional[str]):
-        got = self._request("GET", _resource_path(kind, namespace))
+    def _list_with_rv(self, kind: str, namespace: Optional[str],
+                      label_selector: Optional[str] = None):
+        query = ({"labelSelector": label_selector}
+                 if label_selector else None)
+        got = self._request("GET", _resource_path(kind, namespace),
+                            query=query)
         rv = (got.get("metadata") or {}).get("resourceVersion", "")
         items = []
         for item in got.get("items") or []:

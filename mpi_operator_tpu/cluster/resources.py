@@ -140,6 +140,55 @@ class Job:
         return self.status.failed > 0
 
 
+@dataclass
+class PodStatus:
+    """Minimal pod status: phase + container restart/exit data. Worker
+    crash-loops are invisible at the StatefulSet level (RestartPolicy=
+    Always means kubelet resurrects the pod in place), so the controller
+    reads these to surface failures into replicaStatuses (v1alpha2
+    common_types.go:68-80)."""
+    phase: str = "Running"            # Pending|Running|Succeeded|Failed
+    restart_count: int = 0            # sum over containerStatuses[]
+    exit_code: Optional[int] = None   # last terminated container, if any
+
+
+@dataclass
+class Pod:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    status: PodStatus = field(default_factory=PodStatus)
+    kind: str = "Pod"
+
+
+@dataclass
+class ObjectReference:
+    """core/v1 ObjectReference — the involvedObject of an Event."""
+    kind: str = ""
+    namespace: str = ""
+    name: str = ""
+    uid: str = ""
+    api_version: str = ""
+
+
+@dataclass
+class Event:
+    """core/v1 Event. The reference wires its recorder into the core-v1
+    Events sink (mpi_job_controller.go:165-172) so `kubectl describe
+    mpijob` surfaces Synced/ErrResourceExists warnings (:518, :539); this
+    is the typed analogue the EventRecorder posts. `count`/timestamps
+    implement client-go's correlator aggregation: a repeated identical
+    event bumps count instead of creating a new object."""
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    involved_object: ObjectReference = field(default_factory=ObjectReference)
+    reason: str = ""
+    message: str = ""
+    type: str = "Normal"            # Normal | Warning
+    count: int = 1
+    first_timestamp: Optional[float] = None
+    last_timestamp: Optional[float] = None
+    source_component: str = ""
+    kind: str = "Event"
+
+
 def deepcopy_resource(obj):
     return copy.deepcopy(obj)
 
@@ -148,5 +197,5 @@ __all__ = [
     "ConfigMap", "ServiceAccount", "PolicyRule", "Role", "RoleBinding",
     "PodDisruptionBudget", "Service", "StatefulSet", "StatefulSetSpec",
     "StatefulSetStatus", "Job", "JobSpec", "JobStatus", "Container",
-    "deepcopy_resource",
+    "Event", "ObjectReference", "Pod", "PodStatus", "deepcopy_resource",
 ]

@@ -38,9 +38,13 @@ from ..api.types import (
 )
 from .resources import (
     ConfigMap,
+    Event,
     Job,
     JobSpec,
     JobStatus,
+    ObjectReference,
+    Pod,
+    PodStatus,
     PodDisruptionBudget,
     PolicyRule,
     Role,
@@ -67,6 +71,8 @@ API_RESOURCES: Dict[str, tuple] = {
     # failed pod carries the container exit code the ExitCode restart policy
     # needs (kubeclient.KubeAPIServer._lookup_exit_code)
     "Pod": ("v1", "pods"),
+    # core/v1 Events: the recorder sink (ref mpi_job_controller.go:165-172)
+    "Event": ("v1", "events"),
 }
 
 
@@ -496,6 +502,79 @@ def _pdb_from_manifest(m: dict) -> PodDisruptionBudget:
     return PodDisruptionBudget(min_available=int(spec.get("minAvailable", 0)))
 
 
+def _pod_to_manifest(p: Pod) -> dict:
+    cs = {"restartCount": p.status.restart_count}
+    if p.status.exit_code is not None:
+        cs["state"] = {"terminated": {"exitCode": p.status.exit_code}}
+    return {
+        "status": _prune({
+            "phase": p.status.phase,
+            "containerStatuses": [cs],
+        }),
+    }
+
+
+def _pod_from_manifest(m: dict) -> Pod:
+    # Canonical containerStatuses parsing — the ONE place that decides
+    # exit-code semantics (kubeclient._lookup_exit_code consumes this):
+    # the first NON-ZERO terminated exitCode wins (the failure cause);
+    # all-zero terminations report 0; no terminations report None.
+    status = m.get("status") or {}
+    restarts = 0
+    exit_code = None
+    for cs in status.get("containerStatuses") or []:
+        restarts += int(cs.get("restartCount", 0))
+        term = (cs.get("state") or {}).get("terminated") or {}
+        code = term.get("exitCode")
+        if code is not None and (exit_code is None or exit_code == 0):
+            exit_code = int(code)
+    return Pod(status=PodStatus(
+        phase=status.get("phase", "Running"),
+        restart_count=restarts,
+        exit_code=exit_code,
+    ))
+
+
+def _event_to_manifest(e: Event) -> dict:
+    io = e.involved_object
+    return {
+        "involvedObject": _prune({
+            "kind": io.kind,
+            "namespace": io.namespace,
+            "name": io.name,
+            "uid": io.uid or None,
+            "apiVersion": io.api_version or None,
+        }),
+        "reason": e.reason,
+        "message": e.message,
+        "type": e.type,
+        "count": e.count,
+        "firstTimestamp": rfc3339(e.first_timestamp),
+        "lastTimestamp": rfc3339(e.last_timestamp),
+        "source": {"component": e.source_component},
+    }
+
+
+def _event_from_manifest(m: dict) -> Event:
+    io = m.get("involvedObject") or {}
+    return Event(
+        involved_object=ObjectReference(
+            kind=io.get("kind", ""),
+            namespace=io.get("namespace", ""),
+            name=io.get("name", ""),
+            uid=io.get("uid", ""),
+            api_version=io.get("apiVersion", ""),
+        ),
+        reason=m.get("reason", ""),
+        message=m.get("message", ""),
+        type=m.get("type", "Normal"),
+        count=int(m.get("count", 1)),
+        first_timestamp=parse_time(m.get("firstTimestamp")),
+        last_timestamp=parse_time(m.get("lastTimestamp")),
+        source_component=(m.get("source") or {}).get("component", ""),
+    )
+
+
 # ---------------------------------------------------------------------------
 # public API
 # ---------------------------------------------------------------------------
@@ -527,6 +606,10 @@ def to_manifest(obj) -> dict:
         body.update(_statefulset_to_manifest(obj))
     elif kind == "Job":
         body.update(_job_to_manifest(obj))
+    elif kind == "Event":
+        body.update(_event_to_manifest(obj))
+    elif kind == "Pod":
+        body.update(_pod_to_manifest(obj))
     else:  # pragma: no cover — API_RESOURCES lookup above already raised
         raise KeyError(kind)
     return body
@@ -569,6 +652,14 @@ def from_manifest(m: dict):
         job = _job_from_manifest(m)
         job.metadata = meta
         return job
+    if kind == "Event":
+        ev = _event_from_manifest(m)
+        ev.metadata = meta
+        return ev
+    if kind == "Pod":
+        pod = _pod_from_manifest(m)
+        pod.metadata = meta
+        return pod
     raise KeyError(f"unknown kind {kind!r}")
 
 

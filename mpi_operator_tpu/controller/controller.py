@@ -21,8 +21,9 @@ from __future__ import annotations
 
 import logging
 import threading
+import time
 from dataclasses import dataclass, field
-from typing import List, Optional, Tuple
+from typing import Dict, List, Optional, Tuple
 
 from ..api import types as api
 from ..api.types import (
@@ -41,7 +42,8 @@ from ..api.types import (
     TPUJob,
     is_controlled_by,
 )
-from ..cluster.apiserver import InMemoryAPIServer, NotFoundError
+from ..cluster.apiserver import (
+    AlreadyExistsError, InMemoryAPIServer, NotFoundError)
 from ..cluster.informers import InformerFactory
 from ..cluster.resources import (
     ConfigMap,
@@ -95,17 +97,77 @@ class Event:
 
 
 class EventRecorder:
-    """In-memory recorder; the FakeRecorder equivalent the tests use
-    (ref mpi_job_controller_test.go:177). Bounded: a run-forever operator
-    appends per reconcile, so an unbounded list would leak."""
-    MAX_EVENTS = 1000
+    """Event recorder with a real core-v1 sink.
 
-    def __init__(self):
+    The reference wires its broadcaster into the Events API
+    (StartRecordingToSink, mpi_job_controller.go:165-172) so `kubectl
+    describe mpijob` shows Synced/ErrResourceExists at exactly the moment
+    a user debugs a stuck job. Given an api_server this does the same:
+    every event is POSTed as a core/v1 Event; a repeat of an identical
+    (object, type, reason, message) bumps `count` on the existing Event
+    instead of creating a new one (client-go's correlator aggregation).
+
+    Without an api_server it degrades to the in-memory deque — the
+    FakeRecorder equivalent tests use (ref mpi_job_controller_test.go:177).
+    Posting is best-effort: a sink failure must never fail a reconcile.
+    Bounded deque: a run-forever operator appends per reconcile, so an
+    unbounded list would leak."""
+    MAX_EVENTS = 1000
+    COMPONENT = "tpu-operator"
+
+    def __init__(self, api_server=None):
         from collections import deque
         self.events = deque(maxlen=self.MAX_EVENTS)
+        self.api = api_server
+        # correlator: (ns, involved uid, type, reason, message) -> Event name
+        self._correlated: Dict[tuple, str] = {}
 
-    def event(self, _obj, etype: str, reason: str, message: str) -> None:
+    def event(self, obj, etype: str, reason: str, message: str) -> None:
         self.events.append(Event(etype, reason, message))
+        if self.api is None or obj is None:
+            return
+        try:
+            self._post(obj, etype, reason, message)
+        except Exception as exc:  # noqa: BLE001 — observability only
+            logger.warning("event sink post failed: %s", exc)
+
+    def _post(self, obj, etype: str, reason: str, message: str) -> None:
+        from ..cluster.resources import Event as CoreEvent, ObjectReference
+
+        ns = obj.metadata.namespace
+        now = time.time()
+        key = (ns, obj.metadata.uid or obj.metadata.name, etype, reason,
+               message)
+        name = self._correlated.get(key)
+        if name is not None:
+            existing = None
+            try:
+                existing = self.api.get("Event", ns, name)
+            except NotFoundError:
+                pass                  # pruned server-side; recreate below
+            if existing is not None:
+                existing.count += 1
+                existing.last_timestamp = now
+                self.api.update(existing)
+                return
+        # client-go names events "<involved>.<unique hex>"
+        name = f"{obj.metadata.name}.{int(now * 1e6):x}"
+        self.api.create(CoreEvent(
+            metadata=ObjectMeta(name=name, namespace=ns),
+            involved_object=ObjectReference(
+                kind=obj.kind, namespace=ns, name=obj.metadata.name,
+                uid=obj.metadata.uid,
+                api_version=f"{api.GROUP_NAME}/{api.API_VERSION}"
+                if obj.kind == api.KIND else "v1",
+            ),
+            reason=reason, message=message, type=etype, count=1,
+            first_timestamp=now, last_timestamp=now,
+            source_component=self.COMPONENT,
+        ))
+        self._correlated[key] = name
+        # bound the correlator like the deque — drop oldest entries
+        while len(self._correlated) > self.MAX_EVENTS:
+            self._correlated.pop(next(iter(self._correlated)))
 
 
 @dataclass
@@ -142,11 +204,18 @@ class TPUJobController:
     ):
         self.api = api_server
         self.config = config or ControllerConfig()
-        self.recorder = recorder or EventRecorder()
+        # default recorder posts real core-v1 Events through the same API
+        # server the reconciler writes to (ref StartRecordingToSink,
+        # mpi_job_controller.go:165-172)
+        self.recorder = recorder or EventRecorder(api_server)
         self.factory = factory or InformerFactory(api_server, self.config.namespace)
         self.queue = RateLimitingQueue()
         from .metrics import SyncCounters
         self.sync_counters = SyncCounters()
+        # per-job {pod_uid: (max restart count seen, last phase)} — the
+        # delta baseline for cumulative worker-crash accounting; entries
+        # are dropped once a job reaches a terminal state
+        self._worker_restart_marks: Dict[tuple, dict] = {}
 
         # Admission: reject invalid TPUJob specs at create/update, the CRD
         # openAPIV3-schema analogue (ref deploy/0-crd.yaml:16-99) — invalid
@@ -355,7 +424,8 @@ class TPUJobController:
             and worker.status.ready_replicas == alloc.worker_replicas
         ) or alloc.worker_replicas == 0
         if not done and workers_ready and launcher is None:
-            launcher = self.api.create(self.new_launcher(job, alloc))
+            launcher, _ = self._create_or_get(self.new_launcher(job, alloc),
+                                              job)
 
         self.update_tpu_job_status(job, launcher, worker)      # ref :513, :761-791
 
@@ -485,6 +555,22 @@ class TPUJobController:
             raise ForeignOwnershipError(obj.kind, obj.metadata.name)
         return obj
 
+    def _create_or_get(self, desired, job: TPUJob) -> Tuple[object, bool]:
+        """Create `desired`; on AlreadyExists read the live object through
+        the API server (bypassing the informer cache) and ownership-check
+        it. Returns (obj, created). Against a real cluster the informer
+        lags its own writes by a watch round-trip, so right after a create
+        the lister still misses the child; the reference fails the sync
+        and relies on requeue backoff (AlreadyExists → error → retry,
+         8-10 wasted syncs per job) — reading through converges in THIS
+        sync instead."""
+        try:
+            return self.api.create(desired), True
+        except AlreadyExistsError:
+            fetched = self.api.get(desired.kind, desired.metadata.namespace,
+                                   desired.metadata.name)
+            return self._check_ownership(fetched, job), False
+
     def get_or_create_config_map(self, job: TPUJob, alloc: AllocationResult) -> ConfigMap:
         """ref: getOrCreateConfigMap (:627-648) + newConfigMap (:849-885).
         Updates in place if the discovery data drifted (worker count change),
@@ -493,8 +579,11 @@ class TPUJobController:
         desired = self.new_config_map(job, alloc)
         existing = self.configmap_lister.try_get(job.metadata.namespace, name)
         if existing is None:
-            return self.api.create(desired)
-        self._check_ownership(existing, job)
+            existing, created = self._create_or_get(desired, job)
+            if created:
+                return existing
+        else:
+            self._check_ownership(existing, job)
         if existing.data != desired.data:
             existing.data = desired.data
             return self.api.update(existing)
@@ -506,7 +595,7 @@ class TPUJobController:
         name = job.metadata.name + WORKER_SUFFIX
         existing = self.service_lister.try_get(job.metadata.namespace, name)
         if existing is None:
-            return self.api.create(self.new_worker_service(job))
+            return self._create_or_get(self.new_worker_service(job), job)[0]
         return self._check_ownership(existing, job)
 
     def new_worker_service(self, job: TPUJob) -> Service:
@@ -529,7 +618,8 @@ class TPUJobController:
         name = job.metadata.name + LAUNCHER_SUFFIX
         existing = self.sa_lister.try_get(job.metadata.namespace, name)
         if existing is None:
-            return self.api.create(self.new_launcher_service_account(job))
+            return self._create_or_get(
+                self.new_launcher_service_account(job), job)[0]
         return self._check_ownership(existing, job)
 
     def get_or_create_launcher_role(self, job: TPUJob, worker_replicas: int) -> Role:
@@ -539,8 +629,11 @@ class TPUJobController:
         desired = self.new_launcher_role(job, worker_replicas)
         existing = self.role_lister.try_get(job.metadata.namespace, name)
         if existing is None:
-            return self.api.create(desired)
-        self._check_ownership(existing, job)
+            existing, created = self._create_or_get(desired, job)
+            if created:
+                return existing
+        else:
+            self._check_ownership(existing, job)
         if existing.rules != desired.rules:
             existing.rules = desired.rules
             return self.api.update(existing)
@@ -551,7 +644,8 @@ class TPUJobController:
         name = job.metadata.name + LAUNCHER_SUFFIX
         existing = self.rolebinding_lister.try_get(job.metadata.namespace, name)
         if existing is None:
-            return self.api.create(self.new_launcher_role_binding(job))
+            return self._create_or_get(
+                self.new_launcher_role_binding(job), job)[0]
         return self._check_ownership(existing, job)
 
     def get_or_create_pdb(self, job: TPUJob, worker_replicas: int) -> PodDisruptionBudget:
@@ -560,8 +654,11 @@ class TPUJobController:
         desired = self.new_pdb(job, worker_replicas)
         existing = self.pdb_lister.try_get(job.metadata.namespace, name)
         if existing is None:
-            return self.api.create(desired)
-        self._check_ownership(existing, job)
+            existing, created = self._create_or_get(desired, job)
+            if created:
+                return existing
+        else:
+            self._check_ownership(existing, job)
         if existing.min_available != desired.min_available:
             existing.min_available = desired.min_available
             return self.api.update(existing)
@@ -577,8 +674,12 @@ class TPUJobController:
         if existing is None:
             if alloc.worker_replicas == 0:
                 return None
-            return self.api.create(self.new_worker(job, alloc))
-        self._check_ownership(existing, job)
+            existing, created = self._create_or_get(
+                self.new_worker(job, alloc), job)
+            if created:
+                return existing
+        else:
+            self._check_ownership(existing, job)
         if existing.spec.replicas != alloc.worker_replicas:    # ref :748-756
             existing.spec.replicas = alloc.worker_replicas
             return self.api.update(existing)
@@ -836,6 +937,39 @@ class TPUJobController:
             ),
         )
 
+    def _worker_crash_delta(self, job: TPUJob) -> int:
+        """NEW worker crashes since the last sync: positive per-pod deltas
+        of kubelet restart counts (keyed by pod uid, so a recreated pod's
+        counter reset never hides its fresh crashes) plus newly-Failed
+        pods. Best-effort: a backend without pod-read access (or no pods
+        yet) reports 0 rather than failing the sync. The reference can't
+        see this at all — its workers are `sleep` landing pads whose
+        health is irrelevant; ours run the training process, so a
+        crash-looping worker means the job is sick even while every
+        StatefulSet counter looks green."""
+        try:
+            pods = self.api.list(
+                "Pod", job.metadata.namespace,
+                label_selector=f"{LABEL_GROUP}={job.metadata.name},"
+                               f"tpu_job_role=worker")
+        except Exception as exc:  # noqa: BLE001 — observability only
+            logger.debug("worker pod list failed: %s", exc)
+            return 0
+        key = (job.metadata.namespace, job.metadata.name)
+        marks = self._worker_restart_marks.setdefault(key, {})
+        delta = 0
+        for pod in pods:
+            uid = pod.metadata.uid or pod.metadata.name
+            seen = marks.get(uid, (0, ""))[0]
+            now_count = pod.status.restart_count
+            if now_count > seen:
+                delta += now_count - seen
+            phase = pod.status.phase
+            if phase == "Failed" and marks.get(uid, (0, ""))[1] != "Failed":
+                delta += 1
+            marks[uid] = (max(now_count, seen), phase)
+        return delta
+
     # ------------------------------------------------------------------
     # status (ref updateMPIJobStatus :761-791) + v1alpha2 conditions
     # ------------------------------------------------------------------
@@ -907,11 +1041,34 @@ class TPUJobController:
                 "launcher", api.ReplicaStatus())
         else:
             launcher_rs = api.ReplicaStatus()
+        # Worker failures are otherwise invisible (RestartPolicy=Always:
+        # kubelet resurrects crashed workers in place, so the StatefulSet
+        # always looks healthy). Read the worker pods and accumulate crash
+        # events into ReplicaStatus.failed (v1alpha2 common_types.go:68-80)
+        # — a true cumulative history: per-pod restart-count deltas survive
+        # pod recreation (counter resets) because marks key on pod uid.
+        # Terminal jobs stop paying the pod LIST.
+        prev_failed = job.status.replica_statuses.get(
+            "worker", api.ReplicaStatus()).failed
+        if worker is not None and not job.status.is_done():
+            delta = self._worker_crash_delta(job)
+        else:
+            delta = 0
+            # terminal: drop the delta baseline (bounded memory — the
+            # recorded .failed total lives on in status)
+            self._worker_restart_marks.pop(
+                (job.metadata.namespace, job.metadata.name), None)
+        worker_failed = prev_failed + delta
+        if delta > 0 and worker_failed >= 2:
+            # repeated restarts = crash loop; one Warning per escalation
+            # (the Events correlator aggregates repeats into count bumps)
+            self.recorder.event(
+                job, "Warning", "WorkerCrashLoop",
+                "worker pods are crash-looping; check "
+                "`kubectl logs` on the worker StatefulSet")
         desired = {
             "launcher": launcher_rs,
-            # no worker failed-count: the StatefulSet's RestartPolicy=Always
-            # means kubelet resurrects workers rather than failing them
-            "worker": api.ReplicaStatus(active=ready),
+            "worker": api.ReplicaStatus(active=ready, failed=worker_failed),
         }
         if job.status.replica_statuses != desired:
             job.status.replica_statuses = desired

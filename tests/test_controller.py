@@ -701,3 +701,194 @@ def test_metrics_sync_error_counter():
     assert f.controller.process_next_work_item(timeout=1.0)
     body = render_metrics(f.controller)
     assert "tpu_operator_sync_errors_total 1" in body
+
+
+# ---------------------------------------------------------------------------
+# real Kubernetes Events (ref StartRecordingToSink :165-172; Synced :518,
+# ErrResourceExists :539)
+# ---------------------------------------------------------------------------
+
+def test_synced_event_posted_and_aggregated():
+    """The recorder POSTs a core/v1 Event through the API server on every
+    Synced, and a repeated identical event bumps count on the SAME Event
+    object (client-go correlator aggregation) instead of flooding new
+    ones."""
+    f = Fixture()
+    f.seed(new_job(tpus=8))
+    f.run("default/test")
+    events = f.api.list("Event", "default")
+    synced = [e for e in events if e.reason == "Synced"]
+    assert len(synced) == 1
+    ev = synced[0]
+    assert ev.type == "Normal"
+    assert ev.involved_object.kind == api.KIND
+    assert ev.involved_object.name == "test"
+    assert ev.involved_object.uid
+    assert ev.count == 1
+    assert ev.source_component == "tpu-operator"
+    assert ev.first_timestamp and ev.last_timestamp
+
+    f.run("default/test")                 # level-triggered re-sync
+    events = f.api.list("Event", "default")
+    synced = [e for e in events if e.reason == "Synced"]
+    assert len(synced) == 1               # still one object...
+    assert synced[0].count == 2           # ...with the count bumped
+    assert synced[0].last_timestamp >= ev.last_timestamp
+
+
+def test_ownership_conflict_event_posted():
+    """The ErrResourceExists warning reaches the Events API (ref :539) so
+    `kubectl describe tpujob` shows it while a user debugs a stuck job."""
+    f = Fixture()
+    f.seed(new_job(tpus=8))
+    f.seed(ConfigMap(metadata=_foreign_meta("test" + CONFIG_SUFFIX)))
+    f.run("default/test", expect_error=ForeignOwnershipError)
+    warnings = [e for e in f.api.list("Event", "default")
+                if e.type == "Warning"]
+    assert len(warnings) == 1
+    assert warnings[0].reason == "ErrResourceExists"
+    assert "test-config" in warnings[0].message
+
+
+def test_event_posts_never_fail_reconcile():
+    """A broken Events sink must not fail a sync — posting is best-effort
+    observability (the reference's broadcaster is fire-and-forget too)."""
+    class ExplodingSink:
+        def __getattr__(self, _name):
+            raise RuntimeError("sink down")
+
+    f = Fixture()
+    f.seed(new_job(tpus=8))
+    f.controller.recorder.api = ExplodingSink()
+    f.run("default/test")      # must not raise
+    status = f.api.get(api.KIND, "default", "test").status
+    assert status.conditions              # sync actually did its work
+
+
+# ---------------------------------------------------------------------------
+# worker failure visibility (v1alpha2 ReplicaStatus, common_types.go:68-80)
+# ---------------------------------------------------------------------------
+
+def _worker_pod(name, job="test", restarts=0, phase="Running"):
+    from mpi_operator_tpu.cluster.resources import Pod, PodStatus
+    return Pod(
+        metadata=ObjectMeta(
+            name=name, namespace="default",
+            labels={"tpu_job_name": job, "tpu_job_role": "worker"}),
+        status=PodStatus(phase=phase, restart_count=restarts),
+    )
+
+
+def test_worker_restarts_surface_in_replica_status():
+    """A crash-looping worker must be visible: kubelet resurrects workers
+    in place (RestartPolicy=Always) so the StatefulSet always looks
+    healthy — the controller reads worker pods and surfaces restarts into
+    replicaStatuses["worker"].failed, plus a Warning Event."""
+    f = Fixture()
+    f.seed(new_job(tpus=8))
+    _seed_workers(f, job=f.api.get(api.KIND, "default", "test"),
+                  replicas=2, ready=2)
+    f.seed(_worker_pod("test-worker-0", restarts=3))
+    f.seed(_worker_pod("test-worker-1", restarts=0))
+    f.run("default/test")
+    st = f.api.get(api.KIND, "default", "test").status
+    assert st.replica_statuses["worker"].failed == 3
+    assert st.replica_statuses["worker"].active == 2
+    warnings = [e for e in f.controller.recorder.events
+                if e.type == "Warning"]
+    assert any(e.reason == "WorkerCrashLoop" for e in warnings)
+
+
+def test_healthy_workers_report_zero_failed():
+    f = Fixture()
+    f.seed(new_job(tpus=8))
+    _seed_workers(f, job=f.api.get(api.KIND, "default", "test"),
+                  replicas=2, ready=2)
+    f.seed(_worker_pod("test-worker-0"))
+    f.seed(_worker_pod("test-worker-1"))
+    f.run("default/test")
+    st = f.api.get(api.KIND, "default", "test").status
+    assert st.replica_statuses["worker"].failed == 0
+    assert not any(e.reason == "WorkerCrashLoop"
+                   for e in f.controller.recorder.events)
+
+
+def test_failed_count_is_cumulative_across_pod_recreation():
+    """Pod deletion resets kubelet restart counters; the recorded failed
+    count is a true cumulative crash history — it neither regresses NOR
+    hides fresh crashes of the replacement pod (per-pod uid-keyed restart
+    baselines, not a high-water mark)."""
+    f = Fixture()
+    f.seed(new_job(tpus=8))
+    _seed_workers(f, job=f.api.get(api.KIND, "default", "test"),
+                  replicas=2, ready=2)
+    f.seed(_worker_pod("test-worker-0", restarts=4))
+    f.run("default/test")
+    st = f.api.get(api.KIND, "default", "test").status
+    assert st.replica_statuses["worker"].failed == 4
+    f.api.delete("Pod", "default", "test-worker-0")   # pod recreated fresh
+    f.seed(_worker_pod("test-worker-0", restarts=0))  # counter reset
+    f.run("default/test")
+    st = f.api.get(api.KIND, "default", "test").status
+    assert st.replica_statuses["worker"].failed == 4   # no regression
+    # the REPLACEMENT crash-loops: its fresh restarts must still count
+    pod = f.api.get("Pod", "default", "test-worker-0")
+    pod.status.restart_count = 3
+    f.api.update(pod)
+    f.run("default/test")
+    st = f.api.get(api.KIND, "default", "test").status
+    assert st.replica_statuses["worker"].failed == 7   # 4 + 3, cumulative
+
+
+def test_foreign_pods_ignored_in_failure_count():
+    """Pods of other jobs (or non-worker roles) don't pollute the count."""
+    f = Fixture()
+    f.seed(new_job(tpus=8))
+    _seed_workers(f, job=f.api.get(api.KIND, "default", "test"),
+                  replicas=2, ready=2)
+    f.seed(_worker_pod("other-worker-0", job="other", restarts=9))
+    launcher_pod = _worker_pod("test-launcher-x", restarts=5)
+    launcher_pod.metadata.labels["tpu_job_role"] = "launcher"
+    f.seed(launcher_pod)
+    f.run("default/test")
+    st = f.api.get(api.KIND, "default", "test").status
+    assert st.replica_statuses["worker"].failed == 0
+
+
+# ---------------------------------------------------------------------------
+# create-race read-through (real-cluster informer lag)
+# ---------------------------------------------------------------------------
+
+def test_create_race_resolved_by_read_through():
+    """Against a real API server the informer lags its own writes by a
+    watch round-trip: a child can exist server-side while the lister still
+    misses it. The sync must read through (create → AlreadyExists → direct
+    GET + ownership check) and converge in THIS pass instead of failing
+    8-10 syncs on requeue backoff (which is what the reference does)."""
+    f = Fixture()
+    job = f.seed(new_job(tpus=8))
+    alloc = f.controller.allocate_processing_units(job, False)
+    cm = f.controller.new_config_map(job, alloc)
+    # plant the child server-side WITHOUT a watch notification — the
+    # informer-lag state (white-box: the in-memory server's watch fanout
+    # is synchronous, so this is the only way to simulate the lag)
+    cm.metadata.resource_version = 999
+    cm.metadata.uid = "uid-race"
+    f.api._store[("ConfigMap", "default", "test" + CONFIG_SUFFIX)] = cm
+    assert f.controller.configmap_lister.try_get(
+        "default", "test" + CONFIG_SUFFIX) is None     # lister blind
+    f.run("default/test")                              # must not raise
+    st = f.api.get(api.KIND, "default", "test").status
+    assert st.conditions                               # sync completed
+
+
+def test_create_race_foreign_owner_still_refused():
+    """Read-through must NOT become adoption: a same-named child owned by
+    someone else still fails the sync (ref :641-645)."""
+    f = Fixture()
+    f.seed(new_job(tpus=8))
+    foreign = ConfigMap(metadata=_foreign_meta("test" + CONFIG_SUFFIX))
+    foreign.metadata.resource_version = 999
+    foreign.metadata.uid = "uid-foreign"
+    f.api._store[("ConfigMap", "default", "test" + CONFIG_SUFFIX)] = foreign
+    f.run("default/test", expect_error=ForeignOwnershipError)

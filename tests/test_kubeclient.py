@@ -139,6 +139,34 @@ class TestSerializeRoundTrip:
             if obj.kind == "Role":
                 assert back.rules == obj.rules
 
+    def test_event_and_pod_roundtrip(self):
+        """The Event sink kind and the Pod read-path kind survive the wire
+        (timestamps quantize to whole seconds — RFC3339 without fractions,
+        same as every other kind)."""
+        from mpi_operator_tpu.cluster.resources import (
+            Event, ObjectReference, Pod, PodStatus)
+
+        ev = Event(
+            metadata=ObjectMeta(name="trainjob.1a2b3c", namespace="default"),
+            involved_object=ObjectReference(
+                kind="TPUJob", namespace="default", name="trainjob",
+                uid="uid-7", api_version="tpu.kubeflow.org/v1alpha1"),
+            reason="Synced", message="TPUJob synced successfully",
+            type="Normal", count=3,
+            first_timestamp=1700000000.0, last_timestamp=1700000600.0,
+            source_component="tpu-operator")
+        back = from_manifest(to_manifest(ev))
+        assert back == ev
+
+        pod = Pod(
+            metadata=ObjectMeta(name="trainjob-worker-0",
+                                namespace="default",
+                                labels={"tpu_job_name": "trainjob",
+                                        "tpu_job_role": "worker"}),
+            status=PodStatus(phase="Running", restart_count=2, exit_code=137))
+        back = from_manifest(to_manifest(pod))
+        assert back == pod
+
     def test_time_format(self):
         assert rfc3339(0.0) == "1970-01-01T00:00:00Z"
         assert parse_time("1970-01-01T00:00:00Z") == 0.0
@@ -420,6 +448,30 @@ class TestWireFormat:
         svc = reconciled.get_object("services", "default", "trainjob-worker")
         assert svc["spec"]["clusterIP"] == "None"
         assert svc["spec"]["selector"]["tpu_job_name"] == "trainjob"
+
+    def test_synced_event_posted_over_the_wire(self, reconciled):
+        """The recorder reaches the real core-v1 Events sink (ref
+        StartRecordingToSink, mpi_job_controller.go:165-172; Synced event
+        :518): after a reconcile the scripted server must hold a POSTed
+        Event manifest with the exact wire fields kubectl consumes."""
+        events = reconciled.objects_of("events")
+        synced = [e for e in events if e.get("reason") == "Synced"]
+        assert synced, f"no Synced event posted; got {events}"
+        ev = synced[0]
+        assert ev["apiVersion"] == "v1"
+        assert ev["kind"] == "Event"
+        assert ev["type"] == "Normal"
+        assert ev["message"] == "TPUJob synced successfully"
+        assert ev["source"] == {"component": "tpu-operator"}
+        io = ev["involvedObject"]
+        assert io["kind"] == "TPUJob"
+        assert io["name"] == "trainjob"
+        assert io["apiVersion"] == "tpu.kubeflow.org/v1alpha1"
+        assert io["uid"]                        # correlatable by kubectl
+        assert ev["firstTimestamp"].endswith("Z")
+        assert ev["count"] >= 1
+        # the Event's name is "<involved>.<hex>" (client-go convention)
+        assert ev["metadata"]["name"].startswith("trainjob.")
 
 
 # ---------------------------------------------------------------------------
