@@ -109,6 +109,9 @@ class Container:
     limits: Dict[str, int] = field(default_factory=dict)
     requests: Dict[str, int] = field(default_factory=dict)
     volume_mounts: List[Dict[str, str]] = field(default_factory=list)
+    # wire-format core/v1 Probe dict ({exec|httpGet, periodSeconds, ...});
+    # the controller injects the TPU-health readiness gate here
+    readiness_probe: Optional[Dict] = None
 
     def copy(self) -> "Container":
         return copy.deepcopy(self)

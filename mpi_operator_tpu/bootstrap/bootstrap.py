@@ -38,6 +38,15 @@ ENV_LOCAL_RANK = "TPU_LOCAL_RANK"          # set by bootstrap.launch for slots>1
 ENV_CONFIG_PATH = "TPU_CONFIG_PATH"
 ENV_LAUNCHER = "TPU_LAUNCHER"
 ENV_NUM_SLICES = "TPU_NUM_SLICES"
+# TPU-health readiness gate (SURVEY §7 "Readiness vs ICI formation"):
+# when the controller injects TPU_READY_FILE, the worker writes the marker
+# only after the accelerator runtime proved usable (device_check), and the
+# injected readinessProbe checks the file — so the pod's Ready (and hence
+# the launcher gate, ref mpi_job_controller.go:503-509) means "chips
+# enumerate", not just "container started".
+ENV_READY_FILE = "TPU_READY_FILE"
+ENV_EXPECTED_CHIPS = "TPU_EXPECTED_CHIPS"
+READY_FILE_DEFAULT = "/tmp/tpu-ready"
 
 #: rank-0 serves job status here for the launcher's completion poll
 STATUS_PORT = 8477
@@ -144,6 +153,48 @@ def process_info(
     )
 
 
+def device_check(expected_chips: Optional[int] = None) -> int:
+    """Prove the accelerator runtime is usable from THIS process: enumerate
+    local devices and (optionally) verify the chip count matches what the
+    controller allocated. Raises BootstrapError with an actionable message
+    otherwise. Runs in the worker process — the one that rightfully owns
+    the TPU — never in a probe sidecar (libtpu is single-owner; a probe
+    that touched the runtime would steal the training process's lock)."""
+    import jax
+
+    try:
+        devices = jax.local_devices()
+    except Exception as exc:  # noqa: BLE001 — runtime init failures vary
+        raise BootstrapError(
+            f"accelerator runtime failed to initialize: {exc}") from exc
+    n = len(devices)
+    if n == 0:
+        raise BootstrapError(
+            "accelerator runtime reports ZERO local devices — the TPU "
+            "runtime is sick or the pod is missing its google.com/tpu "
+            "resource limit")
+    if expected_chips and n != expected_chips:
+        raise BootstrapError(
+            f"accelerator runtime enumerates {n} local device(s) but the "
+            f"controller allocated {expected_chips} chips to this worker "
+            f"— partial slice, check node health")
+    return n
+
+
+def mark_ready(path: Optional[str] = None) -> Optional[str]:
+    """Write the readiness marker the injected probe checks. No-op (None)
+    when no path is configured — dev/test processes outside the operator
+    don't leave marker litter."""
+    path = path or os.environ.get(ENV_READY_FILE)
+    if not path:
+        return None
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w") as f:
+        f.write("ok\n")
+    os.replace(tmp, path)      # atomic: the probe never sees a torn write
+    return path
+
+
 def initialize(env: Optional[Mapping[str, str]] = None,
                hostname: Optional[str] = None) -> ProcessInfo:
     """Resolve + `jax.distributed.initialize`.
@@ -159,6 +210,7 @@ def initialize(env: Optional[Mapping[str, str]] = None,
     single-host JAX needs none, keeping dev/test flows zero-config.
     """
     info = process_info(env, hostname)
+    resolved_env = dict(os.environ if env is None else env)
     if not info.is_launcher and info.num_processes > 1:
         import jax
 
@@ -167,6 +219,24 @@ def initialize(env: Optional[Mapping[str, str]] = None,
             num_processes=info.num_processes,
             process_id=info.process_id,
         )
+    gated = (ENV_READY_FILE in resolved_env
+             or ENV_EXPECTED_CHIPS in resolved_env)
+    if not info.is_launcher and (gated or info.num_processes > 1):
+        # TPU-health readiness gate: only after the runtime proves its
+        # chips enumerate does the pod's readinessProbe start passing —
+        # a Ready worker set then implies ICI can form, so the gated
+        # launcher (ref :503-509) never starts against sick chips and the
+        # first collective can't hang until activeDeadlineSeconds.
+        # (Single-process runs outside the operator skip it — they keep
+        # their zero-config, zero-jax-import bootstrap.)
+        expected = int(resolved_env.get(ENV_EXPECTED_CHIPS, 0) or 0)
+        device_check(expected_chips=expected or None)
+        # only the RESOLVED env decides the marker path — mark_ready's
+        # os.environ fallback must not resurrect a gate this call's
+        # explicit `env` deliberately omitted
+        ready_path = resolved_env.get(ENV_READY_FILE)
+        if ready_path:
+            mark_ready(ready_path)
     return info
 
 
@@ -334,10 +404,11 @@ def launcher_wait(info: ProcessInfo, port: int = STATUS_PORT,
 
 __all__ = [
     "BootstrapError", "ProcessInfo", "initialize", "process_info",
-    "resolve_worker_ordinal",
+    "resolve_worker_ordinal", "device_check", "mark_ready",
     "ENV_COORDINATOR", "ENV_NUM_PROCESSES", "ENV_WORKER_HOSTNAMES",
     "ENV_WORKER_ID", "ENV_SLOTS", "ENV_CONFIG_PATH", "ENV_LAUNCHER",
-    "ENV_NUM_SLICES", "ENV_JOB_TOKEN",
+    "ENV_NUM_SLICES", "ENV_JOB_TOKEN", "ENV_READY_FILE",
+    "ENV_EXPECTED_CHIPS", "READY_FILE_DEFAULT",
     "StatusServer", "poll_status", "launcher_wait",
     "STATUS_PORT", "LAUNCHER_LOST_EXIT",
 ]

@@ -171,6 +171,8 @@ def _container_to_manifest(c: Container) -> dict:
             "requests": {k: str(v) for k, v in c.requests.items()},
         }) or None,
         "volumeMounts": [dict(vm) for vm in c.volume_mounts],
+        "readinessProbe": dict(c.readiness_probe)
+        if c.readiness_probe else None,
     })
 
 
@@ -195,6 +197,8 @@ def _container_from_manifest(m: dict) -> Container:
         requests={k: _quantity(v)
                   for k, v in (res.get("requests") or {}).items()},
         volume_mounts=[dict(vm) for vm in (m.get("volumeMounts") or [])],
+        readiness_probe=(dict(m["readinessProbe"])
+                         if m.get("readinessProbe") else None),
     )
 
 

@@ -75,6 +75,16 @@ LABEL_GROUP = "tpu_job_name"            # ref "mpi_job_name" label (:1007-1012)
 NS_ACCELERATOR = "cloud.google.com/gke-tpu-accelerator"
 NS_TOPOLOGY = "cloud.google.com/gke-tpu-topology"
 
+# TPU-health readiness gate wiring (bootstrap.ENV_READY_FILE /
+# ENV_EXPECTED_CHIPS — string literals here so the operator image never
+# imports the jax-adjacent bootstrap module)
+READINESS_ENV_FILE_KEY = "TPU_READY_FILE"
+READINESS_ENV_CHIPS_KEY = "TPU_EXPECTED_CHIPS"
+READINESS_FILE_PATH = "/tmp/tpu-ready"
+# opt-out for worker images that don't call mpi_operator_tpu.bootstrap
+# (they'd never write the marker and would sit NotReady forever)
+ANNOTATION_HEALTH_GATE = "tpu.kubeflow.org/health-gate"
+
 ERR_RESOURCE_EXISTS = "ErrResourceExists"   # ref :88-96
 MSG_RESOURCE_EXISTS = "Resource %s already exists and is not managed by TPUJob"
 
@@ -116,11 +126,15 @@ class EventRecorder:
     COMPONENT = "tpu-operator"
 
     def __init__(self, api_server=None):
+        import itertools
         from collections import deque
         self.events = deque(maxlen=self.MAX_EVENTS)
         self.api = api_server
         # correlator: (ns, involved uid, type, reason, message) -> Event name
         self._correlated: Dict[tuple, str] = {}
+        # name uniqueness within this process — time.time() microseconds
+        # alone can collide for two events in the same sync
+        self._seq = itertools.count()
 
     def event(self, obj, etype: str, reason: str, message: str) -> None:
         self.events.append(Event(etype, reason, message))
@@ -150,8 +164,10 @@ class EventRecorder:
                 existing.last_timestamp = now
                 self.api.update(existing)
                 return
-        # client-go names events "<involved>.<unique hex>"
-        name = f"{obj.metadata.name}.{int(now * 1e6):x}"
+        # client-go names events "<involved>.<unique hex>"; the counter
+        # suffix keeps same-microsecond events from colliding
+        name = (f"{obj.metadata.name}.{int(now * 1e6):x}"
+                f".{next(self._seq):x}")
         self.api.create(CoreEvent(
             metadata=ObjectMeta(name=name, namespace=ns),
             involved_object=ObjectReference(
@@ -354,7 +370,9 @@ class TPUJobController:
 
         job = self.job_lister.try_get(namespace, name)
         if job is None:
-            # work item no longer exists → drop (ref :431-436)
+            # work item no longer exists → drop (ref :431-436); release its
+            # crash-baseline state too (jobs deleted mid-run would leak it)
+            self._worker_restart_marks.pop((namespace, name), None)
             logger.debug("tpujob '%s' no longer exists", key)
             return
 
@@ -839,6 +857,38 @@ class TPUJobController:
             **container.env,
             **self._discovery_env(job, alloc, is_launcher=False),
         }
+        gate_opt_out = (
+            job.metadata.annotations.get(ANNOTATION_HEALTH_GATE) == "false"
+            or template.metadata.annotations.get(
+                ANNOTATION_HEALTH_GATE) == "false")
+        if alloc.resource_type == RESOURCE_TPU and not gate_opt_out:
+            # TPU-health readiness gate (SURVEY §7 "Readiness vs ICI
+            # formation"): Ready must mean "chips enumerate", not
+            # "container started". The bootstrap writes READY_FILE only
+            # after jax proves its local devices (bootstrap.device_check);
+            # this probe turns that into pod Readiness, which the existing
+            # ReadyReplicas launcher gate (ref :503-509) then consumes —
+            # so the coordinator never starts against a sick TPU runtime.
+            # File check, NOT a runtime touch: libtpu is single-owner and
+            # a probe opening it would steal the training process's lock.
+            # Worker images that never call mpi_operator_tpu.bootstrap
+            # must opt out via the annotation above (or supply their own
+            # probe), else they'd sit NotReady forever.
+            container.env.setdefault(
+                READINESS_ENV_FILE_KEY, READINESS_FILE_PATH)
+            container.env.setdefault(
+                READINESS_ENV_CHIPS_KEY, str(alloc.units_per_worker))
+            if container.readiness_probe is None:
+                container.readiness_probe = {
+                    "exec": {"command": [
+                        "/bin/sh", "-c",
+                        f"test -f {READINESS_FILE_PATH}"]},
+                    "initialDelaySeconds": 5,
+                    "periodSeconds": 10,
+                    # generous: first jax/libtpu init legitimately takes
+                    # tens of seconds before the marker appears
+                    "failureThreshold": 60,
+                }
         container.volume_mounts = container.volume_mounts + [
             {"name": CONFIG_VOLUME_NAME, "mountPath": CONFIG_MOUNT_PATH}
         ]
@@ -937,16 +987,18 @@ class TPUJobController:
             ),
         )
 
-    def _worker_crash_delta(self, job: TPUJob) -> int:
+    def _worker_crash_delta(self, job: TPUJob):
         """NEW worker crashes since the last sync: positive per-pod deltas
         of kubelet restart counts (keyed by pod uid, so a recreated pod's
         counter reset never hides its fresh crashes) plus newly-Failed
-        pods. Best-effort: a backend without pod-read access (or no pods
-        yet) reports 0 rather than failing the sync. The reference can't
-        see this at all — its workers are `sleep` landing pads whose
-        health is irrelevant; ours run the training process, so a
-        crash-looping worker means the job is sick even while every
-        StatefulSet counter looks green."""
+        pods. Returns (delta, pending_marks) where pending_marks is the
+        (key, baselines) the caller commits AFTER its status write lands,
+        or None when there is nothing to commit. Best-effort: a backend
+        without pod-read access (or no pods yet) reports 0 rather than
+        failing the sync. The reference can't see this at all — its
+        workers are `sleep` landing pads whose health is irrelevant; ours
+        run the training process, so a crash-looping worker means the job
+        is sick even while every StatefulSet counter looks green."""
         try:
             pods = self.api.list(
                 "Pod", job.metadata.namespace,
@@ -954,21 +1006,35 @@ class TPUJobController:
                                f"tpu_job_role=worker")
         except Exception as exc:  # noqa: BLE001 — observability only
             logger.debug("worker pod list failed: %s", exc)
-            return 0
+            return 0, None
         key = (job.metadata.namespace, job.metadata.name)
-        marks = self._worker_restart_marks.setdefault(key, {})
+        marks = self._worker_restart_marks.get(key)
+        if marks is None:
+            # first observation of this job (fresh controller process):
+            # adopt current counts as the baseline WITHOUT a delta — an
+            # operator restart must not re-count historical restarts into
+            # .failed (the persisted total already carries them)
+            self._worker_restart_marks[key] = {
+                (p.metadata.uid or p.metadata.name):
+                (p.status.restart_count, p.status.phase) for p in pods}
+            return 0, None
         delta = 0
+        new_marks = {}
         for pod in pods:
             uid = pod.metadata.uid or pod.metadata.name
-            seen = marks.get(uid, (0, ""))[0]
+            seen, seen_phase = marks.get(uid, (0, ""))
             now_count = pod.status.restart_count
             if now_count > seen:
                 delta += now_count - seen
             phase = pod.status.phase
-            if phase == "Failed" and marks.get(uid, (0, ""))[1] != "Failed":
+            if phase == "Failed" and seen_phase != "Failed":
                 delta += 1
-            marks[uid] = (max(now_count, seen), phase)
-        return delta
+            new_marks[uid] = (max(now_count, seen), phase)
+        # new_marks also PRUNES: a recreated pod gets a new uid, so absent
+        # uids never return — keeping them would leak across pod churn.
+        # The caller commits new_marks only after the status write lands
+        # (a failed update must not consume the observed crashes).
+        return delta, (key, new_marks)
 
     # ------------------------------------------------------------------
     # status (ref updateMPIJobStatus :761-791) + v1alpha2 conditions
@@ -1050,8 +1116,9 @@ class TPUJobController:
         # Terminal jobs stop paying the pod LIST.
         prev_failed = job.status.replica_statuses.get(
             "worker", api.ReplicaStatus()).failed
+        pending_marks = None
         if worker is not None and not job.status.is_done():
-            delta = self._worker_crash_delta(job)
+            delta, pending_marks = self._worker_crash_delta(job)
         else:
             delta = 0
             # terminal: drop the delta baseline (bounded memory — the
@@ -1081,6 +1148,12 @@ class TPUJobController:
             # use full Update (ref :789) only because its v1beta1 CRD
             # predates subresources.
             self.api.update_status(job)
+        # commit the crash baselines only now: if the status write above
+        # raised (409 against a real server), the observed deltas stay
+        # unconsumed and the requeued sync re-counts them
+        if pending_marks is not None:
+            key, new_marks = pending_marks
+            self._worker_restart_marks[key] = new_marks
 
 
 __all__ = [

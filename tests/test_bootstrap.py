@@ -328,3 +328,72 @@ def test_multiprocess_rendezvous_e2e(tmp_path):
     for rank, (p, out) in enumerate(zip(procs, outs)):
         assert p.returncode == 0, f"rank {rank} failed:\n{out}"
         assert f"rank {rank} psum ok" in out
+
+
+# ---------------------------------------------------------------------------
+# TPU-health readiness gate (SURVEY §7 "Readiness vs ICI formation")
+# ---------------------------------------------------------------------------
+
+def test_device_check_counts_local_devices():
+    from mpi_operator_tpu.bootstrap.bootstrap import device_check
+
+    import jax
+    n = len(jax.local_devices())
+    assert device_check() == n
+    assert device_check(expected_chips=n) == n
+
+
+def test_device_check_chip_mismatch_is_actionable():
+    from mpi_operator_tpu.bootstrap.bootstrap import device_check
+
+    with pytest.raises(BootstrapError, match="allocated 99 chips"):
+        device_check(expected_chips=99)
+
+
+def test_mark_ready_atomic_and_gated(tmp_path):
+    from mpi_operator_tpu.bootstrap.bootstrap import mark_ready
+
+    marker = tmp_path / "tpu-ready"
+    # no path configured (env unset) → no-op, no litter
+    assert mark_ready(None) is None
+    assert not marker.exists()
+    out = mark_ready(str(marker))
+    assert out == str(marker)
+    assert marker.read_text() == "ok\n"
+    # no torn temp file left behind (atomic os.replace)
+    assert list(tmp_path.iterdir()) == [marker]
+
+
+def test_initialize_writes_marker_after_device_check(tmp_path):
+    """The full gate: initialize() under the controller-injected env must
+    leave the readiness marker only after the runtime enumerated the
+    expected devices — the exec probe's contract."""
+    import jax
+    from mpi_operator_tpu.bootstrap.bootstrap import (
+        ENV_EXPECTED_CHIPS, ENV_READY_FILE)
+
+    marker = tmp_path / "tpu-ready"
+    n = len(jax.local_devices())
+    info = initialize(env={ENV_COORDINATOR: "localhost:8476",
+                           ENV_NUM_PROCESSES: "1",
+                           ENV_READY_FILE: str(marker),
+                           ENV_EXPECTED_CHIPS: str(n)},
+                      hostname="job-worker-0")
+    assert info.num_processes == 1
+    assert marker.exists()                      # probe would now pass
+
+
+def test_initialize_leaves_no_marker_on_sick_runtime(tmp_path):
+    """A chip-count mismatch (sick TPU) must raise AND leave no marker —
+    the pod stays NotReady and the launcher gate holds."""
+    from mpi_operator_tpu.bootstrap.bootstrap import (
+        ENV_EXPECTED_CHIPS, ENV_READY_FILE)
+
+    marker = tmp_path / "tpu-ready"
+    with pytest.raises(BootstrapError, match="allocated 99 chips"):
+        initialize(env={ENV_COORDINATOR: "localhost:8476",
+                        ENV_NUM_PROCESSES: "1",
+                        ENV_READY_FILE: str(marker),
+                        ENV_EXPECTED_CHIPS: "99"},
+                   hostname="job-worker-0")
+    assert not marker.exists()

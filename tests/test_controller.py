@@ -782,14 +782,20 @@ def _worker_pod(name, job="test", restarts=0, phase="Running"):
 def test_worker_restarts_surface_in_replica_status():
     """A crash-looping worker must be visible: kubelet resurrects workers
     in place (RestartPolicy=Always) so the StatefulSet always looks
-    healthy — the controller reads worker pods and surfaces restarts into
-    replicaStatuses["worker"].failed, plus a Warning Event."""
+    healthy — the controller reads worker pods and surfaces restart
+    DELTAS into replicaStatuses["worker"].failed, plus a Warning Event.
+    (The first sync adopts current counts as the baseline, so crashes are
+    counted from when this controller started watching.)"""
     f = Fixture()
     f.seed(new_job(tpus=8))
     _seed_workers(f, job=f.api.get(api.KIND, "default", "test"),
                   replicas=2, ready=2)
-    f.seed(_worker_pod("test-worker-0", restarts=3))
+    f.seed(_worker_pod("test-worker-0", restarts=0))
     f.seed(_worker_pod("test-worker-1", restarts=0))
+    f.run("default/test")                   # baseline sync
+    pod = f.api.get("Pod", "default", "test-worker-0")
+    pod.status.restart_count = 3            # three crashes since
+    f.api.update(pod)
     f.run("default/test")
     st = f.api.get(api.KIND, "default", "test").status
     assert st.replica_statuses["worker"].failed == 3
@@ -797,6 +803,30 @@ def test_worker_restarts_surface_in_replica_status():
     warnings = [e for e in f.controller.recorder.events
                 if e.type == "Warning"]
     assert any(e.reason == "WorkerCrashLoop" for e in warnings)
+
+
+def test_operator_restart_does_not_recount_crashes():
+    """A fresh controller process must adopt current restart counts as the
+    baseline instead of re-counting history into .failed (which would
+    double the number on every operator redeploy)."""
+    f = Fixture()
+    f.seed(new_job(tpus=8))
+    _seed_workers(f, job=f.api.get(api.KIND, "default", "test"),
+                  replicas=2, ready=2)
+    f.seed(_worker_pod("test-worker-0", restarts=0))
+    f.run("default/test")                   # baseline
+    pod = f.api.get("Pod", "default", "test-worker-0")
+    pod.status.restart_count = 5
+    f.api.update(pod)
+    f.run("default/test")
+    assert f.api.get(api.KIND, "default", "test") \
+        .status.replica_statuses["worker"].failed == 5
+    # "operator restart": a NEW controller over the same API server
+    ctrl2 = TPUJobController(f.api)
+    ctrl2.factory.start_all()
+    ctrl2.sync_handler("default/test")
+    st = f.api.get(api.KIND, "default", "test").status
+    assert st.replica_statuses["worker"].failed == 5   # not 10
 
 
 def test_healthy_workers_report_zero_failed():
@@ -822,7 +852,11 @@ def test_failed_count_is_cumulative_across_pod_recreation():
     f.seed(new_job(tpus=8))
     _seed_workers(f, job=f.api.get(api.KIND, "default", "test"),
                   replicas=2, ready=2)
-    f.seed(_worker_pod("test-worker-0", restarts=4))
+    f.seed(_worker_pod("test-worker-0", restarts=0))
+    f.run("default/test")                              # baseline
+    pod = f.api.get("Pod", "default", "test-worker-0")
+    pod.status.restart_count = 4
+    f.api.update(pod)
     f.run("default/test")
     st = f.api.get(api.KIND, "default", "test").status
     assert st.replica_statuses["worker"].failed == 4
@@ -846,10 +880,15 @@ def test_foreign_pods_ignored_in_failure_count():
     f.seed(new_job(tpus=8))
     _seed_workers(f, job=f.api.get(api.KIND, "default", "test"),
                   replicas=2, ready=2)
-    f.seed(_worker_pod("other-worker-0", job="other", restarts=9))
-    launcher_pod = _worker_pod("test-launcher-x", restarts=5)
+    f.seed(_worker_pod("other-worker-0", job="other", restarts=0))
+    launcher_pod = _worker_pod("test-launcher-x", restarts=0)
     launcher_pod.metadata.labels["tpu_job_role"] = "launcher"
     f.seed(launcher_pod)
+    f.run("default/test")                              # baseline
+    for name in ("other-worker-0", "test-launcher-x"):
+        pod = f.api.get("Pod", "default", name)
+        pod.status.restart_count = 9                   # foreign crashes
+        f.api.update(pod)
     f.run("default/test")
     st = f.api.get(api.KIND, "default", "test").status
     assert st.replica_statuses["worker"].failed == 0
@@ -892,3 +931,68 @@ def test_create_race_foreign_owner_still_refused():
     foreign.metadata.uid = "uid-foreign"
     f.api._store[("ConfigMap", "default", "test" + CONFIG_SUFFIX)] = foreign
     f.run("default/test", expect_error=ForeignOwnershipError)
+
+
+# ---------------------------------------------------------------------------
+# TPU-health readiness gate (SURVEY §7 "Readiness vs ICI formation")
+# ---------------------------------------------------------------------------
+
+def test_worker_readiness_probe_injected():
+    """TPU workers carry a readinessProbe checking the bootstrap's health
+    marker, so ReadyReplicas (the launcher gate, ref :503-509) means "the
+    TPU runtime enumerated its chips", not "the container started"."""
+    f = Fixture()
+    f.seed(new_job(tpus=8))
+    f.run("default/test")
+    sts = f.api.get("StatefulSet", "default", "test" + WORKER_SUFFIX)
+    c = sts.spec.template.main_container()
+    probe = c.readiness_probe
+    assert probe is not None
+    assert probe["exec"]["command"][-1] == "test -f /tmp/tpu-ready"
+    assert probe["failureThreshold"] >= 30     # first libtpu init is slow
+    assert c.env["TPU_READY_FILE"] == "/tmp/tpu-ready"
+    assert c.env["TPU_EXPECTED_CHIPS"] == "4"  # tpus=8 / 2 workers
+
+
+def test_cpu_workers_get_no_tpu_probe():
+    """cpu-resource jobs have no TPU runtime to gate on."""
+    f = Fixture()
+    job = new_job(tpus=None)
+    job.spec.replicas = 2
+    job.spec.processing_resource_type = api.RESOURCE_CPU
+    job.spec.template.main_container().limits = {"cpu": 2}
+    f.seed(job)
+    f.run("default/test")
+    sts = f.api.get("StatefulSet", "default", "test" + WORKER_SUFFIX)
+    c = sts.spec.template.main_container()
+    assert c.readiness_probe is None
+    assert "TPU_READY_FILE" not in c.env
+
+
+def test_user_supplied_probe_not_overwritten():
+    """A user's own readinessProbe in the pod template wins — the operator
+    only fills the gap."""
+    f = Fixture()
+    job = new_job(tpus=8)
+    job.spec.template.main_container().readiness_probe = {
+        "httpGet": {"path": "/healthz", "port": 9999}}
+    f.seed(job)
+    f.run("default/test")
+    sts = f.api.get("StatefulSet", "default", "test" + WORKER_SUFFIX)
+    probe = sts.spec.template.main_container().readiness_probe
+    assert probe == {"httpGet": {"path": "/healthz", "port": 9999}}
+
+
+def test_health_gate_annotation_opt_out():
+    """Worker images that never call mpi_operator_tpu.bootstrap can opt
+    out of the TPU-health probe (they would otherwise sit NotReady
+    forever, since nothing writes the marker)."""
+    f = Fixture()
+    job = new_job(tpus=8)
+    job.metadata.annotations["tpu.kubeflow.org/health-gate"] = "false"
+    f.seed(job)
+    f.run("default/test")
+    c = f.api.get("StatefulSet", "default",
+                  "test" + WORKER_SUFFIX).spec.template.main_container()
+    assert c.readiness_probe is None
+    assert "TPU_READY_FILE" not in c.env

@@ -409,6 +409,12 @@ class TestWireFormat:
         c = pod["containers"][0]
         assert c["image"] == "tpu-bench:latest"
         assert c["resources"]["limits"] == {"google.com/tpu": "4"}
+        # TPU-health readiness gate on the wire (SURVEY §7): kubelet must
+        # see the exec probe so Ready == "chips enumerated"
+        probe = c["readinessProbe"]
+        assert probe["exec"]["command"] == [
+            "/bin/sh", "-c", "test -f /tmp/tpu-ready"]
+        assert probe["failureThreshold"] >= 30
         assert {"name": "tpu-job-config",
                 "mountPath": "/etc/tpu"} in c["volumeMounts"]
         env = {e["name"]: e["value"] for e in c["env"]}
