@@ -139,6 +139,29 @@ def validate_spec(spec: TPUJobSpec,
 
     if spec.num_slices < 1:
         errs.append(f"spec.numSlices must be >= 1, got {spec.num_slices}")
+    elif spec.num_slices > 1:
+        # every slice is a worker group of equal size — the derived worker
+        # count must divide. Checkable at admission whenever the spec
+        # itself determines the count (replicas mode, or Mode A with an
+        # explicit per-worker); the controller keeps a backstop for the
+        # flag-default case it alone can see.
+        workers = None
+        if spec.replicas is not None and spec.replicas >= 1:
+            workers = spec.replicas
+        else:
+            total = spec.tpus if spec.tpus is not None else \
+                spec.processing_units
+            per = spec.tpus_per_worker if spec.tpus is not None else \
+                spec.processing_units_per_worker
+            if total is not None and per and per >= 1:
+                workers = 1 if total < per else (
+                    total // per if total % per == 0 else None)
+        if workers is not None and workers % spec.num_slices:
+            errs.append(
+                f"the spec derives {workers} worker(s), which does not "
+                f"divide into {spec.num_slices} slices (each slice is an "
+                f"equal worker group)"
+            )
 
     if spec.slice_topology is not None:
         total = spec.tpus or spec.processing_units

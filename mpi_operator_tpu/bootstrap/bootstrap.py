@@ -38,6 +38,11 @@ ENV_LOCAL_RANK = "TPU_LOCAL_RANK"          # set by bootstrap.launch for slots>1
 ENV_CONFIG_PATH = "TPU_CONFIG_PATH"
 ENV_LAUNCHER = "TPU_LAUNCHER"
 ENV_NUM_SLICES = "TPU_NUM_SLICES"
+# multi-slice (controller injects per worker GROUP, i.e. per StatefulSet):
+# the pod hostname ordinal is slice-LOCAL; the global rank folds in the
+# slice id — global worker index = slice_id * workers_per_slice + ordinal
+ENV_SLICE_ID = "TPU_SLICE_ID"
+ENV_WORKERS_PER_SLICE = "TPU_WORKERS_PER_SLICE"
 # TPU-health readiness gate (SURVEY §7 "Readiness vs ICI formation"):
 # when the controller injects TPU_READY_FILE, the worker writes the marker
 # only after the accelerator runtime proved usable (device_check), and the
@@ -70,6 +75,8 @@ class ProcessInfo:
     process_id: int
     slots_per_worker: int = 1
     num_slices: int = 1
+    slice_id: int = 0
+    workers_per_slice: int = 0     # 0 = single-slice (all workers)
     is_launcher: bool = False
 
     @property
@@ -94,7 +101,7 @@ def _read_config_dir(path: str) -> dict:
     if not os.path.isdir(path):
         return data
     for key in ("coordinator-address", "num-processes", "slots-per-worker",
-                "num-slices"):
+                "num-slices", "workers-per-slice"):
         p = os.path.join(path, key)
         if os.path.exists(p):
             with open(p) as f:
@@ -119,6 +126,15 @@ def process_info(
         env.get(ENV_NUM_PROCESSES) or cfg.get("num-processes") or 1)
     slots = int(env.get(ENV_SLOTS) or cfg.get("slots-per-worker") or 1)
     num_slices = int(env.get(ENV_NUM_SLICES) or cfg.get("num-slices") or 1)
+    slice_id = int(env.get(ENV_SLICE_ID) or 0)
+    workers_per_slice = int(
+        env.get(ENV_WORKERS_PER_SLICE) or cfg.get("workers-per-slice") or 0)
+    if num_slices > 1 and workers_per_slice == 0:
+        # derivable: ranks divide evenly over slices (admission enforces it)
+        workers_per_slice = num_processes // (slots * num_slices)
+    if slice_id >= max(num_slices, 1):
+        raise BootstrapError(
+            f"{ENV_SLICE_ID}={slice_id} >= num_slices {num_slices}")
     is_launcher = env.get(ENV_LAUNCHER) == "1"
 
     if ENV_WORKER_ID in env:
@@ -129,7 +145,14 @@ def process_info(
         # — no ordinal-bearing hostname needed (dev boxes, notebooks).
         pid = 0
     else:
+        # Multi-slice: the StatefulSet ordinal is slice-LOCAL (pod
+        # `<job>-worker-s<k>-<i>` → i); fold in the slice id so global
+        # worker indexes are slice-major — exactly the order the
+        # controller publishes worker-hostnames in (the hostfile-analogue
+        # topology truth, ref mpi_job_controller.go:857-869).
         ordinal = resolve_worker_ordinal(hostname or socket.gethostname())
+        if num_slices > 1:
+            ordinal = slice_id * workers_per_slice + ordinal
         # slots>1: bootstrap.launch forks `slots` local processes per worker
         # (the orted replacement) and tags each with TPU_LOCAL_RANK; the
         # global rank interleaves exactly like the reference hostfile's
@@ -149,8 +172,34 @@ def process_info(
         process_id=pid,
         slots_per_worker=slots,
         num_slices=num_slices,
+        slice_id=slice_id,
+        workers_per_slice=workers_per_slice,
         is_launcher=is_launcher,
     )
+
+
+def hybrid_mesh(info: Optional[ProcessInfo] = None, **axes):
+    """The job's device mesh straight from the bootstrap topology: the
+    `dcn` axis gets num_slices (so cross-slice collectives ride DCN
+    hierarchically, parallel/mesh.make_mesh), the remaining devices spread
+    over the given axes — default pure data-parallel, the reference's sole
+    strategy. This is the env-contract path: controller env → process_info
+    → mesh, no hand-built topology."""
+    import jax
+
+    from ..parallel.mesh import MeshConfig, make_mesh
+
+    info = info if info is not None else process_info()
+    n = jax.device_count()
+    if axes:
+        cfg = MeshConfig(dcn=info.num_slices, **axes)
+        if cfg.num_devices != n:
+            raise BootstrapError(
+                f"mesh axes {axes} x num_slices {info.num_slices} = "
+                f"{cfg.num_devices} devices, but the job sees {n}")
+    else:
+        cfg = MeshConfig.data_parallel(n, num_slices=info.num_slices)
+    return make_mesh(cfg)
 
 
 def device_check(expected_chips: Optional[int] = None) -> int:
@@ -404,11 +453,12 @@ def launcher_wait(info: ProcessInfo, port: int = STATUS_PORT,
 
 __all__ = [
     "BootstrapError", "ProcessInfo", "initialize", "process_info",
-    "resolve_worker_ordinal", "device_check", "mark_ready",
+    "resolve_worker_ordinal", "device_check", "mark_ready", "hybrid_mesh",
     "ENV_COORDINATOR", "ENV_NUM_PROCESSES", "ENV_WORKER_HOSTNAMES",
     "ENV_WORKER_ID", "ENV_SLOTS", "ENV_CONFIG_PATH", "ENV_LAUNCHER",
     "ENV_NUM_SLICES", "ENV_JOB_TOKEN", "ENV_READY_FILE",
     "ENV_EXPECTED_CHIPS", "READY_FILE_DEFAULT",
+    "ENV_SLICE_ID", "ENV_WORKERS_PER_SLICE",
     "StatusServer", "poll_status", "launcher_wait",
     "STATUS_PORT", "LAUNCHER_LOST_EXIT",
 ]

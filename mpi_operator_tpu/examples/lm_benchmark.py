@@ -42,6 +42,8 @@ def run_lm_benchmark(
     dtype_name: str = "bfloat16",
     tp: int = 1,
     pp: int = 1,
+    pp_schedule: str = "gpipe",
+    pp_interleave: int = 1,
     sp: int = 1,
     num_slices: int = 1,
     attention: str = "auto",
@@ -153,10 +155,16 @@ def run_lm_benchmark(
         pp_mesh = make_mesh(MeshConfig(pp=pp, tp=tp,
                                        dp=n // (pp * tp * num_slices),
                                        dcn=num_slices))
-        pp_trainer = PipelineLMTrainer(model.config, pp_mesh, tcfg)
+        pp_trainer = PipelineLMTrainer(model.config, pp_mesh, tcfg,
+                                       schedule=pp_schedule,
+                                       interleave=pp_interleave)
         pp_state = pp_trainer.init_state(jax.random.PRNGKey(0))
         from ..train.checkpoint import maybe_resume, maybe_save
-        pp_state = maybe_resume(train_dir, pp_state, log)
+        # checkpoints live in CANONICAL layer order (schedule-agnostic);
+        # the live state may be 1F1B-interleaved — convert around resume
+        pp_state = pp_trainer.from_canonical_state(
+            maybe_resume(train_dir, pp_trainer.canonical_state(pp_state),
+                         log))
 
         class RawStream:
             def __init__(self):
@@ -194,14 +202,17 @@ def run_lm_benchmark(
         else:
             pp_stream = RawStream()
         from ..train.checkpoint import periodic_saver
+        saver = periodic_saver(train_dir, ckpt_every, log)
+        canonical_hook = (None if saver is None else (
+            lambda st, step: saver(pp_trainer.canonical_state(st), step)))
         try:
             pp_state, pp_metrics = pp_trainer.benchmark(
                 pp_state, pp_stream, num_steps=num_steps,
                 warmup_steps=warmup_steps, log=log,
-                step_hook=periodic_saver(train_dir, ckpt_every, log))
+                step_hook=canonical_hook)
         finally:
             pp_stream.close()
-        maybe_save(train_dir, pp_state, log)
+        maybe_save(train_dir, pp_trainer.canonical_state(pp_state), log)
         return pp_state, pp_metrics
     trainer = LMTrainer(model, mesh, tcfg)
     state = trainer.init_state(jax.random.PRNGKey(0))
@@ -419,7 +430,15 @@ def main(argv=None) -> int:
                         choices=["bfloat16", "float32"])
     parser.add_argument("--tp", type=int, default=1)
     parser.add_argument("--pp", type=int, default=1,
-                        help="GPipe pipeline stages (causal LM only)")
+                        help="pipeline stages (causal LM only)")
+    parser.add_argument("--pp-schedule", default="gpipe",
+                        choices=["gpipe", "1f1b"],
+                        help="gpipe = fill/drain via autodiff; 1f1b = "
+                             "interleaved one-forward-one-backward "
+                             "(O(pp) in-flight memory, in-schedule grads)")
+    parser.add_argument("--pp-interleave", type=int, default=1,
+                        help="virtual stages per device for --pp-schedule "
+                             "1f1b (divides the pipeline bubble)")
     parser.add_argument("--sp", type=int, default=1,
                         help="sequence/context-parallel degree: seq axis "
                              "sharded over sp, ring attention over the sp "
@@ -494,7 +513,9 @@ def main(argv=None) -> int:
                 seq_len=args.seq_len, num_steps=args.num_steps,
                 warmup_steps=args.warmup_steps,
                 eval_steps=args.eval_steps, dtype_name=args.dtype,
-                tp=args.tp, pp=args.pp, sp=args.sp,
+                tp=args.tp, pp=args.pp,
+                pp_schedule=args.pp_schedule,
+                pp_interleave=args.pp_interleave, sp=args.sp,
                 moe_experts=args.moe_experts,
                 ep=args.ep, fused_xent=args.fused_xent,
                 accum_steps=args.accum_steps,

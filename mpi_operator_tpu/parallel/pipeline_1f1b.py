@@ -1,0 +1,522 @@
+"""Interleaved 1F1B pipeline schedule (Megatron-style) over the pp axis.
+
+GPipe (parallel/pipeline.py — the simple path, kept) runs all forwards then
+all backwards via autodiff of the forward scan: correct, but every stage
+holds residuals for ALL M microbatches and the drain bubble is paid twice.
+1F1B interleaves one-forward/one-backward per stage so at most O(P)
+microbatches are ever in flight, and interleaving (each device owns
+`interleave` non-contiguous chunks of layers, Megatron's virtual stages)
+divides the fill/drain bubble by the chunk count.
+
+TPU-native shape — everything is STATIC:
+  * The schedule is simulated ON HOST (numpy) into dense [T, P] tables
+    (who computes what at each tick, which buffer slot every value lives
+    in); the device program is a single `lax.scan` over ticks that just
+    indexes those tables. No data-dependent control flow reaches XLA.
+  * Buffer slots come from interval allocation in the simulator, so the
+    on-device activation pools are exactly max-in-flight deep — the O(P)
+    memory claim is enforced by construction, not hoped for.
+  * Inter-stage traffic stays two single-neighbor `lax.ppermute` hops per
+    tick (activations forward, cotangents backward) — identical ICI cost
+    profile to the GPipe path.
+  * Backward ticks recompute their stage's forward under `jax.vjp` from
+    the saved stage INPUT (per-stage full rematerialization — the
+    standard 1F1B memory/compute trade; saving outputs instead would keep
+    the whole residual chain alive and reintroduce GPipe memory).
+
+Gradients are produced IN-SCHEDULE (each backward tick accumulates its
+chunk's parameter cotangents), so the public API returns (loss, grads)
+directly — the trainer applies them without an outer jax.grad.
+
+No reference equivalent (SURVEY.md §2.3: PP absent from the reference).
+"""
+from __future__ import annotations
+
+import functools
+from dataclasses import dataclass
+from typing import Dict
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax import lax
+from jax import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+# dir codes in the schedule tables
+IDLE, FWD, BWD = 0, 1, 2
+# role codes (what a virtual stage's compute includes)
+ROLE_FIRST, ROLE_MID, ROLE_LAST = 0, 1, 2
+
+
+@dataclass(frozen=True)
+class Schedule:
+    """Host-built 1F1B schedule: dense per-tick tables (all [T, P] int32)
+    plus buffer depths. Everything the device program needs to index."""
+    num_stages: int            # P — pipeline devices
+    num_microbatches: int      # M
+    interleave: int            # v — virtual stages per device
+    ticks: int                 # T
+    dir: np.ndarray            # IDLE | FWD | BWD
+    role: np.ndarray           # ROLE_* for the work item (0 when idle)
+    chunk: np.ndarray          # local chunk index of the work item
+    mb: np.ndarray             # microbatch index of the work item
+    h_slot: np.ndarray         # input-activation slot (-1: none, embed path)
+    g_slot: np.ndarray         # cotangent slot for BWD (-1: loss-seeded)
+    recv_fwd_slot: np.ndarray  # where an arriving activation lands (-1 none)
+    recv_bwd_slot: np.ndarray  # where an arriving cotangent lands (-1 none)
+    h_depth: int               # activation pool depth (max in flight)
+    g_depth: int               # cotangent pool depth
+    idle_slots: int            # Σ dir == IDLE (the bubble, in stage-ticks)
+
+    @property
+    def total_slots(self) -> int:
+        return self.ticks * self.num_stages
+
+    @property
+    def bubble_fraction(self) -> float:
+        return self.idle_slots / self.total_slots
+
+
+class _SlotPool:
+    """Interval allocator: slots live from alloc to free; depth = peak."""
+
+    def __init__(self):
+        self.free: list = []
+        self.next = 0
+        self.depth = 0
+
+    def alloc(self) -> int:
+        if self.free:
+            return self.free.pop()
+        s = self.next
+        self.next += 1
+        self.depth = max(self.depth, self.next)
+        return s
+
+    def release(self, slot: int) -> None:
+        self.free.append(slot)
+
+
+def simulate_1f1b(num_stages: int, num_microbatches: int,
+                  interleave: int = 1) -> Schedule:
+    """Greedy dependency-driven 1F1B simulation.
+
+    Virtual stage k (0..v*P-1) runs on device k % P as local chunk k // P
+    (Megatron round-robin placement — every virtual-stage hop is one
+    forward ring hop). Policy per device per tick: run a ready BACKWARD if
+    one exists (backwards drain in-flight memory and unblock upstream),
+    else a ready FORWARD whose in-flight budget allows. fwd(k, m) is ready
+    once fwd(k-1, m) finished a previous tick; bwd(k, m) once bwd(k+1, m)
+    did (bwd of the last virtual stage is seeded by its own loss at the
+    fwd tick). The in-flight cap (v*P - device, the classic 1F1B warmup
+    depth) is what turns greedy scheduling into the 1F1B pattern."""
+    Pn, M, v = num_stages, num_microbatches, interleave
+    VP = v * Pn
+    if M % Pn:
+        raise ValueError(f"num_microbatches={M} must divide over "
+                         f"pp={Pn} for the interleaved schedule")
+    fwd_done = -np.ones((VP, M), dtype=np.int64)   # tick of completion
+    bwd_done = -np.ones((VP, M), dtype=np.int64)
+
+    # Megatron interleaved order per device: microbatches in groups of P,
+    # chunk-major inside a group — F-seq: (c0 m0..mP-1)(c1 m0..mP-1)...
+    # then the next group. Backwards mirror it. Warmup depth
+    # (P - d - 1)*2 + (v - 1)*P forwards, then strict 1F1B alternation —
+    # the schedule whose fill/drain bubble shrinks by the chunk count.
+    def fseq(d):
+        return [(c * Pn + d, g * Pn + i)
+                for g in range(M // Pn)
+                for c in range(v)
+                for i in range(Pn)]
+
+    def bseq(d):
+        return [(c * Pn + d, g * Pn + i)
+                for g in range(M // Pn)
+                for c in reversed(range(v))
+                for i in range(Pn)]
+
+    F = [fseq(d) for d in range(Pn)]
+    B = [bseq(d) for d in range(Pn)]
+    fi = [0] * Pn
+    bi = [0] * Pn
+    warmup = [min((Pn - d - 1) * 2 + (v - 1) * Pn if v > 1
+                  else Pn - d - 1, len(F[d]))
+              for d in range(Pn)]
+    prefer_bwd = [False] * Pn      # steady-state alternation state
+    in_flight = [0] * Pn           # forwards minus backwards, per device
+    cap = [w + 1 for w in warmup]  # the O(P·v) in-flight memory bound
+
+    rows: Dict[str, list] = {k: [] for k in (
+        "dir", "role", "chunk", "mb", "h_slot", "g_slot",
+        "recv_fwd_slot", "recv_bwd_slot")}
+    h_pools = [_SlotPool() for _ in range(Pn)]
+    g_pools = [_SlotPool() for _ in range(Pn)]
+    # (k, m) -> assigned slot on its device
+    h_slot_of: Dict[tuple, int] = {}
+    g_slot_of: Dict[tuple, int] = {}
+
+    def role_of(k: int) -> int:
+        if k == 0:
+            return ROLE_FIRST
+        if k == VP - 1:
+            return ROLE_LAST
+        return ROLE_MID
+
+    def fwd_ready(k, m, t):
+        return k == 0 or (0 <= fwd_done[k - 1, m] < t)
+
+    def bwd_ready(k, m, t):
+        if k == VP - 1:
+            return 0 <= fwd_done[k, m] < t
+        return 0 <= bwd_done[k + 1, m] < t
+
+    t = 0
+    while any(bi[d] < len(B[d]) for d in range(Pn)):
+        if t > 8 * v * (M + VP):    # pragma: no cover — schedule bug guard
+            raise RuntimeError("1F1B simulation failed to converge")
+        row = {k: [0] * Pn for k in rows}
+        for key in ("h_slot", "g_slot", "recv_fwd_slot", "recv_bwd_slot"):
+            row[key] = [-1] * Pn
+        chosen = []                    # (device, dir, k, m) this tick
+        for d in range(Pn):
+            pick = None
+            f_item = F[d][fi[d]] if fi[d] < len(F[d]) else None
+            b_item = B[d][bi[d]] if bi[d] < len(B[d]) else None
+            in_warmup = fi[d] < warmup[d]
+            # Warmup runs forwards; steady state alternates F/B (Megatron
+            # pairs forward-then-backward), falling back to the other
+            # direction when the preferred one isn't ready — but forwards
+            # NEVER exceed the in-flight cap, which is what keeps the
+            # activation memory at the O(P·v) 1F1B bound instead of
+            # ballooning to O(M) like GPipe.
+            if in_warmup:
+                want = [(FWD, f_item), (BWD, b_item)]
+            elif prefer_bwd[d] or f_item is None:
+                want = [(BWD, b_item), (FWD, f_item)]
+            else:
+                want = [(FWD, f_item), (BWD, b_item)]
+            for direction, item in want:
+                if item is None:
+                    continue
+                if direction == FWD and in_flight[d] >= cap[d]:
+                    continue
+                k, m = item
+                ok = (fwd_ready(k, m, t) if direction == FWD
+                      else bwd_ready(k, m, t))
+                if ok:
+                    pick = (direction, k, m)
+                    break
+            if pick is None:
+                row["dir"][d] = IDLE
+                continue
+            direction, k, m = pick
+            chosen.append((d, direction, k, m))
+            row["dir"][d] = direction
+            row["role"][d] = role_of(k)
+            row["chunk"][d] = k // Pn
+            row["mb"][d] = m
+            if direction == FWD:
+                fi[d] += 1
+                fwd_done[k, m] = t
+                in_flight[d] += 1
+                # alternation flips only in steady state: the first
+                # post-warmup op must be a FORWARD (Megatron's F-then-B
+                # pairing), so warmup forwards leave the toggle alone
+                if fi[d] > warmup[d]:
+                    prefer_bwd[d] = True
+                row["h_slot"][d] = h_slot_of.get((k, m), -1)
+            else:
+                bi[d] += 1
+                bwd_done[k, m] = t
+                in_flight[d] -= 1
+                prefer_bwd[d] = False
+                row["h_slot"][d] = h_slot_of.get((k, m), -1)
+                row["g_slot"][d] = g_slot_of.get((k, m), -1)
+        # deliveries land the SAME tick (ppermute happens inside the tick)
+        for d, direction, k, m in chosen:
+            if direction == FWD and k < VP - 1:
+                rd = (d + 1) % Pn                 # device of k+1
+                slot = h_pools[rd].alloc()
+                h_slot_of[(k + 1, m)] = slot
+                row["recv_fwd_slot"][rd] = slot
+            if direction == BWD and k > 0:
+                rd = (d - 1) % Pn                 # device of k-1
+                slot = g_pools[rd].alloc()
+                g_slot_of[(k - 1, m)] = slot
+                row["recv_bwd_slot"][rd] = slot
+        for d, direction, k, m in chosen:
+            if direction == BWD:                  # slots die with the bwd
+                s = h_slot_of.pop((k, m), None)
+                if s is not None:
+                    h_pools[d].release(s)
+                s = g_slot_of.pop((k, m), None)
+                if s is not None:
+                    g_pools[d].release(s)
+        for key in rows:
+            rows[key].append(row[key])
+        t += 1
+
+    tables = {k: np.asarray(vv, dtype=np.int32) for k, vv in rows.items()}
+    idle = int((tables["dir"] == IDLE).sum())
+    return Schedule(
+        num_stages=Pn, num_microbatches=M, interleave=v, ticks=t,
+        h_depth=max(1, max(p.depth for p in h_pools)),
+        g_depth=max(1, max(p.depth for p in g_pools)),
+        idle_slots=idle, **tables)
+
+
+def _layer_order(num_layers: int, num_stages: int, interleave: int):
+    lc = num_layers // (num_stages * interleave)
+    return np.concatenate([
+        np.arange(lc) + (c * num_stages + d) * lc
+        for d in range(num_stages) for c in range(interleave)])
+
+
+def interleave_blocks(blocks, num_stages: int, interleave: int):
+    """Permute stage-stacked block params [L, ...] into the 1F1B device-
+    major layout: device d's chunks (virtual stages d, P+d, 2P+d, ...)
+    become CONTIGUOUS on the leading dim, so a plain P("pp") sharding
+    hands every device exactly its chunk stack. v=1 is the identity."""
+    def perm(leaf):
+        return leaf[_layer_order(leaf.shape[0], num_stages, interleave)]
+    return jax.tree.map(perm, blocks)
+
+
+def deinterleave_blocks(blocks, num_stages: int, interleave: int):
+    """Inverse of interleave_blocks — back to canonical layer order (the
+    layout checkpoints are written in, so a checkpoint taken under one
+    schedule/interleave restores correctly under any other)."""
+    def unperm(leaf):
+        order = _layer_order(leaf.shape[0], num_stages, interleave)
+        inv = np.argsort(order)
+        return leaf[inv]
+    return jax.tree.map(unperm, blocks)
+
+
+# ---------------------------------------------------------------------------
+# LM integration: stage-sliced CausalLM under the 1F1B schedule
+# ---------------------------------------------------------------------------
+
+def _lm_1f1b_local(cfg, sched: Schedule, axis_name, psum_axes,
+                   tables, pp_params, tokens, targets):
+    """Device-local 1F1B over a stage-sliced CausalLM. pp_params["blocks"]
+    leaves arrive [v*Lc, ...] (this device's chunk stack, interleave_blocks
+    layout); tokens/targets [M, mb, S] are replicated across pp (raw int
+    streams are cheap; the relay-register trick stays GPipe-only)."""
+    from ..models.transformer import Block, _head_matmul, _layer_norm
+
+    v, Pn, M = sched.interleave, sched.num_stages, sched.num_microbatches
+    stage = lax.axis_index(axis_name)
+    S = tokens.shape[-1]
+    E = pp_params["wte"].shape[1]
+    mb = tokens.shape[1]
+
+    wte, wpe = pp_params["wte"], pp_params["wpe"]
+    blocks = jax.tree.map(
+        lambda x: x.reshape((v, x.shape[0] // v) + x.shape[1:]),
+        pp_params["blocks"])
+    block = Block(cfg)
+    ln_f = _layer_norm(cfg, "ln_f")
+
+    def chunk_params(c):
+        return jax.tree.map(lambda x: x[c], blocks)
+
+    def stage_stack(cparams, h):
+        def body(h, layer_params):
+            return block.apply({"params": layer_params}, h), None
+        h, _ = lax.scan(body, h, cparams)
+        return h
+
+    # role-uniform forward: returns (activation_out, loss_sum). The role
+    # decides embed-in / head-out; lax.switch keeps one branch's cost.
+    def f_first(shared, cparams, h_in, m):
+        toks = lax.dynamic_index_in_dim(tokens, m, 0, keepdims=False)
+        h = shared["wte"][toks].astype(cfg.dtype) \
+            + shared["wpe"][:S][None].astype(cfg.dtype)
+        return stage_stack(cparams, h), jnp.zeros((), jnp.float32)
+
+    def f_mid(shared, cparams, h_in, m):
+        del shared
+        return stage_stack(cparams, h_in), jnp.zeros((), jnp.float32)
+
+    def f_last(shared, cparams, h_in, m):
+        y = stage_stack(cparams, h_in)
+        hn = ln_f.apply({"params": shared["ln_f"]}, y)
+        logits = _head_matmul(hn, shared["wte"].astype(cfg.dtype))
+        tgt = lax.dynamic_index_in_dim(targets, m, 0, keepdims=False)
+        loss = optax.softmax_cross_entropy_with_integer_labels(
+            logits, tgt).sum()
+        return y, loss        # act out unused (never sent)
+
+    branches = (f_first, f_mid, f_last)
+    shared0 = {"wte": wte, "wpe": wpe, "ln_f": pp_params["ln_f"]}
+
+    def zeros_grads():
+        return jax.tree.map(lambda x: jnp.zeros(x.shape, jnp.float32),
+                            {"shared": shared0, "blocks": blocks})
+
+    T = sched.ticks
+    t_dir = tables["dir"]; t_role = tables["role"]
+    t_chunk = tables["chunk"]; t_mb = tables["mb"]
+    t_hs = tables["h_slot"]; t_gs = tables["g_slot"]
+    t_rf = tables["recv_fwd_slot"]; t_rb = tables["recv_bwd_slot"]
+
+    def tick(carry, tau):
+        h_buf, g_buf, loss_sum, grads = carry
+        direction = t_dir[tau, stage]
+        role = t_role[tau, stage]
+        c = t_chunk[tau, stage]
+        m = t_mb[tau, stage]
+        hs = t_hs[tau, stage]
+        gs = t_gs[tau, stage]
+        h_in = lax.dynamic_index_in_dim(h_buf, jnp.maximum(hs, 0), 0,
+                                        keepdims=False)
+        cparams = chunk_params(c)
+
+        def do_fwd(_):
+            y, loss = lax.switch(role, branches, shared0, cparams, h_in, m)
+            return y, loss, jax.tree.map(
+                lambda x: jnp.zeros(x.shape, jnp.float32),
+                {"shared": shared0, "blocks_c": cparams}), \
+                jnp.zeros((mb, S, E), cfg.dtype)
+
+        def do_bwd(_):
+            def fwd_for_vjp(shared, cp, h):
+                y, loss = lax.switch(role, branches, shared, cp, h, m)
+                return y, loss
+            g_in = lax.dynamic_index_in_dim(g_buf, jnp.maximum(gs, 0), 0,
+                                            keepdims=False)
+            # cotangent: interior stages receive dL/dy; the last virtual
+            # stage is seeded by its own loss term (dL/dloss = 1)
+            seed_loss = (role == ROLE_LAST).astype(jnp.float32)
+            g_act = jnp.where(role == ROLE_LAST,
+                              jnp.zeros_like(g_in), g_in)
+            _, vjp = jax.vjp(fwd_for_vjp, shared0, cparams, h_in)
+            d_shared, d_c, dh = vjp((g_act, seed_loss))
+            return dh, jnp.zeros((), jnp.float32), \
+                {"shared": d_shared, "blocks_c": d_c}, dh
+
+        def do_idle(_):
+            return jnp.zeros((mb, S, E), cfg.dtype), \
+                jnp.zeros((), jnp.float32), jax.tree.map(
+                    lambda x: jnp.zeros(x.shape, jnp.float32),
+                    {"shared": shared0, "blocks_c": cparams}), \
+                jnp.zeros((mb, S, E), cfg.dtype)
+
+        out_act, loss_add, d, dh_out = lax.switch(
+            direction, (do_idle, do_fwd, do_bwd), None)
+        loss_sum = loss_sum + loss_add
+        grads = {
+            "shared": jax.tree.map(lambda a, b: a + b, grads["shared"],
+                                   d["shared"]),
+            "blocks": jax.tree.map(
+                lambda acc, dc: acc.at[c].add(dc), grads["blocks"],
+                d["blocks_c"]),
+        }
+        # activations one hop forward; receivers bank per the tables
+        arriving = lax.ppermute(
+            out_act.astype(cfg.dtype), axis_name,
+            [(j, (j + 1) % Pn) for j in range(Pn)])
+        rf = t_rf[tau, stage]
+        h_prev = lax.dynamic_index_in_dim(h_buf, jnp.maximum(rf, 0), 0,
+                                          keepdims=False)
+        h_buf = lax.dynamic_update_index_in_dim(
+            h_buf, jnp.where(rf >= 0, arriving, h_prev),
+            jnp.maximum(rf, 0), 0)
+        # cotangents one hop backward
+        arriving_g = lax.ppermute(
+            dh_out.astype(cfg.dtype), axis_name,
+            [(j, (j - 1) % Pn) for j in range(Pn)])
+        rb = t_rb[tau, stage]
+        g_prev = lax.dynamic_index_in_dim(g_buf, jnp.maximum(rb, 0), 0,
+                                          keepdims=False)
+        g_buf = lax.dynamic_update_index_in_dim(
+            g_buf, jnp.where(rb >= 0, arriving_g, g_prev),
+            jnp.maximum(rb, 0), 0)
+        return (h_buf, g_buf, loss_sum, grads), None
+
+    h_buf0 = jnp.zeros((sched.h_depth, mb, S, E), cfg.dtype)
+    g_buf0 = jnp.zeros((sched.g_depth, mb, S, E), cfg.dtype)
+    (_, _, loss_sum, grads), _ = lax.scan(
+        tick, (h_buf0, g_buf0, jnp.zeros((), jnp.float32), zeros_grads()),
+        jnp.arange(T))
+    loss_sum = lax.psum(loss_sum, psum_axes)
+    d_shared = jax.tree.map(lambda x: lax.psum(x, psum_axes),
+                            grads["shared"])
+    d_blocks = jax.tree.map(
+        lambda x: x.reshape((x.shape[0] * x.shape[1],) + x.shape[2:]),
+        grads["blocks"])
+    if len(psum_axes) > 1:      # data axes shard the microbatch dim
+        d_blocks = jax.tree.map(
+            lambda x: lax.psum(x, psum_axes[1:]), d_blocks)
+    return loss_sum, d_shared, d_blocks
+
+
+def pipeline_lm_1f1b_grads(cfg, pp_params, tokens, targets, mesh: Mesh,
+                           num_microbatches: int, interleave: int = 1,
+                           axis_name: str = "pp"):
+    """Mean loss AND grads of a stage-sliced CausalLM under interleaved
+    1F1B. pp_params is the stack_lm_params layout with blocks PRE-PERMUTED
+    by interleave_blocks (identity when interleave=1), sharded over pp.
+    tokens/targets [M, mb, S] int32. Returns (loss, grads) with grads in
+    the same (permuted) layout — feed optax directly.
+
+    Matches pipeline_lm_loss + jax.grad numerically (same maths, different
+    schedule); pinned by tests/test_parallel.py::TestPipeline1F1B."""
+    n_stages = mesh.shape[axis_name]
+    M = num_microbatches
+    if M % n_stages:
+        raise ValueError(f"num_microbatches={M} must divide over "
+                         f"pp={n_stages}")
+    if cfg.num_layers % (n_stages * interleave):
+        raise ValueError(
+            f"num_layers={cfg.num_layers} must divide over pp×interleave="
+            f"{n_stages}×{interleave}")
+    sched = simulate_1f1b(n_stages, M, interleave)
+    tables = {k: jnp.asarray(getattr(sched, k)) for k in (
+        "dir", "role", "chunk", "mb", "h_slot", "g_slot",
+        "recv_fwd_slot", "recv_bwd_slot")}
+
+    from .mesh import BATCH_AXES
+    import math as _math
+
+    data_deg = _math.prod(mesh.shape[a] for a in BATCH_AXES)
+    shard_mb = data_deg > 1 and tokens.shape[1] % data_deg == 0
+    stream_spec = P(None, BATCH_AXES) if shard_mb else P()
+    psum_axes = (axis_name, *BATCH_AXES) if shard_mb else (axis_name,)
+
+    specs = {
+        "wte": P(), "wpe": P(),
+        "blocks": jax.tree.map(lambda _: P(axis_name),
+                               pp_params["blocks"]),
+        "ln_f": jax.tree.map(lambda _: P(), pp_params["ln_f"]),
+    }
+    manual = frozenset(a for a in mesh.axis_names if a != "tp")
+    fn = shard_map(
+        functools.partial(_lm_1f1b_local, cfg, sched, axis_name,
+                          psum_axes, tables),
+        mesh=mesh,
+        in_specs=(specs, stream_spec, stream_spec),
+        out_specs=(P(), jax.tree.map(lambda _: P(), {
+            "wte": pp_params["wte"], "wpe": pp_params["wpe"],
+            "ln_f": pp_params["ln_f"]}),
+            jax.tree.map(lambda _: P(axis_name), pp_params["blocks"])),
+        axis_names=manual,
+        check_vma=False,
+    )
+    loss_sum, d_shared, d_blocks = fn(pp_params, tokens, targets)
+    denom = tokens.shape[0] * tokens.shape[1] * tokens.shape[2]
+    grads = {
+        "wte": d_shared["wte"] / denom,
+        "wpe": d_shared["wpe"] / denom,
+        "ln_f": jax.tree.map(lambda x: x / denom, d_shared["ln_f"]),
+        "blocks": jax.tree.map(lambda x: x / denom, d_blocks),
+    }
+    return loss_sum / denom, grads
+
+
+__all__ = ["Schedule", "simulate_1f1b",
+           "interleave_blocks", "deinterleave_blocks",
+           "pipeline_lm_1f1b_grads", "IDLE", "FWD", "BWD"]

@@ -55,10 +55,18 @@ class PipelineLMTrainer:
     def __init__(self, cfg: TransformerConfig, mesh: Mesh,
                  config: Optional[LMTrainerConfig] = None,
                  num_microbatches: Optional[int] = None,
-                 tx: Optional[optax.GradientTransformation] = None):
+                 tx: Optional[optax.GradientTransformation] = None,
+                 schedule: str = "gpipe", interleave: int = 1):
         self.cfg = cfg
         self.mesh = mesh
         self.config = config or LMTrainerConfig()
+        if schedule not in ("gpipe", "1f1b"):
+            raise ValueError(f"schedule={schedule!r}; expected gpipe|1f1b")
+        if interleave > 1 and schedule != "1f1b":
+            raise ValueError("interleave>1 requires schedule='1f1b' "
+                             "(virtual stages are a 1F1B concept)")
+        self.schedule = schedule
+        self.interleave = interleave
         if cfg.pos_embedding != "learned":
             raise ValueError(
                 f"the pipeline trainer supports learned-position models "
@@ -69,6 +77,10 @@ class PipelineLMTrainer:
         if self.num_microbatches % self.pp:
             raise ValueError(f"num_microbatches={self.num_microbatches} "
                              f"must divide over pp={self.pp}")
+        if cfg.num_layers % (self.pp * self.interleave):
+            raise ValueError(
+                f"num_layers={cfg.num_layers} must divide over "
+                f"pp×interleave={self.pp}×{self.interleave}")
         if self.config.global_batch_size % self.num_microbatches:
             raise ValueError(
                 f"global_batch_size={self.config.global_batch_size} must "
@@ -91,6 +103,10 @@ class PipelineLMTrainer:
 
     @property
     def bubble(self) -> float:
+        if self.schedule == "1f1b":
+            from ..parallel.pipeline_1f1b import simulate_1f1b
+            return simulate_1f1b(self.pp, self.num_microbatches,
+                                 self.interleave).bubble_fraction
         return bubble_fraction(self.pp, self.num_microbatches)
 
     # -- initialization -----------------------------------------------------
@@ -121,6 +137,15 @@ class PipelineLMTrainer:
         def init_all(rng):
             variables = meta.unbox(model.init(rng, dummy))
             params = stack_lm_params(variables["params"], cfg.num_layers)
+            if self.schedule == "1f1b" and self.interleave > 1:
+                # 1F1B virtual stages: device-major chunk layout so a
+                # plain pp sharding hands each device its chunk stack
+                # (parallel/pipeline_1f1b.interleave_blocks); grads and
+                # optimizer state live in the same layout
+                from ..parallel.pipeline_1f1b import interleave_blocks
+                params = dict(params)
+                params["blocks"] = interleave_blocks(
+                    params["blocks"], self.pp, self.interleave)
             return params, self.tx.init(params)
 
         abstract_p, _ = jax.eval_shape(init_all, rng)
@@ -141,11 +166,56 @@ class PipelineLMTrainer:
 
     # -- the jitted step ----------------------------------------------------
 
+    # -- checkpoint layout --------------------------------------------------
+    # Checkpoints are ALWAYS written in canonical layer order so a run can
+    # switch pp schedule / interleave across restarts without silently
+    # loading permuted weights; the 1F1B device-major layout exists only
+    # inside the live training state.
+
+    def _permute_state(self, state: PPTrainState,
+                       to_canonical: bool) -> PPTrainState:
+        if self.schedule != "1f1b" or self.interleave <= 1:
+            return state
+        from ..parallel.pipeline_1f1b import (deinterleave_blocks,
+                                              interleave_blocks)
+        fn = deinterleave_blocks if to_canonical else interleave_blocks
+        L = self.cfg.num_layers
+
+        def fix(tree):
+            # any leaf under a "blocks" path with the stacked layer dim
+            # (params AND the AdamW moments mirroring them)
+            def f(path, leaf):
+                if ("blocks" in jax.tree_util.keystr(path)
+                        and hasattr(leaf, "ndim") and leaf.ndim >= 1
+                        and leaf.shape[0] == L):
+                    return fn(leaf, self.pp, self.interleave)
+                return leaf
+            return jax.tree_util.tree_map_with_path(f, tree)
+
+        return state.replace(params=fix(state.params),
+                             opt_state=fix(state.opt_state))
+
+    def canonical_state(self, state: PPTrainState) -> PPTrainState:
+        """The checkpoint view (canonical layer order)."""
+        return self._permute_state(state, to_canonical=True)
+
+    def from_canonical_state(self, state: PPTrainState) -> PPTrainState:
+        """Back to this trainer's live layout after a restore."""
+        return self._permute_state(state, to_canonical=False)
+
     def _step_fn(self, state: PPTrainState, tokens, targets):
-        def loss_fn(params):
-            return pipeline_lm_loss(self.cfg, params, tokens, targets,
-                                    self.mesh, self.num_microbatches)
-        loss, grads = jax.value_and_grad(loss_fn)(state.params)
+        if self.schedule == "1f1b":
+            # 1F1B computes grads IN-SCHEDULE (backward ticks interleave
+            # with forwards), so no outer jax.grad
+            from ..parallel.pipeline_1f1b import pipeline_lm_1f1b_grads
+            loss, grads = pipeline_lm_1f1b_grads(
+                self.cfg, state.params, tokens, targets, self.mesh,
+                self.num_microbatches, interleave=self.interleave)
+        else:
+            def loss_fn(params):
+                return pipeline_lm_loss(self.cfg, params, tokens, targets,
+                                        self.mesh, self.num_microbatches)
+            loss, grads = jax.value_and_grad(loss_fn)(state.params)
         updates, new_opt = state.tx.update(grads, state.opt_state,
                                            state.params)
         return state.replace(
@@ -224,7 +294,9 @@ class PipelineLMTrainer:
         stats = flops.throughput_stats(
             per_token * tokens_per_step, tps / tokens_per_step, n)
         log(f"pp={self.pp} M={self.num_microbatches} "
-            f"bubble={self.bubble:.1%}: {tps:.0f} tokens/sec")
+            f"schedule={self.schedule}"
+            + (f"×{self.interleave}" if self.interleave > 1 else "")
+            + f" bubble={self.bubble:.1%}: {tps:.0f} tokens/sec")
         return state, {"tokens_per_sec": tps,
                        "tokens_per_sec_per_device": tps / n,
                        "final_loss": final_loss,

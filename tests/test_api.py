@@ -153,3 +153,18 @@ def test_mode_b_zero_chip_rejected_at_admission():
     # cpu-resource jobs carry no chips by design — not rejected
     validate_spec(TPUJobSpec(replicas=2,
                              processing_resource_type=RESOURCE_CPU))
+
+
+def test_multislice_mode_a_per_worker_divisibility_at_admission():
+    """Mode A with an explicit per-worker count: the derived worker count
+    must divide into numSlices AT ADMISSION (tpus=16/16-per-worker = 1
+    worker can't split over 2 slices); the flag-default case stays a
+    controller backstop."""
+    with pytest.raises(ValidationError, match="does not divide into 2"):
+        validate_spec(TPUJobSpec(tpus=16, tpus_per_worker=16, num_slices=2,
+                                 slice_topology="2x4"))
+    # divisible derivations pass
+    validate_spec(TPUJobSpec(tpus=16, tpus_per_worker=8, num_slices=2,
+                             slice_topology="2x4"))
+    with pytest.raises(ValidationError, match="does not divide into 2"):
+        validate_spec(TPUJobSpec(replicas=3, num_slices=2))

@@ -397,3 +397,72 @@ def test_initialize_leaves_no_marker_on_sick_runtime(tmp_path):
                         ENV_EXPECTED_CHIPS: "99"},
                    hostname="job-worker-0")
     assert not marker.exists()
+
+
+# ---------------------------------------------------------------------------
+# multi-slice rank derivation (SURVEY §7 "Multi-slice (DCN) bootstrap")
+# ---------------------------------------------------------------------------
+
+def test_multislice_global_rank_is_slice_major():
+    """Pod `<job>-worker-s<k>-<i>` + TPU_SLICE_ID=k → global worker index
+    k*workers_per_slice + i, matching the controller's rank-major
+    worker-hostnames order (the hostfile-analogue topology truth)."""
+    from mpi_operator_tpu.bootstrap.bootstrap import (
+        ENV_SLICE_ID, ENV_WORKERS_PER_SLICE)
+
+    env = {ENV_COORDINATOR: "ms-worker-s0-0.ms-worker.default.svc:8476",
+           ENV_NUM_PROCESSES: "4", "TPU_NUM_SLICES": "2",
+           ENV_SLICE_ID: "1", ENV_WORKERS_PER_SLICE: "2"}
+    info = process_info(env=env, hostname="ms-worker-s1-0")
+    assert info.process_id == 2            # slice 1 starts at rank 2
+    assert info.slice_id == 1
+    assert info.num_slices == 2
+    assert info.workers_per_slice == 2
+    info = process_info(env={**env, ENV_SLICE_ID: "0"},
+                        hostname="ms-worker-s0-1")
+    assert info.process_id == 1
+
+
+def test_multislice_workers_per_slice_derivable():
+    """workers-per-slice can be derived from num_processes/slots/slices
+    when the env omits it (older ConfigMaps)."""
+    env = {ENV_COORDINATOR: "c:1", ENV_NUM_PROCESSES: "8",
+           "TPU_NUM_SLICES": "2", "TPU_SLICE_ID": "1"}
+    info = process_info(env=env, hostname="j-worker-s1-3")
+    assert info.workers_per_slice == 4
+    assert info.process_id == 7
+
+
+def test_multislice_slots_interleave_within_slice():
+    """slots>1 × multi-slice: rank = (slice*wps + ordinal)*slots + local."""
+    from mpi_operator_tpu.bootstrap.bootstrap import ENV_LOCAL_RANK
+
+    env = {ENV_COORDINATOR: "c:1", ENV_NUM_PROCESSES: "8",
+           "TPU_NUM_SLICES": "2", "TPU_SLICE_ID": "1",
+           "TPU_WORKERS_PER_SLICE": "2", "TPU_SLOTS_PER_WORKER": "2",
+           ENV_LOCAL_RANK: "1"}
+    info = process_info(env=env, hostname="j-worker-s1-1")
+    assert info.process_id == (1 * 2 + 1) * 2 + 1    # == 7
+
+
+def test_slice_id_out_of_range_rejected():
+    with pytest.raises(BootstrapError, match="TPU_SLICE_ID=3"):
+        process_info(env={ENV_COORDINATOR: "c:1", ENV_NUM_PROCESSES: "4",
+                          "TPU_NUM_SLICES": "2", "TPU_SLICE_ID": "3"},
+                     hostname="j-worker-s3-0")
+
+
+def test_hybrid_mesh_from_env_contract():
+    """bootstrap.hybrid_mesh builds the dcn×dp mesh straight from the
+    controller-injected env — the REAL env contract, no hand-built mesh."""
+    from mpi_operator_tpu.bootstrap.bootstrap import hybrid_mesh
+
+    import jax
+    n = jax.device_count()
+    info = process_info(
+        env={ENV_COORDINATOR: "c:1", ENV_NUM_PROCESSES: "1",
+             "TPU_NUM_SLICES": "2"},
+        hostname="j-worker-s0-0")
+    mesh = hybrid_mesh(info)
+    assert dict(mesh.shape)["dcn"] == 2
+    assert dict(mesh.shape)["dp"] == n // 2

@@ -996,3 +996,131 @@ def test_health_gate_annotation_opt_out():
                   "test" + WORKER_SUFFIX).spec.template.main_container()
     assert c.readiness_probe is None
     assert "TPU_READY_FILE" not in c.env
+
+
+# ---------------------------------------------------------------------------
+# multi-slice topology (SURVEY §7 "Multi-slice (DCN) bootstrap";
+# VERDICT r02 missing #2 — the controller must actually PLACE slices)
+# ---------------------------------------------------------------------------
+
+def _two_slice_job(name="ms", tpus=16, num_slices=2):
+    job = new_job(name=name, tpus=tpus)
+    job.spec.num_slices = num_slices
+    job.spec.slice_topology = "2x4"      # per-slice v5e-8
+    return job
+
+
+def test_multislice_materializes_per_slice_worker_groups():
+    """numSlices=2, tpus=16, 4/worker → two StatefulSets of 2 workers
+    each, named <job>-worker-s<k>, with slice-id env and a SHARED
+    governing Service (pod names are unique across groups)."""
+    f = Fixture()
+    f.seed(_two_slice_job())
+    f.run("default/ms")
+    groups = []
+    for k in (0, 1):
+        sts = f.api.get("StatefulSet", "default", f"ms-worker-s{k}")
+        groups.append(sts)
+        assert sts.spec.replicas == 2
+        assert sts.spec.service_name == "ms-worker"   # shared DNS backing
+        c = sts.spec.template.main_container()
+        assert c.env["TPU_SLICE_ID"] == str(k)
+        assert c.env["MEGASCALE_SLICE_ID"] == str(k)
+        assert c.env["MEGASCALE_NUM_SLICES"] == "2"
+        assert c.env["TPU_WORKERS_PER_SLICE"] == "2"
+        assert c.env["TPU_NUM_SLICES"] == "2"
+        assert sts.spec.template.metadata.labels["tpu_job_slice"] == str(k)
+        # each slice carries the per-slice topology selector
+        assert sts.spec.template.node_selector[
+            "cloud.google.com/gke-tpu-topology"] == "2x4"
+    # the flat single-slice name must NOT exist
+    from mpi_operator_tpu.cluster.apiserver import NotFoundError
+    with pytest.raises(NotFoundError):
+        f.api.get("StatefulSet", "default", "ms-worker")
+    # megascale coordinator points at slice-0 worker-0
+    c0 = groups[0].spec.template.main_container()
+    assert c0.env["MEGASCALE_COORDINATOR_ADDRESS"].startswith(
+        "ms-worker-s0-0.")
+
+
+def test_multislice_configmap_is_rank_major():
+    """worker-hostnames must list slice-0's workers first (global rank
+    order = slice-major), and the role must name every pod of every
+    slice — the hostfile-as-topology-truth analogue
+    (ref mpi_job_controller.go:857-869)."""
+    f = Fixture()
+    f.seed(_two_slice_job())
+    f.run("default/ms")
+    cm = f.api.get("ConfigMap", "default", "ms" + CONFIG_SUFFIX)
+    assert cm.data["worker-hostnames"] == (
+        "ms-worker-s0-0.ms-worker.default.svc\n"
+        "ms-worker-s0-1.ms-worker.default.svc\n"
+        "ms-worker-s1-0.ms-worker.default.svc\n"
+        "ms-worker-s1-1.ms-worker.default.svc\n"
+    )
+    assert cm.data["coordinator-address"] == (
+        "ms-worker-s0-0.ms-worker.default.svc:8476")
+    assert cm.data["num-slices"] == "2"
+    assert cm.data["workers-per-slice"] == "2"
+    assert cm.data["num-processes"] == "4"
+    role = f.api.get("Role", "default", "ms-launcher")
+    names = [n for rule in role.rules for n in rule.resource_names]
+    for pod in ("ms-worker-s0-0", "ms-worker-s0-1",
+                "ms-worker-s1-0", "ms-worker-s1-1"):
+        assert pod in names
+
+
+def test_multislice_launcher_gated_on_all_slices():
+    """One Ready slice is NOT enough — the launcher must wait for every
+    slice (a missing slice would hang the first cross-slice collective)."""
+    f = Fixture()
+    f.seed(_two_slice_job())
+    f.run("default/ms")
+    # slice 0 fully ready, slice 1 not
+    s0 = f.api.get("StatefulSet", "default", "ms-worker-s0")
+    s0.status = StatefulSetStatus(ready_replicas=2, replicas=2)
+    f.api.update(s0)
+    f.run("default/ms")
+    from mpi_operator_tpu.cluster.apiserver import NotFoundError
+    with pytest.raises(NotFoundError):
+        f.api.get("Job", "default", "ms-launcher")
+    # slice 1 comes up → launcher created
+    s1 = f.api.get("StatefulSet", "default", "ms-worker-s1")
+    s1.status = StatefulSetStatus(ready_replicas=2, replicas=2)
+    f.api.update(s1)
+    f.run("default/ms")
+    f.api.get("Job", "default", "ms-launcher")      # exists now
+    st = f.api.get(api.KIND, "default", "ms").status
+    assert st.worker_replicas == 4                  # aggregated across slices
+
+
+def test_multislice_scale_down_covers_all_groups():
+    f = Fixture()
+    f.seed(_two_slice_job())
+    f.run("default/ms")
+    for k in (0, 1):
+        s = f.api.get("StatefulSet", "default", f"ms-worker-s{k}")
+        s.status = StatefulSetStatus(ready_replicas=2, replicas=2)
+        f.api.update(s)
+    f.run("default/ms")
+    launcher = f.api.get("Job", "default", "ms-launcher")
+    launcher.status.succeeded = 1
+    f.api.update_status(launcher)
+    f.run("default/ms")
+    for k in (0, 1):
+        assert f.api.get("StatefulSet", "default",
+                         f"ms-worker-s{k}").spec.replicas == 0
+
+
+def test_multislice_indivisible_replicas_rejected_at_admission():
+    """replicas mode: 3 workers cannot split into 2 slices — rejected at
+    admission (fail at admission, not at runtime); the controller's
+    allocate keeps the same check as a backstop."""
+    f = Fixture()
+    job = new_job(name="bad", tpus=None)
+    job.spec.replicas = 3
+    job.spec.num_slices = 2
+    job.spec.template.main_container().limits = {api.RESOURCE_TPU: 4}
+    with pytest.raises(InMemoryAPIServer.AdmissionError,
+                       match="does not divide into 2 slices"):
+        f.seed(job)

@@ -121,7 +121,7 @@ class TestSerializeRoundTrip:
         for obj in (
             ctl.new_config_map(job, alloc),
             ctl.new_launcher_service_account(job),
-            ctl.new_launcher_role(job, alloc.worker_replicas),
+            ctl.new_launcher_role(job, alloc),
             ctl.new_launcher_role_binding(job),
             ctl.new_worker_service(job),
             ctl.new_pdb(job, alloc.worker_replicas),

@@ -399,3 +399,149 @@ class TestPipelineTrainer:
                               LMTrainerConfig(global_batch_size=16,
                                               seq_len=8),
                               num_microbatches=8, tx=optax.sgd(0.1))
+
+
+class TestPipeline1F1B:
+    """Interleaved 1F1B (parallel/pipeline_1f1b.py): same loss/grads as
+    GPipe/unpiped, strictly smaller bubble with interleaving, O(P·v)
+    in-flight memory by construction (VERDICT r02 next #4)."""
+
+    def test_schedule_invariants(self):
+        from mpi_operator_tpu.parallel.pipeline_1f1b import simulate_1f1b
+
+        for (P, M, v) in [(2, 4, 1), (4, 8, 1), (4, 8, 2), (2, 8, 2),
+                          (4, 16, 4)]:
+            s = simulate_1f1b(P, M, v)
+            VP = v * P
+            done_f = np.full((VP, M), -1)
+            done_b = np.full((VP, M), -1)
+            for t in range(s.ticks):
+                for d in range(P):
+                    if s.dir[t, d] == 0:
+                        continue
+                    k = s.chunk[t, d] * P + d
+                    m = s.mb[t, d]
+                    if s.dir[t, d] == 1:
+                        # fwd dependency: previous virtual stage finished
+                        assert done_f[k, m] == -1
+                        if k > 0:
+                            assert 0 <= done_f[k - 1, m] < t
+                        done_f[k, m] = t
+                    else:
+                        assert done_b[k, m] == -1
+                        if k == VP - 1:
+                            assert 0 <= done_f[k, m] < t
+                        else:
+                            assert 0 <= done_b[k + 1, m] < t
+                        done_b[k, m] = t
+            assert (done_f >= 0).all() and (done_b >= 0).all()
+
+    def test_interleaving_shrinks_the_bubble(self):
+        """The VERDICT criterion: measurably fewer idle ticks at pp=4.
+        v=2 at pp=4/M=8 nearly halves the idle fraction; v=1 in-flight
+        memory is O(P), not O(M)."""
+        from mpi_operator_tpu.parallel.pipeline_1f1b import simulate_1f1b
+
+        s1 = simulate_1f1b(4, 8, 1)
+        s2 = simulate_1f1b(4, 8, 2)
+        assert s2.bubble_fraction < 0.65 * s1.bubble_fraction
+        assert s1.h_depth <= 4            # O(P): GPipe holds all M=8
+        s_big = simulate_1f1b(4, 32, 1)
+        assert s_big.h_depth <= 4         # independent of M
+
+    def _parity(self, pp, dp, v, L):
+        from flax.core import meta
+        from mpi_operator_tpu.parallel.pipeline import (
+            pipeline_lm_loss, stack_lm_params)
+        from mpi_operator_tpu.parallel.pipeline_1f1b import (
+            interleave_blocks, pipeline_lm_1f1b_grads)
+
+        cfg = gpt2_config("test", attention="dense", dtype=jnp.float32,
+                          vocab_size=128, max_len=16, num_layers=L)
+        mesh = make_mesh(MeshConfig(pp=pp, dp=dp))
+        model = CausalLM(cfg)
+        M, mb, S = 2 * pp, 2, 16
+        toks = jax.random.randint(jax.random.PRNGKey(1), (M, mb, S), 0, 128)
+        tgts = jnp.roll(toks, -1, axis=-1)
+        vs = meta.unbox(model.init(jax.random.PRNGKey(0),
+                                   jnp.zeros((2, S), jnp.int32)))
+        pp_params = stack_lm_params(vs["params"], cfg.num_layers)
+        loss_g, grads_g = jax.jit(jax.value_and_grad(
+            lambda p: pipeline_lm_loss(cfg, p, toks, tgts, mesh, M)))(
+                pp_params)
+        params_v = dict(pp_params)
+        params_v["blocks"] = interleave_blocks(pp_params["blocks"], pp, v)
+        loss_f, grads_f = jax.jit(lambda p: pipeline_lm_1f1b_grads(
+            cfg, p, toks, tgts, mesh, M, interleave=v))(params_v)
+        np.testing.assert_allclose(np.asarray(loss_g), np.asarray(loss_f),
+                                   atol=1e-5)
+        gb = interleave_blocks(grads_g["blocks"], pp, v)
+        jax.tree.map(lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=1e-5),
+            gb, grads_f["blocks"])
+        for k in ("wte", "wpe"):
+            np.testing.assert_allclose(np.asarray(grads_g[k]),
+                                       np.asarray(grads_f[k]), atol=1e-5)
+
+    def test_1f1b_matches_gpipe_pp2(self):
+        self._parity(pp=2, dp=4, v=1, L=2)
+
+    def test_1f1b_interleaved_matches_gpipe(self):
+        self._parity(pp=2, dp=4, v=2, L=4)
+
+    def test_1f1b_trainer_step(self):
+        """End-to-end: PipelineLMTrainer(schedule='1f1b', interleave=2)
+        runs a full train step (grads in-schedule + optimizer) and the
+        loss decreases over a few steps."""
+        from mpi_operator_tpu.train.lm_trainer import LMTrainerConfig
+        from mpi_operator_tpu.train.pp_trainer import PipelineLMTrainer
+
+        cfg = gpt2_config("test", attention="dense", dtype=jnp.float32,
+                          vocab_size=128, max_len=16, num_layers=4)
+        mesh = make_mesh(MeshConfig(pp=2, dp=4))
+        M, S = 4, 16
+        tcfg = LMTrainerConfig(global_batch_size=4 * M, seq_len=S,
+                               warmup_steps=1)
+        tr = PipelineLMTrainer(cfg, mesh, tcfg, num_microbatches=M,
+                               schedule="1f1b", interleave=2)
+        state = tr.init_state(jax.random.PRNGKey(0))
+        toks = jax.random.randint(jax.random.PRNGKey(2),
+                                  (tcfg.global_batch_size, S + 1), 0, 128)
+        stream = tr.microbatch(toks[:, :-1], toks[:, 1:])
+        losses = []
+        for _ in range(5):
+            state, m = tr.train_step(state, *stream)
+            losses.append(float(m["loss"]))
+        assert losses[-1] < losses[0], losses
+        assert int(state.step) == 5
+
+    def test_checkpoint_layout_is_schedule_agnostic(self):
+        """Checkpoints are written in canonical layer order regardless of
+        schedule, so a gpipe checkpoint resumes under 1f1b×2 (and back)
+        without silently permuting layers."""
+        from mpi_operator_tpu.train.lm_trainer import LMTrainerConfig
+        from mpi_operator_tpu.train.pp_trainer import PipelineLMTrainer
+
+        cfg = gpt2_config("test", attention="dense", dtype=jnp.float32,
+                          vocab_size=128, max_len=16, num_layers=4)
+        mesh = make_mesh(MeshConfig(pp=2, dp=4))
+        tcfg = LMTrainerConfig(global_batch_size=16, seq_len=16,
+                               warmup_steps=1)
+        g = PipelineLMTrainer(cfg, mesh, tcfg, num_microbatches=4)
+        f = PipelineLMTrainer(cfg, mesh, tcfg, num_microbatches=4,
+                              schedule="1f1b", interleave=2)
+        gs = g.init_state(jax.random.PRNGKey(0))
+        fs = f.init_state(jax.random.PRNGKey(0))
+        # same seed → identical canonical params (the live layouts differ)
+        jax.tree.map(lambda a, b: np.testing.assert_array_equal(
+            np.asarray(a), np.asarray(b)),
+            g.canonical_state(gs).params, f.canonical_state(fs).params)
+        # live layouts really are permuted relative to each other
+        diff = jax.tree.leaves(jax.tree.map(
+            lambda a, b: float(jnp.abs(a - b).max()),
+            gs.params["blocks"], fs.params["blocks"]))
+        assert max(diff) > 0
+        # roundtrip is exact
+        back = f.from_canonical_state(f.canonical_state(fs))
+        jax.tree.map(lambda a, b: np.testing.assert_array_equal(
+            np.asarray(a), np.asarray(b)), fs.params, back.params)
