@@ -61,6 +61,7 @@ STATUS_PORT = 8477
 LAUNCHER_LOST_EXIT = 213
 
 _ORDINAL_RE = re.compile(r"-(\d+)$")
+_SLICE_RE = re.compile(r"-s(\d+)-\d+$")   # <job>-worker-s<k>-<i>
 
 
 class BootstrapError(RuntimeError):
@@ -126,7 +127,25 @@ def process_info(
         env.get(ENV_NUM_PROCESSES) or cfg.get("num-processes") or 1)
     slots = int(env.get(ENV_SLOTS) or cfg.get("slots-per-worker") or 1)
     num_slices = int(env.get(ENV_NUM_SLICES) or cfg.get("num-slices") or 1)
-    slice_id = int(env.get(ENV_SLICE_ID) or 0)
+    is_launcher = env.get(ENV_LAUNCHER) == "1"
+    if env.get(ENV_SLICE_ID) is not None:
+        slice_id = int(env[ENV_SLICE_ID])
+    elif (num_slices > 1 and not is_launcher
+          and ENV_WORKER_ID not in env):
+        # ConfigMap-fallback processes (debug shells) have no slice env;
+        # the slice id is recoverable from the pod name's group token
+        # (`<job>-worker-s<k>-<i>`). Defaulting to 0 would collide global
+        # ranks across slices and hang the rendezvous. Launchers and
+        # explicit-TPU_WORKER_ID processes don't derive from hostnames.
+        m = _SLICE_RE.search(hostname or socket.gethostname())
+        if m is None:
+            raise BootstrapError(
+                f"numSlices={num_slices} but neither {ENV_SLICE_ID} nor a "
+                f"slice-group hostname (…-s<k>-<i>) identifies this "
+                f"process's slice")
+        slice_id = int(m.group(1))
+    else:
+        slice_id = 0
     workers_per_slice = int(
         env.get(ENV_WORKERS_PER_SLICE) or cfg.get("workers-per-slice") or 0)
     if num_slices > 1 and workers_per_slice == 0:
@@ -135,7 +154,6 @@ def process_info(
     if slice_id >= max(num_slices, 1):
         raise BootstrapError(
             f"{ENV_SLICE_ID}={slice_id} >= num_slices {num_slices}")
-    is_launcher = env.get(ENV_LAUNCHER) == "1"
 
     if ENV_WORKER_ID in env:
         pid = int(env[ENV_WORKER_ID])

@@ -304,12 +304,16 @@ def run_generate_benchmark(
     num_iters: int = 8,
     dtype_name: str = "bfloat16",
     temperature: float = 0.0,
+    family: str = "gpt2",
+    kv_cache_dtype: Optional[str] = None,
     log: Callable[[str], None] = print,
 ) -> Dict[str, float]:
     """Inference benchmark: KV-cache autoregressive decode throughput
     (models/generate.py). Reports end-to-end NEW tokens/sec (prefill
-    amortized in) for the gpt2 ladder — the inference half the reference
-    has no analogue for."""
+    amortized in) for the gpt2 AND llama families (llama's GQA cache is
+    num_heads/num_kv_heads× smaller, the decode-bandwidth win) — the
+    inference half the reference has no analogue for. kv_cache_dtype=
+    "int8" halves the cache bytes again (quantized storage)."""
     import time
 
     import jax
@@ -320,8 +324,9 @@ def run_generate_benchmark(
     from ..parallel import MeshConfig, make_mesh
 
     dtype = jnp.bfloat16 if dtype_name == "bfloat16" else jnp.float32
-    name = f"gpt2-{size}" if size else "gpt2"
+    name = f"{family}-{size}" if size else family
     model = create_lm(name, dtype=dtype,
+                      kv_cache_dtype=kv_cache_dtype,
                       max_len=max(prompt_len + new_tokens, 32))
     mesh = make_mesh(MeshConfig(dp=jax.device_count()))
     variables, _ = shard_init(
@@ -346,7 +351,8 @@ def run_generate_benchmark(
     int(out.tokens[0, -1])                 # host read = true barrier
     dt = time.perf_counter() - t0
     tps = batch * new_tokens * num_iters / dt
-    log(f"generate {name}: batch={batch} prompt={prompt_len} "
+    log(f"generate {name}{' kv=int8' if kv_cache_dtype == 'int8' else ''}: "
+        f"batch={batch} prompt={prompt_len} "
         f"new={new_tokens}: {tps:.0f} new tokens/sec")
     return {"decode_tokens_per_sec": tps,
             "tokens_per_iter": batch * new_tokens,

@@ -70,6 +70,11 @@ class TransformerConfig:
     # False; generate() flips it on a config copy — no extra params either
     # way, so trained params load directly.
     decode: bool = False
+    # decode KV-cache storage: None = model dtype; "int8" = symmetric
+    # per-vector quantization (one f32 scale per cached position×kv-head)
+    # — halves cache HBM vs bf16, so the bandwidth-bound decode step reads
+    # half the bytes. Dequantized transiently at attend time.
+    kv_cache_dtype: Optional[str] = None
     remat: bool = False                # jax.checkpoint each block
     # what remat may KEEP: "none" recomputes everything (min memory, ~2×
     # block fwd recompute); "dots" saves matmul outputs with no batch dims
@@ -215,14 +220,53 @@ class Attention(nn.Module):
         if cfg.pos_embedding == "rope":
             q = rope(q, pos)
             k = rope(k, pos)
-        ck = self.variable("cache", "cached_key", jnp.zeros,
-                           (B, L, KV, D), k.dtype)
-        cv = self.variable("cache", "cached_value", jnp.zeros,
-                           (B, L, KV, D), v.dtype)
-        ck.value = jax.lax.dynamic_update_slice(ck.value, k, (0, cur, 0, 0))
-        cv.value = jax.lax.dynamic_update_slice(cv.value, v, (0, cur, 0, 0))
-        ci.value = cur + S
-        keys, values = ck.value, cv.value
+        if cfg.kv_cache_dtype == "int8":
+            # symmetric per-vector int8: scale = max|x|/127 over the head
+            # dim, stored alongside. The cache is the decode bandwidth
+            # bottleneck (every step re-reads all L positions), so halving
+            # its bytes beats the tiny dequant cost.
+            def quant(x):
+                scale = jnp.max(jnp.abs(x), axis=-1, keepdims=True) \
+                    .astype(jnp.float32) / 127.0
+                scale = jnp.maximum(scale, 1e-8)
+                q8 = jnp.clip(jnp.round(x.astype(jnp.float32) / scale),
+                              -127, 127).astype(jnp.int8)
+                return q8, scale[..., 0]
+
+            ck = self.variable("cache", "cached_key", jnp.zeros,
+                               (B, L, KV, D), jnp.int8)
+            cv = self.variable("cache", "cached_value", jnp.zeros,
+                               (B, L, KV, D), jnp.int8)
+            ks = self.variable("cache", "key_scale", jnp.zeros,
+                               (B, L, KV), jnp.float32)
+            vs = self.variable("cache", "value_scale", jnp.zeros,
+                               (B, L, KV), jnp.float32)
+            k8, k_sc = quant(k)
+            v8, v_sc = quant(v)
+            ck.value = jax.lax.dynamic_update_slice(
+                ck.value, k8, (0, cur, 0, 0))
+            cv.value = jax.lax.dynamic_update_slice(
+                cv.value, v8, (0, cur, 0, 0))
+            ks.value = jax.lax.dynamic_update_slice(
+                ks.value, k_sc, (0, cur, 0))
+            vs.value = jax.lax.dynamic_update_slice(
+                vs.value, v_sc, (0, cur, 0))
+            ci.value = cur + S
+            keys = (ck.value.astype(cfg.dtype)
+                    * ks.value[..., None].astype(cfg.dtype))
+            values = (cv.value.astype(cfg.dtype)
+                      * vs.value[..., None].astype(cfg.dtype))
+        else:
+            ck = self.variable("cache", "cached_key", jnp.zeros,
+                               (B, L, KV, D), k.dtype)
+            cv = self.variable("cache", "cached_value", jnp.zeros,
+                               (B, L, KV, D), v.dtype)
+            ck.value = jax.lax.dynamic_update_slice(ck.value, k,
+                                                    (0, cur, 0, 0))
+            cv.value = jax.lax.dynamic_update_slice(cv.value, v,
+                                                    (0, cur, 0, 0))
+            ci.value = cur + S
+            keys, values = ck.value, cv.value
         if KV != H:
             keys = jnp.repeat(keys, H // KV, axis=2)
             values = jnp.repeat(values, H // KV, axis=2)

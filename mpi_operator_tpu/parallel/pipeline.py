@@ -213,6 +213,23 @@ def lm_stage_tp_specs(blocks, axis_name: str = "pp", tp_axis: str = "tp"):
     return jax.tree_util.tree_map_with_path(spec, blocks)
 
 
+def lm_stage_embed(cfg, wte, wpe, toks):
+    """Stage-0 input embedding, shared by the GPipe and 1F1B schedules
+    (ONE definition so the pinned numerical parity can't drift)."""
+    S = toks.shape[-1]
+    return wte[toks].astype(cfg.dtype) + wpe[:S][None].astype(cfg.dtype)
+
+
+def lm_stage_head_loss(cfg, ln_f, ln_f_params, wte, y, tgt):
+    """Last-stage ln_f + tied head + summed token cross-entropy, shared by
+    both pipeline schedules."""
+    from ..models.transformer import _head_matmul
+
+    h = ln_f.apply({"params": ln_f_params}, y)
+    logits = _head_matmul(h, wte.astype(cfg.dtype))
+    return optax.softmax_cross_entropy_with_integer_labels(logits, tgt).sum()
+
+
 def stack_lm_params(params, num_layers: int):
     """Restack unboxed CausalLM params (models/transformer.py) into the
     pipeline layout: blocks stacked on a leading layer dim (sharded over
@@ -243,7 +260,7 @@ def _lm_pipeline_local(cfg, axis_name: str, M: int, psum_axes, pp_params,
     over `psum_axes` — pp alone when the microbatch dim is replicated, pp
     plus the data axes when it is dp-sharded (pipeline_lm_loss picks); the
     caller divides by the static global token count."""
-    from ..models.transformer import Block, _head_matmul, _layer_norm
+    from ..models.transformer import Block, _layer_norm
 
     n_stages = lax.axis_size(axis_name)
     stage = lax.axis_index(axis_name)
@@ -258,8 +275,7 @@ def _lm_pipeline_local(cfg, axis_name: str, M: int, psum_axes, pp_params,
     ln_f = _layer_norm(cfg, "ln_f")      # the unpiped model's exact module
 
     def embed(toks):
-        return wte[toks].astype(cfg.dtype) \
-            + wpe[:S][None].astype(cfg.dtype)
+        return lm_stage_embed(cfg, wte, wpe, toks)
 
     def stage_apply(h):
         def body(h, layer_params):
@@ -268,10 +284,7 @@ def _lm_pipeline_local(cfg, axis_name: str, M: int, psum_axes, pp_params,
         return h
 
     def head_loss(y, tgt):
-        h = ln_f.apply({"params": pp_params["ln_f"]}, y)
-        logits = _head_matmul(h, wte.astype(cfg.dtype))
-        return optax.softmax_cross_entropy_with_integer_labels(
-            logits, tgt).sum()
+        return lm_stage_head_loss(cfg, ln_f, pp_params["ln_f"], wte, y, tgt)
 
     def inject(r_tok, r_tgt, tau):
         m_next = tau + 1 + stage

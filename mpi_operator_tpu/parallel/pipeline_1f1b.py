@@ -39,7 +39,6 @@ from typing import Dict
 import jax
 import jax.numpy as jnp
 import numpy as np
-import optax
 from jax import lax
 from jax import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
@@ -305,7 +304,8 @@ def _lm_1f1b_local(cfg, sched: Schedule, axis_name, psum_axes,
     leaves arrive [v*Lc, ...] (this device's chunk stack, interleave_blocks
     layout); tokens/targets [M, mb, S] are replicated across pp (raw int
     streams are cheap; the relay-register trick stays GPipe-only)."""
-    from ..models.transformer import Block, _head_matmul, _layer_norm
+    from ..models.transformer import Block, _layer_norm
+    from .pipeline import lm_stage_embed, lm_stage_head_loss
 
     v, Pn, M = sched.interleave, sched.num_stages, sched.num_microbatches
     stage = lax.axis_index(axis_name)
@@ -333,8 +333,7 @@ def _lm_1f1b_local(cfg, sched: Schedule, axis_name, psum_axes,
     # decides embed-in / head-out; lax.switch keeps one branch's cost.
     def f_first(shared, cparams, h_in, m):
         toks = lax.dynamic_index_in_dim(tokens, m, 0, keepdims=False)
-        h = shared["wte"][toks].astype(cfg.dtype) \
-            + shared["wpe"][:S][None].astype(cfg.dtype)
+        h = lm_stage_embed(cfg, shared["wte"], shared["wpe"], toks)
         return stage_stack(cparams, h), jnp.zeros((), jnp.float32)
 
     def f_mid(shared, cparams, h_in, m):
@@ -343,11 +342,9 @@ def _lm_1f1b_local(cfg, sched: Schedule, axis_name, psum_axes,
 
     def f_last(shared, cparams, h_in, m):
         y = stage_stack(cparams, h_in)
-        hn = ln_f.apply({"params": shared["ln_f"]}, y)
-        logits = _head_matmul(hn, shared["wte"].astype(cfg.dtype))
         tgt = lax.dynamic_index_in_dim(targets, m, 0, keepdims=False)
-        loss = optax.softmax_cross_entropy_with_integer_labels(
-            logits, tgt).sum()
+        loss = lm_stage_head_loss(cfg, ln_f, shared["ln_f"],
+                                  shared["wte"], y, tgt)
         return y, loss        # act out unused (never sent)
 
     branches = (f_first, f_mid, f_last)

@@ -62,6 +62,8 @@ class PipelineLMTrainer:
         self.config = config or LMTrainerConfig()
         if schedule not in ("gpipe", "1f1b"):
             raise ValueError(f"schedule={schedule!r}; expected gpipe|1f1b")
+        if interleave < 1:
+            raise ValueError(f"interleave={interleave} must be >= 1")
         if interleave > 1 and schedule != "1f1b":
             raise ValueError("interleave>1 requires schedule='1f1b' "
                              "(virtual stages are a 1F1B concept)")

@@ -466,3 +466,21 @@ def test_hybrid_mesh_from_env_contract():
     mesh = hybrid_mesh(info)
     assert dict(mesh.shape)["dcn"] == 2
     assert dict(mesh.shape)["dp"] == n // 2
+
+
+def test_slice_id_from_hostname_fallback():
+    """ConfigMap-fallback processes (no slice env) recover the slice id
+    from the pod name's group token — defaulting to 0 would collide
+    global ranks across slices."""
+    env = {ENV_COORDINATOR: "c:1", ENV_NUM_PROCESSES: "4",
+           "TPU_NUM_SLICES": "2", "TPU_WORKERS_PER_SLICE": "2"}
+    info = process_info(env=env, hostname="job-worker-s1-0")
+    assert info.slice_id == 1
+    assert info.process_id == 2
+    # a multi-slice worker with NO slice identity at all is a hard error
+    with pytest.raises(BootstrapError, match="identifies this"):
+        process_info(env=env, hostname="job-worker-0")
+    # launchers have no slice hostname and must not trip the check
+    info = process_info(env={**env, "TPU_LAUNCHER": "1"},
+                        hostname="job-launcher-abc12")
+    assert info.is_launcher and info.slice_id == 0
