@@ -87,10 +87,12 @@ def main() -> None:
     if args.batch_per_device is None:
         args.batch_per_device = 16 if args.workload in ("gpt2", "bert") else 256
 
-    def run_lm(workload, steps, warmup, batch=None):
+    def run_lm(workload, steps, warmup, batch=None, seq=None):
         from mpi_operator_tpu.examples.lm_benchmark import run_lm_benchmark
         size = "test" if args.smoke else None
-        # measured single-v5e sweet spot (gpt2-medium, seq 512): batch 16
+        # measured single-v5e sweet spots (gpt2-medium): seq 2048 wants
+        # batch 4 NO remat + the kernel's 1024-tile auto policy — 34.4k
+        # tok/s / 42.5% MFU, up from r02's 27.1k / 33%. seq 512: batch 16
         # NO remat — 44.5k tok/s (49.7% MFU) vs 39.4k with dots-remat and
         # 43.2k at batch 24; batch 32 no-remat OOMs. Flash attention +
         # bf16 LM head leave enough HBM that recompute buys nothing at
@@ -98,7 +100,7 @@ def main() -> None:
         _state, metrics = retry_infra_once(lambda: run_lm_benchmark(
             workload=workload, size=size,
             batch_per_device=2 if args.smoke else (batch or 16),
-            seq_len=32 if args.smoke else 512,
+            seq_len=32 if args.smoke else (seq or 512),
             num_steps=steps, warmup_steps=warmup,
             remat=False,
             dtype_name=args.dtype, log=lambda s: print(s, file=sys.stderr)))
@@ -251,6 +253,19 @@ def main() -> None:
         except Exception as exc:  # noqa: BLE001
             print(f"# gpt2 secondary bench failed: {exc!r}", file=sys.stderr)
             line["gpt2_error"] = type(exc).__name__
+        try:
+            # long-context leg (VERDICT r02 next #5): seq 2048 at the
+            # tuned config — no remat, auto 1024 flash tiles
+            lg = run_lm("gpt2", steps=min(args.steps, 20),
+                        warmup=min(args.warmup, 3), batch=4, seq=2048)
+            line["gpt2_seq2048_tokens_per_sec"] = round(
+                lg["tokens_per_sec"], 0)
+            line.update({f"gpt2_seq2048_{k}": v
+                         for k, v in mfu_fields(lg).items()})
+        except Exception as exc:  # noqa: BLE001
+            print(f"# longseq secondary bench failed: {exc!r}",
+                  file=sys.stderr)
+            line["longseq_error"] = type(exc).__name__
         try:
             g_med, g_spread = decode_leg("gpt2")
             line["gpt2_decode_tokens_per_sec"] = g_med

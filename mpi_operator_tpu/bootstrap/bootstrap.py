@@ -128,7 +128,7 @@ def process_info(
     slots = int(env.get(ENV_SLOTS) or cfg.get("slots-per-worker") or 1)
     num_slices = int(env.get(ENV_NUM_SLICES) or cfg.get("num-slices") or 1)
     is_launcher = env.get(ENV_LAUNCHER) == "1"
-    if env.get(ENV_SLICE_ID) is not None:
+    if env.get(ENV_SLICE_ID):        # empty string = unset (YAML artifact)
         slice_id = int(env[ENV_SLICE_ID])
     elif (num_slices > 1 and not is_launcher
           and ENV_WORKER_ID not in env):

@@ -52,6 +52,8 @@ def run_lm_benchmark(
     moe_experts: int = 0,
     ep: int = 1,
     fused_xent: bool = False,
+    flash_block_q: Optional[int] = None,
+    flash_block_k: Optional[int] = None,
     accum_steps: int = 1,
     data_dir: Optional[str] = None,
     train_dir: Optional[str] = None,
@@ -107,6 +109,10 @@ def run_lm_benchmark(
         # mixture routed over the ep axis (parallel/moe.py); the trainer
         # folds the load-balancing aux loss in automatically
         overrides = dict(num_experts=moe_experts)
+    if flash_block_q:
+        overrides["flash_block_q"] = flash_block_q
+    if flash_block_k:
+        overrides["flash_block_k"] = flash_block_k
     model = create_lm(name, dtype=dtype, attention=attention, remat=remat,
                       remat_policy=remat_policy, max_len=max(seq_len, 32),
                       **overrides)
@@ -458,6 +464,13 @@ def main(argv=None) -> int:
                         help="gradient accumulation: microbatches per "
                              "optimizer step (activation memory / N, "
                              "numerically identical update)")
+    parser.add_argument("--flash-block-q", type=int, default=0,
+                        help="flash-attention q tile (0 = kernel auto "
+                             "policy: 512, or 1024 when seq >= 2048 "
+                             "divides 1024); sweep per seq-len")
+    parser.add_argument("--flash-block-k", type=int, default=0,
+                        help="flash-attention k tile (0 = kernel auto "
+                             "policy, see --flash-block-q)")
     parser.add_argument("--fused-xent", action="store_true",
                         help="chunked tied-head cross-entropy: the full "
                              "[B*S, vocab] logits never hit HBM - slower "
@@ -524,6 +537,8 @@ def main(argv=None) -> int:
                 pp_interleave=args.pp_interleave, sp=args.sp,
                 moe_experts=args.moe_experts,
                 ep=args.ep, fused_xent=args.fused_xent,
+                flash_block_q=args.flash_block_q or None,
+                flash_block_k=args.flash_block_k or None,
                 accum_steps=args.accum_steps,
                 num_slices=info.num_slices,
                 attention=args.attention, remat=args.remat,

@@ -70,6 +70,11 @@ class TransformerConfig:
     # False; generate() flips it on a config copy — no extra params either
     # way, so trained params load directly.
     decode: bool = False
+    # flash-attention tile sizes (None = the kernel's default 512). Long
+    # sequences want bigger k tiles (fewer grid steps re-reading q/lse);
+    # sweep per seq-len on real hardware — see README long-context table.
+    flash_block_q: Optional[int] = None
+    flash_block_k: Optional[int] = None
     # decode KV-cache storage: None = model dtype; "int8" = symmetric
     # per-vector quantization (one f32 scale per cached position×kv-head)
     # — halves cache HBM vs bf16, so the bandwidth-bound decode step reads
@@ -304,7 +309,12 @@ def _attend(q, k, v, mask, cfg: TransformerConfig):
         impl = "dense"
     if impl == "flash":
         from ..ops.attention import flash_attention
-        return flash_attention(q, k, v, causal=cfg.causal, mask=mask)
+        kw = {}
+        if cfg.flash_block_q:
+            kw["block_q"] = cfg.flash_block_q
+        if cfg.flash_block_k:
+            kw["block_k"] = cfg.flash_block_k
+        return flash_attention(q, k, v, causal=cfg.causal, mask=mask, **kw)
     if impl == "ring":
         from ..parallel.ring_attention import (ring_attention,
                                                ring_attention_inner)

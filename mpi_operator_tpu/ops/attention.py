@@ -412,18 +412,28 @@ _flash_core.defvjp(_flash_core_fwd, _flash_bwd)
 
 def flash_attention(q, k, v, causal: bool = True,
                     mask=None,
-                    block_q: int = 512, block_k: int = 512,
+                    block_q: Optional[int] = None,
+                    block_k: Optional[int] = None,
                     interpret: Optional[bool] = None):
     """Flash attention over [B, S, H, D] tensors (layout matches
     models.transformer). `mask`: optional [B, S] valid-key mask (True =
     attend), the BERT padding mask. Falls back to dense attention when S
     doesn't tile into Mosaic-legal blocks.
+
+    block_q/block_k default to a per-seq-len policy measured on v5e
+    (gpt2-medium train step): 512 tiles up to seq 1024; 1024 tiles from
+    seq 2048 up — the bigger tiles cut grid steps that re-read q/lse and
+    buy +2pp MFU at 2048 and +4.6pp at 4096 (README long-context table).
+    2048-wide q tiles overflow VMEM; don't.
     """
     B, S, H, D = q.shape
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
-    block_q = min(block_q, S)
-    block_k = min(block_k, S)
+    # 1024 tiles only when they tile S exactly — a 512-multiple like 2560
+    # must keep 512 tiles (flash), never fall through to the dense path
+    auto = 1024 if S >= 2048 and S % 1024 == 0 else 512
+    block_q = min(block_q or auto, S)
+    block_k = min(block_k or auto, S)
     unaligned = (S % block_q or S % block_k
                  or (not interpret and (block_q % 8 or block_k % 8)))
     if unaligned:

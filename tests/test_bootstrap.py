@@ -484,3 +484,13 @@ def test_slice_id_from_hostname_fallback():
     info = process_info(env={**env, "TPU_LAUNCHER": "1"},
                         hostname="job-launcher-abc12")
     assert info.is_launcher and info.slice_id == 0
+
+
+def test_empty_slice_id_env_treated_as_unset():
+    """TPU_SLICE_ID: "" (a YAML templating artifact) must not crash with
+    a raw int() ValueError — it falls back to the hostname token."""
+    env = {ENV_COORDINATOR: "c:1", ENV_NUM_PROCESSES: "4",
+           "TPU_NUM_SLICES": "2", "TPU_WORKERS_PER_SLICE": "2",
+           "TPU_SLICE_ID": ""}
+    info = process_info(env=env, hostname="job-worker-s1-1")
+    assert info.slice_id == 1 and info.process_id == 3

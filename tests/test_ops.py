@@ -138,3 +138,21 @@ def test_bert_model_keeps_flash_with_mask():
                            side_effect=AssertionError("fell back to dense")):
         out = tr._attend(q, k, v, mask, cfg)
     assert out.shape == q.shape
+
+
+def test_auto_tile_policy_never_demotes_to_dense():
+    """Seq lens that are 512-multiples but not 1024-multiples (2560,
+    3584, ...) must keep 512 flash tiles — the 1024 auto tiles apply only
+    when they divide S exactly (falling through to dense attention at
+    long seq would OOM on a real chip)."""
+    import numpy as np
+
+    from mpi_operator_tpu.ops.attention import flash_attention
+    from mpi_operator_tpu.models.transformer import dense_attention
+
+    B, S, H, D = 1, 2560, 2, 8
+    q, k, v = (jax.random.normal(jax.random.PRNGKey(i), (B, S, H, D),
+                                 jnp.float32) for i in range(3))
+    out = flash_attention(q, k, v, causal=True)      # must take flash path
+    ref = dense_attention(q, k, v, causal=True, dtype=jnp.float32)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-3)
