@@ -50,6 +50,7 @@ def run_lm_benchmark(
     remat: bool = False,
     remat_policy: str = "none",
     moe_experts: int = 0,
+    moe_dropless: bool = False,
     ep: int = 1,
     fused_xent: bool = False,
     flash_block_q: Optional[int] = None,
@@ -76,6 +77,9 @@ def run_lm_benchmark(
     n = jax.device_count()
     if ep > 1 and not moe_experts:
         raise ValueError("--ep needs --moe-experts (nothing to shard)")
+    if moe_dropless and not moe_experts:
+        raise ValueError("--moe-dropless needs --moe-experts (no MoE is "
+                         "built without it)")
     if moe_experts and moe_experts % ep:
         # the sharding rules silently REPLICATE a non-divisible expert dim
         # (parallel/sharding._divisible_spec), which would mislabel a
@@ -108,7 +112,8 @@ def run_lm_benchmark(
         # expert-parallel MoE: every other block's FFN becomes a top-2
         # mixture routed over the ep axis (parallel/moe.py); the trainer
         # folds the load-balancing aux loss in automatically
-        overrides = dict(num_experts=moe_experts)
+        overrides = dict(num_experts=moe_experts,
+                         moe_dropless=moe_dropless)
     if flash_block_q:
         overrides["flash_block_q"] = flash_block_q
     if flash_block_k:
@@ -458,6 +463,11 @@ def main(argv=None) -> int:
     parser.add_argument("--moe-experts", type=int, default=0,
                         help="replace every other FFN with an N-expert "
                              "top-2 MoE (expert-parallel over ep)")
+    parser.add_argument("--moe-dropless", action="store_true",
+                        help="dropless MoE: every expert runs every token "
+                             "(num_experts× FFN FLOPs, zero dropped "
+                             "tokens); default is capacity dispatch with "
+                             "the drop rate sown as an intermediate")
     parser.add_argument("--ep", type=int, default=1,
                         help="expert-parallel degree (shards MoE experts)")
     parser.add_argument("--accum-steps", type=int, default=1,
@@ -536,6 +546,7 @@ def main(argv=None) -> int:
                 pp_schedule=args.pp_schedule,
                 pp_interleave=args.pp_interleave, sp=args.sp,
                 moe_experts=args.moe_experts,
+                moe_dropless=args.moe_dropless,
                 ep=args.ep, fused_xent=args.fused_xent,
                 flash_block_q=args.flash_block_q or None,
                 flash_block_k=args.flash_block_k or None,

@@ -92,6 +92,10 @@ class TransformerConfig:
     num_experts: int = 0
     moe_every: int = 2
     moe_top_k: int = 2
+    # dropless MoE: every expert runs every token (num_experts× FFN
+    # FLOPs, zero dropped tokens); capacity dispatch is the at-scale
+    # default — see parallel/moe.py
+    moe_dropless: bool = False
 
     @property
     def head_dim(self) -> int:
@@ -471,6 +475,7 @@ class Block(nn.Module):
             ff, aux = MoeMlp(
                 num_experts=cfg.num_experts, top_k=cfg.moe_top_k,
                 embed_dim=cfg.embed_dim, mlp_dim=cfg.mlp_dim,
+                dropless=cfg.moe_dropless,
                 dtype=cfg.dtype, name="moe")(y)
             self.sow("intermediates", "moe_aux_loss", aux)
         else:

@@ -37,7 +37,21 @@ class MoeMlp(nn.Module):
     mlp_dim: int
     top_k: int = 2
     capacity_factor: float = 1.25
+    # dropless=True: every expert runs every token and the top-k gates
+    # weight the combine — NO token is ever dropped, shapes stay static.
+    # Costs num_experts× the FFN FLOPs of capacity dispatch, so it's the
+    # small-expert-count / quality-first mode; capacity dispatch remains
+    # the at-scale default (its drop rate is sown as an intermediate,
+    # "moe_drop_rate", so imbalance is observable instead of silent).
+    dropless: bool = False
     dtype: Any = jnp.bfloat16
+
+    def _sow_drop_rate(self, rate) -> None:
+        # "diagnostics", NOT "intermediates": LMTrainer folds every
+        # intermediates leaf into the loss as MoE aux (lm_trainer._loss_fn)
+        # — a metric there would silently bias the reported objective.
+        # Consumers opt in with mutable=["diagnostics"].
+        self.sow("diagnostics", "moe_drop_rate", rate)
 
     @nn.compact
     def __call__(self, x) -> Tuple[jax.Array, jax.Array]:
@@ -65,6 +79,45 @@ class MoeMlp(nn.Module):
         gate_vals = gate_vals / jnp.maximum(
             gate_vals.sum(-1, keepdims=True), 1e-9)
 
+        # load-balancing aux loss (Switch eq. 4) — shared by both modes
+        top1 = jax.nn.one_hot(gate_idx[:, 0], e, dtype=jnp.float32)
+        aux_loss = e * jnp.sum(top1.mean(0) * probs.mean(0))
+
+        w_in = self.param(
+            "w_in",
+            nn.with_logical_partitioning(
+                kernel_init, ("expert", "embed", "expert_mlp")),
+            (e, E, self.mlp_dim), jnp.float32)
+        w_out = self.param(
+            "w_out",
+            nn.with_logical_partitioning(
+                kernel_init, ("expert", "expert_mlp", "embed")),
+            (e, self.mlp_dim, E), jnp.float32)
+
+        if self.dropless:
+            # dense execution: out_n = Σ_e gate[n,e] · FFN_e(x_n); gates
+            # are zero off the top-k, so routing semantics are identical
+            # to infinite capacity. The "expert" logical axis still
+            # shards over ep (each rank runs its experts on all tokens;
+            # the combine einsum contracts over e — GSPMD emits the
+            # psum).
+            gates_full = (
+                jax.nn.one_hot(gate_idx, e, dtype=jnp.float32)
+                * gate_vals.astype(jnp.float32)[..., None]
+            ).sum(1)                                          # [N, e]
+            h = jnp.einsum("nd,edm->enm", tokens.astype(self.dtype),
+                           w_in.astype(self.dtype))
+            h = nn.gelu(h)
+            # one fused contraction over (e, m): never materializes the
+            # [e, N, embed] per-expert outputs; f32 accumulation via
+            # preferred_element_type matches the capacity path's combine
+            out = jnp.einsum("enm,emd,ne->nd", h,
+                             w_out.astype(self.dtype),
+                             gates_full.astype(self.dtype),
+                             preferred_element_type=jnp.float32)
+            self._sow_drop_rate(jnp.zeros((), jnp.float32))
+            return out.reshape(B, S, E).astype(x.dtype), aux_loss
+
         # --- capacity assignment ------------------------------------------
         # position of each (token, choice) within its expert's queue
         onehot = jax.nn.one_hot(gate_idx, e, dtype=jnp.int32)   # [N, k, e]
@@ -72,6 +125,9 @@ class MoeMlp(nn.Module):
         pos_in_expert = jnp.cumsum(flat_choice, axis=0) * flat_choice
         pos_in_expert = (pos_in_expert.reshape(N, k, e).sum(-1) - 1)  # [N,k]
         keep = (pos_in_expert >= 0) & (pos_in_expert < capacity)
+        # observable imbalance: fraction of (token, choice) routes dropped
+        # by the capacity budget (0 under balanced load)
+        self._sow_drop_rate(1.0 - keep.astype(jnp.float32).mean())
         gate_vals = gate_vals * keep
 
         # dispatch tensor [N, e, capacity] (one-hot over expert & slot)
@@ -92,30 +148,12 @@ class MoeMlp(nn.Module):
         expert_in = jnp.einsum("nd,nec->ecd", tokens.astype(self.dtype),
                                dispatch)
 
-        w_in = self.param(
-            "w_in",
-            nn.with_logical_partitioning(
-                kernel_init, ("expert", "embed", "expert_mlp")),
-            (e, E, self.mlp_dim), jnp.float32)
-        w_out = self.param(
-            "w_out",
-            nn.with_logical_partitioning(
-                kernel_init, ("expert", "expert_mlp", "embed")),
-            (e, self.mlp_dim, E), jnp.float32)
-
         h = jnp.einsum("ecd,edm->ecm", expert_in, w_in.astype(self.dtype))
         h = nn.gelu(h)
         expert_out = jnp.einsum("ecm,emd->ecd", h, w_out.astype(self.dtype))
 
         out = jnp.einsum("ecd,nec->nd", expert_out.astype(jnp.float32),
                          combine)
-
-        # --- load-balancing aux loss (Switch eq. 4) -----------------------
-        # fraction of tokens routed to each expert (top-1 route) × mean prob
-        top1 = jax.nn.one_hot(gate_idx[:, 0], e, dtype=jnp.float32)
-        frac_tokens = top1.mean(0)
-        frac_probs = probs.mean(0)
-        aux_loss = e * jnp.sum(frac_tokens * frac_probs)
 
         return out.reshape(B, S, E).astype(x.dtype), aux_loss
 
