@@ -1,0 +1,38 @@
+#!/bin/sh
+# Discovery init step — the TPU-native analogue of the reference's
+# kubectl-delivery init container (ref cmd/kubectl-delivery/
+# deliver_kubectl.sh:17-24, which copied a kubectl binary so mpirun could
+# exec into workers). No exec transport exists here, so the useful init
+# work is DNS: StatefulSet pod records propagate asynchronously, and a
+# worker that starts before its peers resolve burns jax.distributed's own
+# connect timeout. This script blocks until every hostname in the job's
+# discovery ConfigMap resolves, so the main container starts straight
+# into a working rendezvous.
+#
+# Inputs (injected by the controller):
+#   TPU_CONFIG_PATH  — ConfigMap mount (default /etc/tpu); reads the
+#                      worker-hostnames file
+#   DISCOVERY_TIMEOUT — seconds before giving up (default 300)
+set -eu
+
+CONFIG="${TPU_CONFIG_PATH:-/etc/tpu}"
+TIMEOUT="${DISCOVERY_TIMEOUT:-300}"
+HOSTS_FILE="$CONFIG/worker-hostnames"
+
+if [ ! -f "$HOSTS_FILE" ]; then
+    echo "discovery: no $HOSTS_FILE; nothing to wait for"
+    exit 0
+fi
+
+deadline=$(( $(date +%s) + TIMEOUT ))
+for host in $(cat "$HOSTS_FILE"); do
+    until nslookup "$host" >/dev/null 2>&1; do
+        if [ "$(date +%s)" -ge "$deadline" ]; then
+            echo "discovery: $host did not resolve within ${TIMEOUT}s" >&2
+            exit 1
+        fi
+        sleep 1
+    done
+    echo "discovery: $host resolves"
+done
+echo "discovery: all workers resolvable"

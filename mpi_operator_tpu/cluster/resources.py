@@ -69,6 +69,12 @@ class Service:
     cluster_ip: str = "None"              # headless
     selector: Dict[str, str] = field(default_factory=dict)
     ports: List[int] = field(default_factory=list)
+    # Publish DNS for NOT-Ready pods. REQUIRED for the worker service:
+    # jax.distributed rendezvous (and the discovery init wait) happens
+    # BEFORE the TPU-health readiness marker exists, so worker A-records
+    # gated on Readiness would deadlock the bootstrap — the standard
+    # StatefulSet peer-discovery setting.
+    publish_not_ready_addresses: bool = False
     kind: str = "Service"
 
 

@@ -431,6 +431,8 @@ def _service_to_manifest(s: Service) -> dict:
             "clusterIP": s.cluster_ip,
             "selector": dict(s.selector),
             "ports": [{"port": p} for p in s.ports],
+            "publishNotReadyAddresses": s.publish_not_ready_addresses
+            or None,
         }),
     }
 
@@ -441,6 +443,8 @@ def _service_from_manifest(m: dict) -> Service:
         cluster_ip=spec.get("clusterIP", "None"),
         selector=dict(spec.get("selector") or {}),
         ports=[p.get("port") for p in (spec.get("ports") or [])],
+        publish_not_ready_addresses=bool(
+            spec.get("publishNotReadyAddresses", False)),
     )
 
 

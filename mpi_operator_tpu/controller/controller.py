@@ -652,6 +652,12 @@ class TPUJobController:
             selector={LABEL_GROUP: job.metadata.name,
                       "tpu_job_role": "worker"},
             ports=[COORDINATOR_PORT],
+            # rendezvous DNS must exist BEFORE pods are Ready: the
+            # TPU-health readiness marker is written only after
+            # jax.distributed.initialize, which itself needs worker-0's
+            # A-record to resolve (and the discovery init wait needs every
+            # worker's) — Ready-gated records would deadlock the bootstrap
+            publish_not_ready_addresses=True,
         )
 
     def get_or_create_launcher_service_account(self, job: TPUJob) -> ServiceAccount:
@@ -983,6 +989,10 @@ class TPUJobController:
             {"name": CONFIG_VOLUME_NAME,
              "configMap": job.metadata.name + CONFIG_SUFFIX}
         ]
+        if self.config.discovery_image:
+            template.init_containers = template.init_containers + [
+                self._discovery_init_container()
+            ]
         template.restart_policy = "Always"    # ref :1021
         if alloc.resource_type == RESOURCE_TPU:
             template.node_selector = {
@@ -1015,6 +1025,20 @@ class TPUJobController:
             ),
         )
 
+    def _discovery_init_container(self) -> Container:
+        """The discovery init step (discovery/Dockerfile, replacing the
+        reference's kubectl-delivery, ref :1106-1121): blocks until every
+        worker hostname in the ConfigMap resolves, so neither the workers'
+        rendezvous nor the launcher's status poll burns its own connect
+        timeout on cold StatefulSet DNS."""
+        return Container(
+            name="discovery",
+            image=self.config.discovery_image,
+            env={"TPU_CONFIG_PATH": CONFIG_MOUNT_PATH},
+            volume_mounts=[{"name": CONFIG_VOLUME_NAME,
+                            "mountPath": CONFIG_MOUNT_PATH}],
+        )
+
     def new_launcher(self, job: TPUJob, alloc: AllocationResult) -> Job:
         """ref: newLauncher (:1088-1236). No kubectl-delivery init container
         (ref :1106-1121) and no OMPI_MCA_* env (ref :1123-1131): the launcher
@@ -1036,7 +1060,7 @@ class TPUJobController:
         ]
         if self.config.discovery_image:
             template.init_containers = template.init_containers + [
-                Container(name="discovery", image=self.config.discovery_image)
+                self._discovery_init_container()
             ]
         if job.spec.launcher_on_master:
             # ref types.go:90-94 (launcherOnMaster — declared by the

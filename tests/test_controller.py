@@ -1124,3 +1124,24 @@ def test_multislice_indivisible_replicas_rejected_at_admission():
     with pytest.raises(InMemoryAPIServer.AdmissionError,
                        match="does not divide into 2 slices"):
         f.seed(job)
+
+
+def test_discovery_init_container_wired():
+    """--discovery-image injects the init container into WORKERS (they do
+    the DNS rendezvous) and the LAUNCHER (ref kubectl-delivery injection
+    :1106-1121), each with the ConfigMap mount its wait script reads."""
+    f = Fixture(discovery_image="tpu-discovery:latest")
+    f.seed(new_job(tpus=8))
+    f.run("default/test")
+    sts = f.api.get("StatefulSet", "default", "test" + WORKER_SUFFIX)
+    sts.status = StatefulSetStatus(ready_replicas=2, replicas=2)
+    f.api.update(sts)
+    f.run("default/test")
+    launcher = f.api.get("Job", "default", "test" + LAUNCHER_SUFFIX)
+    for tmpl in (sts.spec.template, launcher.spec.template):
+        inits = tmpl.init_containers
+        assert len(inits) == 1
+        assert inits[0].image == "tpu-discovery:latest"
+        assert inits[0].env["TPU_CONFIG_PATH"] == "/etc/tpu"
+        assert {"name": "tpu-job-config",
+                "mountPath": "/etc/tpu"} in inits[0].volume_mounts

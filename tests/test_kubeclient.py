@@ -454,6 +454,10 @@ class TestWireFormat:
         svc = reconciled.get_object("services", "default", "trainjob-worker")
         assert svc["spec"]["clusterIP"] == "None"
         assert svc["spec"]["selector"]["tpu_job_name"] == "trainjob"
+        # pod A-records must exist BEFORE Readiness (the rendezvous and
+        # the discovery init wait both run pre-Ready) — without this the
+        # TPU-health gate deadlocks against Ready-gated DNS
+        assert svc["spec"]["publishNotReadyAddresses"] is True
 
     def test_synced_event_posted_over_the_wire(self, reconciled):
         """The recorder reaches the real core-v1 Events sink (ref
