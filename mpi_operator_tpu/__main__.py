@@ -47,6 +47,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--discovery-image", default=None,
                    help="optional init-container image "
                         "(ref --kubectl-delivery-image; usually unneeded)")
+    p.add_argument("--discovery-timeout", type=int, default=300,
+                   help="seconds the discovery init step waits for worker "
+                        "DNS before failing (large multi-slice jobs on "
+                        "slow DNS may need more)")
     p.add_argument("--threadiness", type=int, default=2)
     p.add_argument("--metrics-port", type=int, default=0,
                    help="serve /metrics (Prometheus) and /healthz on this "
@@ -108,6 +112,7 @@ def main(argv=None, stop_event=None) -> int:
         enable_gang_scheduling=args.enable_gang_scheduling,
         namespace=args.namespace,
         discovery_image=args.discovery_image,
+        discovery_timeout_seconds=args.discovery_timeout,
     )
 
     stop = stop_event or threading.Event()

@@ -197,6 +197,8 @@ class ControllerConfig:
     namespace: Optional[str] = None
     # ref --kubectl-delivery-image; on TPU an optional discovery init image
     discovery_image: Optional[str] = None
+    # how long the discovery init step waits for worker DNS before failing
+    discovery_timeout_seconds: int = 300
 
 
 @dataclass
@@ -632,12 +634,28 @@ class TPUJobController:
 
     def get_or_create_worker_service(self, job: TPUJob) -> Service:
         """Headless governing Service for the worker StatefulSet — the DNS
-        backing for the hostnames published in the ConfigMap."""
+        backing for the hostnames published in the ConfigMap. Updates on
+        spec drift so fixes (e.g. publishNotReadyAddresses) reach
+        Services created by older operator versions."""
         name = job.metadata.name + WORKER_SUFFIX
+        desired = self.new_worker_service(job)
         existing = self.service_lister.try_get(job.metadata.namespace, name)
         if existing is None:
-            return self._create_or_get(self.new_worker_service(job), job)[0]
-        return self._check_ownership(existing, job)
+            existing, created = self._create_or_get(desired, job)
+            if created:
+                return existing
+        else:
+            self._check_ownership(existing, job)
+        if (existing.selector, existing.ports,
+                existing.publish_not_ready_addresses) != (
+                desired.selector, desired.ports,
+                desired.publish_not_ready_addresses):
+            existing.selector = desired.selector
+            existing.ports = desired.ports
+            existing.publish_not_ready_addresses = \
+                desired.publish_not_ready_addresses
+            return self.api.update(existing)
+        return existing
 
     def new_worker_service(self, job: TPUJob) -> Service:
         name = job.metadata.name + WORKER_SUFFIX
@@ -1034,7 +1052,9 @@ class TPUJobController:
         return Container(
             name="discovery",
             image=self.config.discovery_image,
-            env={"TPU_CONFIG_PATH": CONFIG_MOUNT_PATH},
+            env={"TPU_CONFIG_PATH": CONFIG_MOUNT_PATH,
+                 "DISCOVERY_TIMEOUT":
+                 str(self.config.discovery_timeout_seconds)},
             volume_mounts=[{"name": CONFIG_VOLUME_NAME,
                             "mountPath": CONFIG_MOUNT_PATH}],
         )

@@ -1143,5 +1143,22 @@ def test_discovery_init_container_wired():
         assert len(inits) == 1
         assert inits[0].image == "tpu-discovery:latest"
         assert inits[0].env["TPU_CONFIG_PATH"] == "/etc/tpu"
+        assert inits[0].env["DISCOVERY_TIMEOUT"] == "300"
         assert {"name": "tpu-job-config",
                 "mountPath": "/etc/tpu"} in inits[0].volume_mounts
+
+
+
+def test_worker_service_drift_repaired():
+    """Spec fixes must reach Services created by older operator versions
+    (e.g. publishNotReadyAddresses — without the repair, pre-upgrade jobs
+    stay DNS-deadlocked forever)."""
+    f = Fixture()
+    job = f.seed(new_job(tpus=8))
+    f.run("default/test")
+    svc = f.api.get("Service", "default", "test" + WORKER_SUFFIX)
+    svc.publish_not_ready_addresses = False    # pre-fix operator wrote this
+    f.api.update(svc)
+    f.run("default/test")
+    svc = f.api.get("Service", "default", "test" + WORKER_SUFFIX)
+    assert svc.publish_not_ready_addresses is True
