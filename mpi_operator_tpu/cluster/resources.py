@@ -92,6 +92,11 @@ class StatefulSetSpec:
     replicas: int = 0
     service_name: str = ""          # headless svc → stable DNS (ref :1079)
     pod_management_policy: str = "Parallel"   # ref :1074
+    # OnDelete for workers: the default RollingUpdate replaces one pod at
+    # a time gated on Ready, but Ready needs a FULL-WORLD rendezvous —
+    # a one-at-a-time roll deadlocks. The controller instead deletes the
+    # gang explicitly after a template change (resize semantics).
+    update_strategy: str = "RollingUpdate"
     template: PodTemplateSpec = field(default_factory=PodTemplateSpec)
 
 

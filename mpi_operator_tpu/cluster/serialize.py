@@ -368,6 +368,7 @@ def _statefulset_to_manifest(s: StatefulSet) -> dict:
             "replicas": s.spec.replicas,
             "serviceName": s.spec.service_name,
             "podManagementPolicy": s.spec.pod_management_policy,
+            "updateStrategy": {"type": s.spec.update_strategy},
             "selector": {"matchLabels":
                          dict(s.spec.template.metadata.labels)},
             "template": template_to_manifest(s.spec.template),
@@ -383,6 +384,8 @@ def _statefulset_from_manifest(m: dict) -> StatefulSet:
             replicas=int(spec.get("replicas", 0)),
             service_name=spec.get("serviceName", ""),
             pod_management_policy=spec.get("podManagementPolicy", "Parallel"),
+            update_strategy=(spec.get("updateStrategy") or {}).get(
+                "type", "RollingUpdate"),
             template=template_from_manifest(spec.get("template") or {}),
         ),
         status=StatefulSetStatus(

@@ -84,9 +84,61 @@ READINESS_FILE_PATH = "/tmp/tpu-ready"
 # opt-out for worker images that don't call mpi_operator_tpu.bootstrap
 # (they'd never write the marker and would sit NotReady forever)
 ANNOTATION_HEALTH_GATE = "tpu.kubeflow.org/health-gate"
+# hash of the worker template whose pods have actually been (re)started —
+# recorded ON the StatefulSet so the resize gang-restart is level-triggered
+# and survives operator restarts (see get_or_create_worker_statefulsets)
+ANNOTATION_TEMPLATE_HASH = "tpu.kubeflow.org/template-hash"
+
+
+def _template_hash(template) -> str:
+    import hashlib
+    import json as _json
+
+    from ..cluster.serialize import template_to_manifest
+
+    return hashlib.sha1(_json.dumps(
+        template_to_manifest(template), sort_keys=True).encode()
+    ).hexdigest()[:12]
 
 ERR_RESOURCE_EXISTS = "ErrResourceExists"   # ref :88-96
 MSG_RESOURCE_EXISTS = "Resource %s already exists and is not managed by TPUJob"
+
+
+def _probe_subset(desired: Optional[dict], existing: Optional[dict]) -> bool:
+    """True when every key the controller set in the desired probe matches
+    the live one (the server adds defaults like successThreshold)."""
+    if desired is None:
+        return True
+    if existing is None:
+        return False
+    return all(existing.get(k) == v for k, v in desired.items())
+
+
+def _worker_template_drifted(existing, desired) -> bool:
+    """Compare ONLY the template fields the controller owns. A real API
+    server decorates live objects with defaults (probe timeoutSeconds,
+    volume defaultMode, ...), so whole-object equality would report drift
+    on every sync of every job and churn updates forever. Fields the
+    server never defaults (env, labels, nodeSelector) compare EXACTLY —
+    subset checks would miss user-removed keys."""
+    try:
+        ec, dc = existing.main_container(), desired.main_container()
+    except ValueError:
+        return True
+    if (ec.image, ec.command, ec.args) != (dc.image, dc.command, dc.args):
+        return True
+    if ec.env != dc.env or ec.limits != dc.limits:
+        return True
+    if not _probe_subset(dc.readiness_probe, ec.readiness_probe):
+        return True
+    if [(c.image, c.env) for c in existing.init_containers] != \
+            [(c.image, c.env) for c in desired.init_containers]:
+        return True
+    if existing.node_selector != desired.node_selector:
+        return True
+    if existing.metadata.labels != desired.metadata.labels:
+        return True
+    return existing.restart_policy != desired.restart_policy
 
 
 class ForeignOwnershipError(Exception):
@@ -442,7 +494,19 @@ class TPUJobController:
             if self.config.enable_gang_scheduling or job.spec.gang_scheduling:
                 self.get_or_create_pdb(job, alloc.worker_replicas)  # ref :490-494
 
-        workers = self.get_or_create_worker_statefulsets(job, alloc)  # ref :497
+        workers, resized = self.get_or_create_worker_statefulsets(
+            job, alloc)                                            # ref :497
+
+        if resized and launcher is not None and not done:
+            # the running launcher carries the OLD topology env (batch Job
+            # pod templates are immutable); replace it OUTSIDE the failure
+            # path so the resize burns no restart budget and can't
+            # terminally fail a restart_policy=Never job — the readiness
+            # gate below recreates it with the new env once the restarted
+            # gang is Ready
+            self.api.delete("Job", launcher.metadata.namespace,
+                            launcher.metadata.name)
+            launcher = None
 
         # THE GATE: launcher starts only once ALL workers of ALL slices
         # report Ready (ref :503-509). On TPU this is also the
@@ -456,7 +520,12 @@ class TPUJobController:
             all(w is not None for w in workers)
             and total_ready == alloc.worker_replicas
         ) or alloc.worker_replicas == 0
-        if not done and workers_ready and launcher is None:
+        # `not resized`: in the resize sync itself the StatefulSet status
+        # still shows the PRE-deletion ready counts (same-size template
+        # edits included) — creating a launcher now would rendezvous
+        # against a gang that was just deleted. The next sync sees the
+        # true readiness and recreates it with the new env.
+        if not done and workers_ready and launcher is None and not resized:
             launcher, _ = self._create_or_get(self.new_launcher(job, alloc),
                                               job)
 
@@ -732,17 +801,21 @@ class TPUJobController:
 
     def get_or_create_worker_statefulsets(
         self, job: TPUJob, alloc: AllocationResult
-    ) -> List[Optional[StatefulSet]]:
+    ) -> Tuple[List[Optional[StatefulSet]], bool]:
         """ref: getOrCreateWorkerStatefulSet (:726-759): create if missing and
         workers>0; update on replica drift (incl. scale-down-to-0 on done).
         Multi-slice: one StatefulSet PER SLICE (`<job>-worker-s<k>`), each
         sized workers_per_slice — the controller actually places slices,
-        instead of flattening them into one pool (VERDICT r02 missing #2)."""
+        instead of flattening them into one pool (VERDICT r02 missing #2).
+        Returns (groups, resized) — resized means the worker TOPOLOGY
+        changed this sync (template reconciled or a slice group pruned)
+        and the gang was restarted onto it."""
         out: List[Optional[StatefulSet]] = []
         per_group = (alloc.workers_per_slice if alloc.worker_replicas > 0
                      else 0)
-        for slice_id, name in enumerate(
-                self.worker_group_names(job, alloc.num_slices)):
+        group_names = self.worker_group_names(job, alloc.num_slices)
+        stale_groups: List[StatefulSet] = []    # need a gang restart
+        for slice_id, name in enumerate(group_names):
             existing = self.statefulset_lister.try_get(
                 job.metadata.namespace, name)
             if existing is None:
@@ -756,11 +829,72 @@ class TPUJobController:
                     continue
             else:
                 self._check_ownership(existing, job)
+            changed = False
             if existing.spec.replicas != per_group:            # ref :748-756
                 existing.spec.replicas = per_group
+                changed = True
+            # The reference reconciles only the replica count; a resized
+            # spec (tpus 8→16) or an edited template would leave the
+            # remaining pods on STALE env (TPU_NUM_PROCESSES, hostnames)
+            # — inconsistent with the updated ConfigMap and a broken
+            # rendezvous after the gang restart. Drift is judged on the
+            # fields the controller OWNS (a real API server defaults
+            # extra fields; whole-object equality would churn forever).
+            if per_group > 0:
+                desired = self.new_worker(job, alloc, slice_id=slice_id)
+                if _worker_template_drifted(existing.spec.template,
+                                            desired.spec.template):
+                    existing.spec.template = desired.spec.template
+                    changed = True
+                # LEVEL-TRIGGERED restart signal: the template-hash
+                # annotation records which template the pods were last
+                # (re)started on. It only advances after the gang
+                # deletion SUCCEEDS, so a failed deletion is retried on
+                # every later sync (and survives operator restarts) —
+                # under OnDelete nothing else would ever replace the
+                # stale pods.
+                if existing.metadata.annotations.get(
+                        ANNOTATION_TEMPLATE_HASH) != _template_hash(
+                        desired.spec.template):
+                    stale_groups.append(existing)
+            if changed:
                 existing = self.api.update(existing)
+                if stale_groups and stale_groups[-1].metadata.name \
+                        == existing.metadata.name:
+                    stale_groups[-1] = existing     # carry the fresh RV
             out.append(existing)
-        return out
+        # prune slice groups a numSlices change orphaned (their stale-
+        # topology pods would keep matching the shared Service selector
+        # and dial the new coordinator with the old world size)
+        pruned = False
+        keep = set(group_names)
+        for sts in self.statefulset_lister.list(job.metadata.namespace):
+            if (sts.metadata.name not in keep
+                    and is_controlled_by(sts.metadata, job.metadata)
+                    and sts.metadata.labels.get(LABEL_GROUP)
+                    == job.metadata.name):
+                self.api.delete("StatefulSet", sts.metadata.namespace,
+                                sts.metadata.name)
+                pruned = True
+        resized = pruned or bool(stale_groups)
+        if resized:
+            # OnDelete update strategy (new_worker): the StatefulSet will
+            # NOT roll pods itself — and a Ready-gated roll would deadlock
+            # on the full-world rendezvous anyway. Delete the whole worker
+            # gang explicitly; kubelet recreates every pod on the new
+            # template simultaneously (Parallel policy) and the run
+            # resumes from the latest checkpoint. Only a SUCCESSFUL
+            # deletion advances the hash annotations.
+            if self._delete_worker_pods(job):
+                for sts in stale_groups:
+                    sts.metadata.annotations[ANNOTATION_TEMPLATE_HASH] = \
+                        _template_hash(sts.spec.template)
+                    self.api.update(sts)
+            self.recorder.event(
+                job, "Normal", "TPUJobResized",
+                "worker topology changed; gang restarted on the new "
+                "template")
+        return out, resized
 
     # ------------------------------------------------------------------
     # resource constructors (ref newConfigMap etc. :849-1236)
@@ -1025,11 +1159,16 @@ class TPUJobController:
         }
         if alloc.num_slices > 1:
             template.metadata.labels["tpu_job_slice"] = str(slice_id)
+        # the template-hash annotation marks which template the pods were
+        # last started on (fresh sets: this one); the resize gang-restart
+        # triggers whenever it trails the desired template
         return StatefulSet(
             metadata=ObjectMeta(
                 name=name,
                 namespace=job.metadata.namespace,
                 labels={LABEL_GROUP: job.metadata.name},
+                annotations={
+                    ANNOTATION_TEMPLATE_HASH: _template_hash(template)},
                 owner_references=[job.controller_owner_reference()],
             ),
             spec=StatefulSetSpec(
@@ -1039,9 +1178,33 @@ class TPUJobController:
                 # stable DNS (ref :1079) without per-slice Services
                 service_name=job.metadata.name + WORKER_SUFFIX,
                 pod_management_policy="Parallel",       # ref :1074
+                # resize = explicit gang restart, never a Ready-gated
+                # one-at-a-time roll (which deadlocks on the full-world
+                # rendezvous); see get_or_create_worker_statefulsets
+                update_strategy="OnDelete",
                 template=template,
             ),
         )
+
+    def _delete_worker_pods(self, job: TPUJob) -> bool:
+        """Gang-delete this job's worker pods (resize semantics: all pods
+        must restart together onto the new template — OnDelete strategy,
+        see get_or_create_worker_statefulsets). Returns success; a False
+        return leaves the template-hash annotations stale so the caller
+        RETRIES on the next sync (under OnDelete nothing else would ever
+        replace the old pods)."""
+        try:
+            pods = self.api.list(
+                "Pod", job.metadata.namespace,
+                label_selector=f"{LABEL_GROUP}={job.metadata.name},"
+                               f"tpu_job_role=worker")
+            for pod in pods:
+                self.api.delete("Pod", pod.metadata.namespace,
+                                pod.metadata.name)
+            return True
+        except Exception as exc:  # noqa: BLE001
+            logger.warning("gang pod deletion failed (will retry): %s", exc)
+            return False
 
     def _discovery_init_container(self) -> Container:
         """The discovery init step (discovery/Dockerfile, replacing the

@@ -1162,3 +1162,195 @@ def test_worker_service_drift_repaired():
     f.run("default/test")
     svc = f.api.get("Service", "default", "test" + WORKER_SUFFIX)
     assert svc.publish_not_ready_addresses is True
+
+
+def test_resize_reconciles_worker_env_and_topology():
+    """tpus 8→16 mid-run: the reference only fixes the replica count
+    (:748-756), leaving surviving pods on stale TPU_NUM_PROCESSES/
+    hostnames — a broken rendezvous after the gang restart. The full
+    template reconciles, so the StatefulSet rolls every worker onto the
+    new topology (checkpoint resume carries the run over)."""
+    f = Fixture()
+    f.seed(new_job(tpus=8))
+    f.run("default/test")
+    sts = f.api.get("StatefulSet", "default", "test" + WORKER_SUFFIX)
+    assert sts.spec.replicas == 2
+    assert sts.spec.template.main_container().env["TPU_NUM_PROCESSES"] == "2"
+
+    job = f.api.get(api.KIND, "default", "test")
+    job.spec.tpus = 16
+    f.api.update(job)
+    f.run("default/test")
+    sts = f.api.get("StatefulSet", "default", "test" + WORKER_SUFFIX)
+    assert sts.spec.replicas == 4
+    env = sts.spec.template.main_container().env
+    assert env["TPU_NUM_PROCESSES"] == "4"
+    assert env["TPU_WORKER_HOSTNAMES"].count(",") == 3     # 4 workers
+    cm = f.api.get("ConfigMap", "default", "test" + CONFIG_SUFFIX)
+    assert cm.data["num-processes"] == "4"                 # consistent
+
+
+def test_template_edit_propagates_to_workers():
+    """User edits the pod template image: the worker StatefulSet follows
+    (the reference never reconciles templates at all)."""
+    f = Fixture()
+    f.seed(new_job(tpus=8))
+    f.run("default/test")
+    job = f.api.get(api.KIND, "default", "test")
+    job.spec.template.main_container().image = "tpu-bench:v2"
+    f.api.update(job)
+    f.run("default/test")
+    sts = f.api.get("StatefulSet", "default", "test" + WORKER_SUFFIX)
+    assert sts.spec.template.main_container().image == "tpu-bench:v2"
+
+
+def test_stable_spec_causes_no_update_churn():
+    """Template reconciliation must be change-driven: an unchanged spec
+    re-synced twice emits NO StatefulSet update actions (level-triggered
+    idempotence, ref test style :533-562)."""
+    f = Fixture()
+    f.seed(new_job(tpus=8))
+    f.run("default/test")
+    actions = f.run("default/test")       # second sync, nothing changed
+    assert ("update", "StatefulSet") not in verbs(actions)
+
+
+def test_resize_replaces_launcher_without_burning_restart_budget():
+    """A running launcher carries the old-topology env (Job pod templates
+    are immutable): resize must replace it OUTSIDE the failure path — no
+    restart_count bump, no terminal failure under restart_policy=Never —
+    and the readiness gate recreates it with the new env."""
+    f = Fixture()
+    f.seed(new_job(tpus=8))
+    f.run("default/test")
+    _seed_ready_workers(f, "test" + WORKER_SUFFIX, 2)
+    f.run("default/test")
+    launcher = f.api.get("Job", "default", "test" + LAUNCHER_SUFFIX)
+    assert launcher.spec.template.main_container().env[
+        "TPU_NUM_PROCESSES"] == "2"
+
+    job = f.api.get(api.KIND, "default", "test")
+    job.spec.tpus = 16
+    f.api.update(job)
+    f.run("default/test")
+    # old launcher deleted, none recreated yet (workers not Ready at the
+    # new size), and the restart budget untouched
+    from mpi_operator_tpu.cluster.apiserver import NotFoundError
+    with pytest.raises(NotFoundError):
+        f.api.get("Job", "default", "test" + LAUNCHER_SUFFIX)
+    st = f.api.get(api.KIND, "default", "test").status
+    assert st.restart_count == 0
+    assert st.get_condition(api.COND_FAILED) is None
+    # gang comes up at the new size → launcher recreated with new env
+    _seed_ready_workers(f, "test" + WORKER_SUFFIX, 4)
+    f.run("default/test")
+    launcher = f.api.get("Job", "default", "test" + LAUNCHER_SUFFIX)
+    assert launcher.spec.template.main_container().env[
+        "TPU_NUM_PROCESSES"] == "4"
+    assert any(e.reason == "TPUJobResized"
+               for e in f.controller.recorder.events)
+
+
+def _seed_ready_workers(f, name, n):
+    sts = f.api.get("StatefulSet", "default", name)
+    sts.status = StatefulSetStatus(ready_replicas=n, replicas=n)
+    f.api.update(sts)
+
+
+def test_numslices_downsize_prunes_orphaned_groups():
+    """numSlices 2→1: the old per-slice groups must be deleted — their
+    stale-topology pods would keep matching the shared Service selector
+    and dial the new coordinator with the old world size."""
+    f = Fixture()
+    job = new_job(name="ms2", tpus=16)
+    job.spec.num_slices = 2
+    job.spec.slice_topology = "2x4"
+    f.seed(job)
+    f.run("default/ms2")
+    f.api.get("StatefulSet", "default", "ms2-worker-s0")
+    f.api.get("StatefulSet", "default", "ms2-worker-s1")
+
+    job = f.api.get(api.KIND, "default", "ms2")
+    job.spec.num_slices = 1
+    job.spec.slice_topology = "4x4"
+    f.api.update(job)
+    f.run("default/ms2")
+    from mpi_operator_tpu.cluster.apiserver import NotFoundError
+    sts = f.api.get("StatefulSet", "default", "ms2-worker")   # flat group
+    assert sts.spec.replicas == 4
+    with pytest.raises(NotFoundError):
+        f.api.get("StatefulSet", "default", "ms2-worker-s0")
+    with pytest.raises(NotFoundError):
+        f.api.get("StatefulSet", "default", "ms2-worker-s1")
+
+
+def test_resize_gang_deletes_worker_pods():
+    """OnDelete update strategy: the controller must delete the worker
+    pods itself after a template change, or nothing ever restarts them
+    onto the new topology."""
+    f = Fixture()
+    f.seed(new_job(tpus=8))
+    f.run("default/test")
+    sts = f.api.get("StatefulSet", "default", "test" + WORKER_SUFFIX)
+    assert sts.spec.update_strategy == "OnDelete"
+    f.seed(_worker_pod("test-worker-0"))
+    f.seed(_worker_pod("test-worker-1"))
+    job = f.api.get(api.KIND, "default", "test")
+    job.spec.tpus = 16
+    f.api.update(job)
+    f.run("default/test")
+    assert f.api.list("Pod", "default",
+                      label_selector="tpu_job_name=test") == []
+
+
+def test_template_edit_defers_launcher_until_gang_restarts():
+    """Same-world-size template edit: the StatefulSet status still shows
+    the PRE-deletion ready count during the resize sync — the launcher
+    must NOT be recreated against a gang that was just deleted."""
+    f = Fixture()
+    f.seed(new_job(tpus=8))
+    f.run("default/test")
+    _seed_ready_workers(f, "test" + WORKER_SUFFIX, 2)
+    f.run("default/test")
+    f.api.get("Job", "default", "test" + LAUNCHER_SUFFIX)   # running
+
+    job = f.api.get(api.KIND, "default", "test")
+    job.spec.template.main_container().image = "tpu-bench:v2"
+    f.api.update(job)
+    f.run("default/test")       # resize sync: ready counts are stale lies
+    from mpi_operator_tpu.cluster.apiserver import NotFoundError
+    with pytest.raises(NotFoundError):
+        f.api.get("Job", "default", "test" + LAUNCHER_SUFFIX)
+    # next sync with the gang genuinely Ready → launcher reborn on v2
+    _seed_ready_workers(f, "test" + WORKER_SUFFIX, 2)
+    f.run("default/test")
+    launcher = f.api.get("Job", "default", "test" + LAUNCHER_SUFFIX)
+    assert launcher.spec.template.main_container().image == "tpu-bench:v2"
+
+
+def test_failed_gang_deletion_is_retried():
+    """The restart signal is level-triggered: a failed pod deletion must
+    leave the template-hash annotation stale so a LATER sync retries —
+    under OnDelete nothing else ever replaces the old pods."""
+    f = Fixture()
+    f.seed(new_job(tpus=8))
+    f.run("default/test")
+    f.seed(_worker_pod("test-worker-0"))
+    f.seed(_worker_pod("test-worker-1"))
+    job = f.api.get(api.KIND, "default", "test")
+    job.spec.template.main_container().image = "tpu-bench:v2"
+    f.api.update(job)
+
+    real_list = f.api.list
+    def broken_list(kind, *a, **k):
+        if kind == "Pod":
+            raise RuntimeError("transient apiserver hiccup")
+        return real_list(kind, *a, **k)
+    f.api.list = broken_list
+    f.run("default/test")                    # deletion fails, logged
+    f.api.list = real_list
+    assert f.api.list("Pod", "default",
+                      label_selector="tpu_job_name=test") != []
+    f.run("default/test")                    # retried and succeeds
+    assert f.api.list("Pod", "default",
+                      label_selector="tpu_job_name=test") == []
