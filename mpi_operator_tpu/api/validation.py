@@ -105,24 +105,48 @@ def validate_spec(spec: TPUJobSpec,
         # limit is absent (mpi_job_controller.go:587-593) and the job then
         # fails at runtime; we reject at admission instead — "fail at
         # admission, not at runtime" (documented divergence).
+        # applies to EVERY effective resource type (cpu included): a
+        # missing limit silently allocates zero units per worker whatever
+        # the type, the exact runtime failure this check exists to prevent
         rtype = spec.processing_resource_type or default_resource_type
-        if rtype == RESOURCE_TPU:
-            if not spec.template.containers:
-                errs.append(
-                    "spec.replicas mode requires a worker container with a "
-                    f"{rtype!r} resource limit; the pod template has no "
-                    "containers"
-                )
-            elif not spec.template.main_container().limits.get(rtype, 0):
-                errs.append(
-                    f"spec.replicas mode requires a {rtype!r} resource "
-                    f"limit on the worker container (each worker would "
-                    f"otherwise get zero chips; ref mpi_job_controller.go"
-                    f":587-593 allocates 0 silently — rejected here)"
-                )
+        if not spec.template.containers:
+            errs.append(
+                "spec.replicas mode requires a worker container with a "
+                f"{rtype!r} resource limit; the pod template has no "
+                "containers"
+            )
+        elif not spec.template.main_container().limits.get(rtype, 0):
+            errs.append(
+                f"spec.replicas mode requires a {rtype!r} resource "
+                f"limit on the worker container (each worker would "
+                f"otherwise get zero chips; ref mpi_job_controller.go"
+                f":587-593 allocates 0 silently — rejected here)"
+            )
 
     if spec.tpus_per_worker is not None and spec.tpus_per_worker < 1:
         errs.append(f"spec.tpusPerWorker must be >= 1, got {spec.tpus_per_worker}")
+
+    if (spec.processing_units_per_worker is not None
+            and spec.processing_units_per_worker < 1):
+        errs.append(
+            f"spec.processingUnitsPerWorker must be >= 1, got "
+            f"{spec.processing_units_per_worker}"
+        )
+
+    # Mode A divisibility with an EXPLICIT per-worker count is checkable at
+    # admission (mirrors the new CRD CEL rules; the flag-default case stays
+    # a controller backstop that converges to Failed/InvalidTPUJobSpec)
+    for total, per, fname in (
+        (spec.tpus, spec.tpus_per_worker, "tpus"),
+        (spec.processing_units, spec.processing_units_per_worker,
+         "processingUnits"),
+    ):
+        if (total is not None and per is not None and per >= 1
+                and total >= per and total % per):
+            errs.append(
+                f"spec.{fname}={total} must be a multiple of the per-worker "
+                f"count ({per}) — ref mpi_job_controller.go:580"
+            )
 
     if (
         spec.processing_resource_type is not None

@@ -405,8 +405,14 @@ class KubeAPIServer:
 
     def delete(self, kind: str, namespace: str, name: str) -> None:
         path = _resource_path(kind, namespace, name)
+        # propagationPolicy=Background: batch/v1 Job deletes default to
+        # ORPHANING dependents on a real API server, so the resize path's
+        # launcher-Job delete would leave the old launcher pod running with
+        # the stale topology env while the new launcher is created
+        body = {"kind": "DeleteOptions", "apiVersion": "v1",
+                "propagationPolicy": "Background"}
         try:
-            self._request("DELETE", path)
+            self._request("DELETE", path, body=body)
         except NotFoundError:
             raise NotFoundError(kind, f"{namespace}/{name}") from None
 

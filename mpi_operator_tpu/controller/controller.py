@@ -187,6 +187,11 @@ class EventRecorder:
         # name uniqueness within this process — time.time() microseconds
         # alone can collide for two events in the same sync
         self._seq = itertools.count()
+        # the recorder is shared across threadiness>1 sync workers; the
+        # correlator get-then-update and the count bump are read-modify-
+        # write, so unguarded concurrent syncs could duplicate Events or
+        # lose increments
+        self._lock = threading.Lock()
 
     def event(self, obj, etype: str, reason: str, message: str) -> None:
         self.events.append(Event(etype, reason, message))
@@ -198,6 +203,10 @@ class EventRecorder:
             logger.warning("event sink post failed: %s", exc)
 
     def _post(self, obj, etype: str, reason: str, message: str) -> None:
+        with self._lock:
+            self._post_locked(obj, etype, reason, message)
+
+    def _post_locked(self, obj, etype: str, reason: str, message: str) -> None:
         from ..cluster.resources import Event as CoreEvent, ObjectReference
 
         ns = obj.metadata.namespace
@@ -442,10 +451,20 @@ class TPUJobController:
         launcher = self.get_launcher_job(job)                  # ref :440, :522-544
 
         # terminal state persists in conditions — the launcher Job object
-        # may be gone afterwards (CleanPodPolicy "All")
+        # may be gone afterwards (CleanPodPolicy "All").
+        # Failed/InvalidTPUJobSpec is deliberately NOT terminal: it's a
+        # level-triggered "desired state is unsatisfiable" signal that
+        # clears itself the moment the user fixes the spec (the reference
+        # recovered here too, by retrying forever).
+        failed_cond = job.status.get_condition(api.COND_FAILED)
+        invalid_spec = (
+            failed_cond is not None and failed_cond.status == "True"
+            and failed_cond.reason == "InvalidTPUJobSpec"
+        )
         terminal = (
             job.status.get_condition(api.COND_SUCCEEDED) is not None
-            or job.status.get_condition(api.COND_FAILED) is not None
+            or (failed_cond is not None and failed_cond.status == "True"
+                and not invalid_spec)
         )
 
         # gang restart (v1alpha2 RestartPolicy, common_types.go:131-156):
@@ -480,7 +499,27 @@ class TPUJobController:
         # (v1alpha2 types.go:55-66); "Running"/"All" scale it to 0 (the
         # v1alpha1 behavior, ref :594-596)
         scale_down = done and job.spec.clean_pod_policy != "None"
-        alloc = self.allocate_processing_units(job, scale_down)  # ref :462, :547-598
+        try:
+            alloc = self.allocate_processing_units(job, scale_down)  # ref :462, :547-598
+        except ValueError as exc:
+            # an invalid spec that slipped past admission (a real cluster
+            # only enforces the CRD-schema subset of api/validation.py)
+            # must converge to a Failed/InvalidTPUJobSpec condition in one
+            # sync — not requeue forever with no user-visible signal.
+            # Returning (instead of raising) makes process_next_work_item
+            # forget the key; the Warning Event + condition tell the user
+            # why nothing is running.
+            self._fail_invalid_spec(job, str(exc), launcher)
+            return
+        if invalid_spec and not done:
+            # the spec is allocatable again (user fixed it): clear the
+            # InvalidTPUJobSpec signal and reconcile normally
+            job.status.set_condition(api.JobCondition(
+                api.COND_FAILED, "False", "SpecValidated",
+                "spec is valid again; resuming reconciliation"))
+            job = self.api.update_status(job)
+            self.recorder.event(job, "Normal", "SpecValidated",
+                                "spec is valid again")
 
         if not done:
             self.get_or_create_config_map(job, alloc)          # ref :470
@@ -541,6 +580,45 @@ class TPUJobController:
                             launcher.metadata.name)
 
         self.recorder.event(job, "Normal", "Synced", "TPUJob synced successfully")
+
+    def _fail_invalid_spec(self, job: TPUJob, message: str,
+                           launcher: Optional[Job] = None) -> None:
+        """InvalidSpec convergence. The reference hot-loops here:
+        allocateProcessingUnits error → syncHandler error → rate-limited
+        requeue forever (mpi_job_controller.go:462-466 + :399-404) with
+        nothing in status explaining why no pods appear. We record a
+        Failed/InvalidTPUJobSpec condition + Warning Event and let the
+        queue forget the key. Idempotent per MESSAGE: a spec re-broken a
+        different way refreshes the condition instead of freezing the
+        first failure text. A RUNNING job edited into an invalid spec
+        also tears its gang down (launcher deleted, workers scaled to 0)
+        — desired state is unsatisfiable, so leaving chips burning behind
+        a Failed status would be the worst of both."""
+        existing = job.status.get_condition(COND_FAILED)
+        fresh = not (existing is not None and existing.status == "True"
+                     and existing.reason == "InvalidTPUJobSpec"
+                     and existing.message == message)
+        if fresh:
+            job.status.set_condition(api.JobCondition(
+                COND_FAILED, "True", "InvalidTPUJobSpec", message))
+            job = self.api.update_status(job)
+            self.recorder.event(job, "Warning", "InvalidTPUJobSpec",
+                                message)
+        if job.spec.clean_pod_policy == "None":
+            return
+        if launcher is not None:
+            try:
+                self.api.delete("Job", launcher.metadata.namespace,
+                                launcher.metadata.name)
+            except NotFoundError:
+                pass
+        for sts in self.statefulset_lister.list(job.metadata.namespace):
+            if (is_controlled_by(sts.metadata, job.metadata)
+                    and sts.metadata.labels.get(LABEL_GROUP)
+                    == job.metadata.name
+                    and sts.spec.replicas != 0):
+                sts.spec.replicas = 0
+                self.api.update(sts)
 
     # ------------------------------------------------------------------
     # gang-restart decision (v1alpha2 RestartPolicy, common_types.go:131-156)
@@ -614,7 +692,17 @@ class TPUJobController:
             total = per_worker = None
 
         if total is not None:
-            # Mode A (ref :573-582)
+            # Mode A (ref :573-582). Guard BEFORE dividing: a zero/negative
+            # per-worker (possible via the operator FLAG, which admission
+            # never sees) must surface as the ValueError the invalid-spec
+            # path converges on — not a ZeroDivisionError that requeues
+            # forever
+            if per_worker is None or per_worker < 1:
+                raise ValueError(
+                    f"per-worker processing-unit count must be >= 1, got "
+                    f"{per_worker} (check --tpus-per-worker / "
+                    f"--processing-units-per-worker or the spec overrides)"
+                )
             if total < per_worker:
                 workers = 1          # total < perNode → 1 worker with all units
                 units = total
@@ -890,10 +978,19 @@ class TPUJobController:
                     sts.metadata.annotations[ANNOTATION_TEMPLATE_HASH] = \
                         _template_hash(sts.spec.template)
                     self.api.update(sts)
-            self.recorder.event(
-                job, "Normal", "TPUJobResized",
-                "worker topology changed; gang restarted on the new "
-                "template")
+                self.recorder.event(
+                    job, "Normal", "TPUJobResized",
+                    "worker topology changed; gang restarted on the new "
+                    "template")
+            else:
+                # the restart did NOT happen this sync — the stale hash
+                # annotations make the next sync retry; say so instead of
+                # claiming success (a misleading Normal event here is the
+                # first thing a user debugging a stuck resize would read)
+                self.recorder.event(
+                    job, "Warning", "TPUJobResizeRetry",
+                    "worker topology changed but the gang pod deletion "
+                    "failed; will retry on the next sync")
         return out, resized
 
     # ------------------------------------------------------------------

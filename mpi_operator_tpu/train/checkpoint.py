@@ -48,7 +48,12 @@ _ASYNC_CKPTR: Optional[ocp.AsyncCheckpointer] = None
 # filesystems and deadlocks the ranks that enter against the ones that
 # skip). A dict (not a single slot) so interleaved saves to different
 # directories can't evict each other's record and trigger a needless
-# force-rewrite of a committed checkpoint.
+# force-rewrite of a committed checkpoint. Deliberately UNBOUNDED: one
+# (str, int) pair per distinct checkpoint directory is negligible, while
+# evicting an entry would reintroduce the force-rewrite hazard for that
+# directory (maybe_save would re-save with force=True, deleting the
+# committed copy before rewriting — a crash mid-rewrite destroys the
+# newest checkpoint).
 _LAST_SAVED: dict = {}
 
 

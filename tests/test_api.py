@@ -118,6 +118,51 @@ def test_example_manifests_validate():
         validate_spec(job.spec)   # raises on violation
 
 
+def test_crd_carries_cel_validation_rules():
+    """deploy/0-crd.yaml must enforce the api/validation.py invariants
+    SERVER-side via x-kubernetes-validations (the reference's schema-first
+    posture — ALL its sizing constraints live in the CRD schema so
+    `kubectl create` rejects bad specs, ref deploy/0-crd.yaml:16-99).
+    Real clusters never run our in-process admission for user objects."""
+    import os
+
+    import yaml
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    with open(os.path.join(repo, "deploy", "0-crd.yaml")) as f:
+        crd = yaml.safe_load(f)
+    spec_schema = crd["spec"]["versions"][0]["schema"]["openAPIV3Schema"][
+        "properties"]["spec"]
+    validations = spec_schema["x-kubernetes-validations"]
+    rules = "\n".join(v["rule"] for v in validations)
+    # every invariant family api/validation.py enforces is represented
+    assert "numSlices" in rules          # slice divisibility
+    assert "tpusPerWorker" in rules      # Mode A divisibility
+    assert "processingUnitsPerWorker" in rules
+    assert "sliceTopology" in rules      # topology-product check
+    for v in validations:
+        assert v.get("message"), f"CEL rule without a message: {v['rule']}"
+
+
+def test_mode_a_explicit_per_worker_divisibility():
+    """Explicit per-worker counts are checkable at admission (parity with
+    the CRD CEL rules); the flag-default case stays a controller backstop
+    that converges to Failed/InvalidTPUJobSpec."""
+    with pytest.raises(ValidationError, match="multiple"):
+        validate_spec(TPUJobSpec(tpus=16, tpus_per_worker=5))
+    with pytest.raises(ValidationError, match="multiple"):
+        validate_spec(TPUJobSpec(processing_units=10,
+                                 processing_units_per_worker=4))
+    validate_spec(TPUJobSpec(tpus=16, tpus_per_worker=4))
+    # total < perWorker is the legal single-worker form (ref :573-582)
+    validate_spec(TPUJobSpec(tpus=2, tpus_per_worker=8))
+    # zero/negative per-worker is rejected for BOTH mode-A fields (a zero
+    # would otherwise reach allocation's divide)
+    with pytest.raises(ValidationError, match="processingUnitsPerWorker"):
+        validate_spec(TPUJobSpec(processing_units=10,
+                                 processing_units_per_worker=0))
+
+
 def test_multislice_validation_is_per_slice():
     """Slice-shape constraints apply PER SLICE: tpus=512 over 2 slices is
     two valid v5e-256 slices; non-divisible counts fail at admission (the
@@ -150,9 +195,15 @@ def test_mode_b_zero_chip_rejected_at_admission():
     spec = TPUJobSpec(replicas=2)
     spec.template.main_container().limits = {RESOURCE_TPU: 4}
     validate_spec(spec)
-    # cpu-resource jobs carry no chips by design — not rejected
-    validate_spec(TPUJobSpec(replicas=2,
-                             processing_resource_type=RESOURCE_CPU))
+    # the check follows the EFFECTIVE resource type: Mode B sizes each
+    # worker from the matching container limit whatever the type, so a
+    # cpu-resource spec without a cpu limit is equally degenerate
+    with pytest.raises(ValidationError, match="resource limit"):
+        validate_spec(TPUJobSpec(replicas=2,
+                                 processing_resource_type=RESOURCE_CPU))
+    spec = TPUJobSpec(replicas=2, processing_resource_type=RESOURCE_CPU)
+    spec.template.main_container().limits = {RESOURCE_CPU: 2}
+    validate_spec(spec)
 
 
 def test_multislice_mode_a_per_worker_divisibility_at_admission():

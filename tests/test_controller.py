@@ -152,12 +152,127 @@ def test_single_worker_when_total_below_per_worker():
     assert sts.spec.template.main_container().limits[api.RESOURCE_TPU] == 2
 
 
-def test_indivisible_total_errors():
-    """ref: total % perNode != 0 → error (:580). 16 valid chips but
-    per-worker 5 via spec override."""
+def test_indivisible_total_converges_to_invalid_spec_failed():
+    """ref: total % perNode != 0 → error (:580). The reference requeues
+    that error forever with nothing in status; here the sync converges to
+    a terminal Failed/InvalidTPUJobSpec condition + Warning Event in ONE
+    sync. Per-worker comes from the operator FLAG (the case admission and
+    the CRD CEL rules cannot see)."""
+    f = Fixture(tpus_per_worker=5)
+    f.seed(new_job(tpus=16))
+    actions = f.run("default/test")
+    assert verbs(actions) == [("update-status", "TPUJob")]
+    job = f.api.get(api.KIND, "default", "test")
+    cond = job.status.get_condition(api.COND_FAILED)
+    assert cond is not None
+    assert cond.reason == "InvalidTPUJobSpec"
+    assert "multiple" in cond.message
+    assert any(e.type == "Warning" and e.reason == "InvalidTPUJobSpec"
+               for e in f.controller.recorder.events)
+    # second sync is a converged no-op, not a hot loop
+    assert f.run("default/test") == []
+
+
+def test_invalid_spec_bypassing_admission_forgets_key():
+    """A spec only a real API server would admit (it enforces just the
+    CRD-schema subset of api/validation.py) must not hot-loop the
+    workqueue (the reference rate-limited-requeues forever, :399-404):
+    one sync lands the Failed condition and the queue forgets the key."""
     f = Fixture()
-    f.seed(new_job(tpus=16, tpus_per_worker=5))
-    f.run("default/test", expect_error=ValueError)
+    f.api._admission.clear()        # simulate schema-only enforcement
+    job = new_job(tpus=None)
+    job.spec.replicas = 3
+    job.spec.num_slices = 2         # 3 workers % 2 slices → backstop error
+    job.spec.template.main_container().limits = {api.RESOURCE_TPU: 4}
+    f.seed(job)
+    f.controller.enqueue_tpu_job(job)
+    # drain: the status write re-enqueues once via its own watch event;
+    # the follow-up sync is a converged no-op
+    while f.controller.process_next_work_item(timeout=0.05):
+        pass
+    assert f.controller.queue.num_requeues("default/test") == 0
+    job = f.api.get(api.KIND, "default", "test")
+    cond = job.status.get_condition(api.COND_FAILED)
+    assert cond is not None
+    assert cond.reason == "InvalidTPUJobSpec"
+
+
+def test_invalid_spec_recovers_when_fixed():
+    """InvalidTPUJobSpec is level-triggered, not terminal: fixing the spec
+    clears the condition and reconciliation resumes (the reference
+    recovered here too — by retrying forever)."""
+    f = Fixture(tpus_per_worker=5)
+    f.seed(new_job(tpus=16))
+    f.run("default/test")
+    job = f.api.get(api.KIND, "default", "test")
+    assert job.status.get_condition(api.COND_FAILED).status == "True"
+    job.spec.tpus_per_worker = 4           # user fixes the spec
+    f.api.update(job)
+    actions = f.run("default/test")
+    job = f.api.get(api.KIND, "default", "test")
+    cond = job.status.get_condition(api.COND_FAILED)
+    assert cond.status == "False"
+    assert cond.reason == "SpecValidated"
+    assert ("create", "StatefulSet") in verbs(actions)
+
+
+def test_invalid_spec_message_refreshes_on_different_breakage():
+    """A spec re-broken a DIFFERENT way must refresh the condition message
+    instead of freezing the first failure text."""
+    f = Fixture(tpus_per_worker=5)
+    f.api._admission.clear()
+    f.seed(new_job(tpus=16))
+    f.run("default/test")
+    job = f.api.get(api.KIND, "default", "test")
+    first_msg = job.status.get_condition(api.COND_FAILED).message
+    job.spec.tpus = None
+    job.spec.replicas = 3
+    job.spec.num_slices = 2
+    job.spec.template.main_container().limits = {api.RESOURCE_TPU: 4}
+    f.api.update(job)
+    f.run("default/test")
+    job = f.api.get(api.KIND, "default", "test")
+    cond = job.status.get_condition(api.COND_FAILED)
+    assert cond.status == "True"
+    assert cond.message != first_msg
+    assert "numSlices" in cond.message
+
+
+def test_midrun_invalid_spec_tears_down_gang():
+    """A RUNNING job edited into an invalid spec must not strand its gang
+    burning chips behind a Failed status: the launcher is deleted and the
+    workers scale to 0 in the same sync that records the condition."""
+    f = Fixture()
+    f.api._admission.clear()
+    job = f.seed(new_job(tpus=8))
+    _seed_workers(f, job, replicas=2, ready=2)
+    f.run("default/test")                   # creates the launcher
+    assert f.api.try_get("Job", "default", "test" + LAUNCHER_SUFFIX) \
+        is not None
+    job = f.api.get(api.KIND, "default", "test")
+    job.spec.tpus = 10                      # 10 % 4 != 0 → invalid
+    f.api.update(job)
+    f.run("default/test")
+    job = f.api.get(api.KIND, "default", "test")
+    assert job.status.get_condition(api.COND_FAILED).reason == \
+        "InvalidTPUJobSpec"
+    assert f.api.try_get("Job", "default", "test" + LAUNCHER_SUFFIX) is None
+    sts = f.api.get("StatefulSet", "default", "test" + WORKER_SUFFIX)
+    assert sts.spec.replicas == 0
+
+
+def test_zero_per_worker_flag_is_invalid_spec_not_crash():
+    """--tpus-per-worker 0 (a flag admission never sees) must surface as
+    the ValueError the invalid-spec path converges on, not a
+    ZeroDivisionError that requeues forever."""
+    f = Fixture(tpus_per_worker=0)
+    f.seed(new_job(tpus=8))
+    f.run("default/test")
+    job = f.api.get(api.KIND, "default", "test")
+    cond = job.status.get_condition(api.COND_FAILED)
+    assert cond is not None
+    assert cond.reason == "InvalidTPUJobSpec"
+    assert ">= 1" in cond.message
 
 
 def test_custom_replicas_cpu():
