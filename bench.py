@@ -52,12 +52,14 @@ def main() -> None:
     parser = argparse.ArgumentParser()
     parser.add_argument("--workload", default="all",
                         choices=["all", "resnet", "gpt2", "bert", "vit",
-                                 "allreduce", "generate"],
-                        help="all = resnet headline + gpt2 secondary (the "
-                             "driver default); gpt2/bert/vit = the BASELINE "
-                             "ladder individually; allreduce = the scaling-"
-                             "efficiency microbenchmark (BASELINE ≥90% "
-                             "4→32); generate = KV-cache decode throughput")
+                                 "llama", "moe", "allreduce", "generate"],
+                        help="all = the FULL BASELINE ladder in one line "
+                             "(the driver default): resnet headline + "
+                             "gpt2/bert/llama/vit/moe/long-seq/decode "
+                             "legs; individual names run one leg; "
+                             "allreduce = the scaling-efficiency "
+                             "microbenchmark (BASELINE ≥90% 4→32); "
+                             "generate = KV-cache decode throughput")
     parser.add_argument("--model", default="resnet101")
     # resnet default 256/device is the single-chip throughput sweet spot on
     # v5e (measured: 64→1377, 128→1408, 256→1612, 512→1442 img/s); the
@@ -85,11 +87,16 @@ def main() -> None:
         args.warmup = 1
         args.image_size = 64
     if args.batch_per_device is None:
-        args.batch_per_device = 16 if args.workload in ("gpt2", "bert") else 256
+        # per-workload single-v5e sweet spots (swept on the chip)
+        args.batch_per_device = {
+            "gpt2": 16, "bert": 16, "moe": 16, "llama": 8,
+        }.get(args.workload, 256)
 
-    def run_lm(workload, steps, warmup, batch=None, seq=None):
+    def run_lm(workload, steps, warmup, batch=None, seq=None, size=None,
+               **kw):
         from mpi_operator_tpu.examples.lm_benchmark import run_lm_benchmark
-        size = "test" if args.smoke else None
+        if args.smoke:
+            size = "test"
         # measured single-v5e sweet spots (gpt2-medium): seq 2048 wants
         # batch 4 NO remat + the kernel's 1024-tile auto policy — 34.4k
         # tok/s / 42.5% MFU, up from r02's 27.1k / 33%. seq 512: batch 16
@@ -103,7 +110,9 @@ def main() -> None:
             seq_len=32 if args.smoke else (seq or 512),
             num_steps=steps, warmup_steps=warmup,
             remat=False,
-            dtype_name=args.dtype, log=lambda s: print(s, file=sys.stderr)))
+            dtype_name=args.dtype, log=lambda s: print(s, file=sys.stderr),
+            **kw))
+        del _state
         return metrics
 
     def mfu_fields(metrics):
@@ -115,21 +124,34 @@ def main() -> None:
                 metrics["tflops_per_sec_per_device"], 2)
         return out
 
-    if args.workload in ("gpt2", "bert"):
-        metrics = run_lm(args.workload, args.steps, args.warmup,
-                         args.batch_per_device)
-        print(json.dumps({
+    if args.workload in ("gpt2", "bert", "llama", "moe"):
+        if args.workload == "moe":
+            # expert-capacity MoE on one chip (ep=1): MFU + the drop rate
+            # the router's capacity dispatch actually loses
+            metrics = run_lm("gpt2", args.steps, args.warmup,
+                             batch=args.batch_per_device,
+                             size=None if args.smoke else "small",
+                             moe_experts=8)
+        else:
+            metrics = run_lm(args.workload, args.steps, args.warmup,
+                             batch=args.batch_per_device)
+        line = {
             "metric": f"{args.workload}_tokens_per_sec",
             "value": round(metrics["tokens_per_sec"], 0),
             "unit": "tokens/sec",
             "vs_baseline": 0.0,     # reference publishes no LM numbers
             **mfu_fields(metrics),
-        }))
+        }
+        if metrics.get("moe_drop_rate") is not None:
+            line["moe_drop_rate"] = round(metrics["moe_drop_rate"], 4)
+        print(json.dumps(line))
         return
-    def decode_leg(family, kv_cache_dtype=None, runs=3):
+    def decode_leg(family, kv_cache_dtype=None, runs=3, batch=None):
         """Median-of-N decode throughput with spread — the r02 numbers
         swung 2.1k-3.5k on the tunneled chip with no variance reporting
-        (VERDICT weak #2); the median + spread pins that down."""
+        (VERDICT weak #2); the median + spread pins that down. Returns
+        (median_tps, spread, mbu) — MBU is the bandwidth roofline
+        (bytes/step ÷ v5e HBM peak, VERDICT r03 weak #3)."""
         from mpi_operator_tpu.examples.lm_benchmark import (
             run_generate_benchmark)
         vals = []
@@ -142,35 +164,49 @@ def main() -> None:
                 size="test" if args.smoke else None,
                 family=family,
                 kv_cache_dtype=kv_cache_dtype,
-                batch=2 if args.smoke else 8,
+                batch=2 if args.smoke else (batch or 8),
                 prompt_len=16 if args.smoke else 128,
                 new_tokens=8 if args.smoke else 128,
                 num_iters=1 if args.smoke else 8,
                 dtype_name=args.dtype,
                 log=lambda s: print(s, file=sys.stderr)))
-            vals.append(gm["decode_tokens_per_sec"])
+            vals.append((gm["decode_tokens_per_sec"], gm.get("mbu")))
         if len(vals) > 1:
             vals = vals[1:]                    # drop the warmup run
-        vals.sort()
-        median = vals[len(vals) // 2]
-        spread = (vals[-1] - vals[0]) / median if median else 0.0
-        return round(median, 0), round(spread, 3)
+        vals.sort(key=lambda v: v[0])
+        median, med_mbu = vals[len(vals) // 2]
+        spread = ((vals[-1][0] - vals[0][0]) / median) if median else 0.0
+        return (round(median, 0), round(spread, 3),
+                round(med_mbu, 4) if med_mbu is not None else None)
+
+    def decode_fields(line, prefix, family, kv_cache_dtype=None,
+                      batch=None):
+        med, spread, mbu_val = decode_leg(family,
+                                          kv_cache_dtype=kv_cache_dtype,
+                                          batch=batch)
+        line[f"{prefix}_tokens_per_sec"] = med
+        line[f"{prefix}_spread"] = spread
+        if mbu_val is not None:
+            line[f"{prefix}_mbu"] = mbu_val
+        return med
 
     if args.workload == "generate":
-        g_med, g_spread = decode_leg("gpt2")
-        l_med, l_spread = decode_leg("llama")
-        li_med, li_spread = decode_leg("llama", kv_cache_dtype="int8")
-        print(json.dumps({
+        line = {
             "metric": "gpt2_decode_tokens_per_sec",
-            "value": g_med,
             "unit": "tokens/sec",
             "vs_baseline": 0.0,     # reference has no inference path
-            "gpt2_decode_spread": g_spread,
-            "llama_decode_tokens_per_sec": l_med,
-            "llama_decode_spread": l_spread,
-            "llama_int8kv_decode_tokens_per_sec": li_med,
-            "llama_int8kv_decode_spread": li_spread,
-        }))
+        }
+        line["value"] = decode_fields(line, "gpt2_decode", "gpt2")
+        decode_fields(line, "llama_decode", "llama")
+        decode_fields(line, "llama_int8kv_decode", "llama",
+                      kv_cache_dtype="int8")
+        # batch sweep: decode shifts from bandwidth- to compute-bound as
+        # the batch amortizes the param reads; the b32 point shows where
+        # this chip sits on that curve
+        decode_fields(line, "llama_decode_b32", "llama", batch=32)
+        decode_fields(line, "llama_int8kv_decode_b32", "llama",
+                      kv_cache_dtype="int8", batch=32)
+        print(json.dumps(line))
         return
     if args.workload == "allreduce":
         from mpi_operator_tpu.examples.allreduce_bench import (
@@ -240,50 +276,85 @@ def main() -> None:
         **mfu_fields(metrics),
     }
     if args.workload == "all":
-        # secondary line items folded into the single JSON line the driver
-        # records: the GPT-2 train ladder entry (BASELINE configs[3]) and
-        # the KV-cache decode throughput. Best-effort: a failure here
-        # (OOM on a small chip, compile error) must not discard the
-        # already-measured resnet headline number.
+        # The FULL BASELINE ladder folded into the single JSON line the
+        # driver records (VERDICT r03 next #1: anything not in the default
+        # run is effectively unmeasured). Each leg is isolated: a failure
+        # (OOM on a small chip, compile error) marks its own *_error field
+        # and must not discard the legs already measured. jax.clear_caches
+        # between legs drops the previous executables' HBM residue
+        # (measured: ~3pp MFU on the long-seq leg).
+
+        def lm_leg(prefix, **kw):
+            try:
+                jax.clear_caches()
+                m = run_lm(**kw)
+                line[f"{prefix}_tokens_per_sec"] = round(
+                    m["tokens_per_sec"], 0)
+                line.update({f"{prefix}_{k}": v
+                             for k, v in mfu_fields(m).items()})
+                if m.get("moe_drop_rate") is not None:
+                    line[f"{prefix}_drop_rate"] = round(
+                        m["moe_drop_rate"], 4)
+            except Exception as exc:  # noqa: BLE001
+                print(f"# {prefix} bench leg failed: {exc!r}",
+                      file=sys.stderr)
+                line[f"{prefix}_error"] = type(exc).__name__
+
+        steps = min(args.steps, 30)
+        warm = min(args.warmup, 3)
+        # BASELINE configs[2-4] ladder: GPT-2, BERT-large-class, llama
+        lm_leg("gpt2", workload="gpt2", steps=steps, warmup=warm)
+        lm_leg("bert", workload="bert", steps=steps, warmup=warm, batch=16)
+        lm_leg("llama_train", workload="llama", steps=steps, warmup=warm,
+               batch=8)
+        # MoE: expert-capacity dispatch on one chip — MFU + drop rate
+        lm_leg("moe", workload="gpt2",
+               size=None if args.smoke else "small",
+               steps=min(args.steps, 20), warmup=warm, batch=16,
+               moe_experts=8)
+        # long-context legs (VERDICT r02 next #5 + r03 next #1): tuned
+        # configs — no remat, the kernel's 1024-tile auto policy
+        lm_leg("gpt2_seq2048", workload="gpt2", steps=min(args.steps, 20),
+               warmup=warm, batch=4, seq=2048)
+        lm_leg("gpt2_seq4096", workload="gpt2", steps=min(args.steps, 15),
+               warmup=warm, batch=2, seq=4096)
+        # ViT-B/16 (BASELINE configs[5] single-chip point; the multi-slice
+        # variant is the dryrun's dcn leg)
         try:
-            jax.clear_caches()     # drop the resnet leg's HBM residue
-            gm = run_lm("gpt2", steps=min(args.steps, 30),
-                        warmup=min(args.warmup, 3))
-            line["gpt2_tokens_per_sec"] = round(gm["tokens_per_sec"], 0)
-            line.update({f"gpt2_{k}": v for k, v in mfu_fields(gm).items()})
-        except Exception as exc:  # noqa: BLE001
-            print(f"# gpt2 secondary bench failed: {exc!r}", file=sys.stderr)
-            line["gpt2_error"] = type(exc).__name__
-        try:
-            # long-context leg (VERDICT r02 next #5): seq 2048 at the
-            # tuned config — no remat, auto 1024 flash tiles. Drop the
-            # previous legs' compiled executables first: their HBM residue
-            # costs this leg ~3pp MFU (39.1% with residue, 42.5% clean)
             jax.clear_caches()
-            lg = run_lm("gpt2", steps=min(args.steps, 20),
-                        warmup=min(args.warmup, 3), batch=4, seq=2048)
-            line["gpt2_seq2048_tokens_per_sec"] = round(
-                lg["tokens_per_sec"], 0)
-            line.update({f"gpt2_seq2048_{k}": v
-                         for k, v in mfu_fields(lg).items()})
+            from mpi_operator_tpu.examples.lm_benchmark import (
+                run_vit_benchmark)
+            _vs, vm = retry_infra_once(lambda: run_vit_benchmark(
+                size="test" if args.smoke else "b16",
+                batch_per_device=2 if args.smoke else 256,
+                image_size=32 if args.smoke else args.image_size,
+                num_steps=steps, warmup_steps=warm,
+                dtype_name=args.dtype,
+                log=lambda s: print(s, file=sys.stderr)))
+            del _vs
+            line["vit_images_per_sec"] = round(vm["images_per_sec"], 1)
+            line.update({f"vit_{k}": v for k, v in mfu_fields(vm).items()})
         except Exception as exc:  # noqa: BLE001
-            print(f"# longseq secondary bench failed: {exc!r}",
-                  file=sys.stderr)
-            line["longseq_error"] = type(exc).__name__
-        try:
-            g_med, g_spread = decode_leg("gpt2")
-            line["gpt2_decode_tokens_per_sec"] = g_med
-            line["gpt2_decode_spread"] = g_spread
-            l_med, l_spread = decode_leg("llama")
-            line["llama_decode_tokens_per_sec"] = l_med
-            line["llama_decode_spread"] = l_spread
-            li_med, li_spread = decode_leg("llama", kv_cache_dtype="int8")
-            line["llama_int8kv_decode_tokens_per_sec"] = li_med
-            line["llama_int8kv_decode_spread"] = li_spread
-        except Exception as exc:  # noqa: BLE001
-            print(f"# decode secondary bench failed: {exc!r}",
-                  file=sys.stderr)
-            line["decode_error"] = type(exc).__name__
+            print(f"# vit bench leg failed: {exc!r}", file=sys.stderr)
+            line["vit_error"] = type(exc).__name__
+        # decode legs isolated like the lm legs: one leg's OOM/compile
+        # failure marks its own *_error field without discarding the rest
+        for prefix, dkw in (
+            ("gpt2_decode", dict(family="gpt2")),
+            ("llama_decode", dict(family="llama")),
+            ("llama_int8kv_decode",
+             dict(family="llama", kv_cache_dtype="int8")),
+            # batch sweep point: where decode leaves the bandwidth-bound
+            # regime (params amortize over the batch)
+            ("llama_decode_b32", dict(family="llama", batch=32)),
+        ):
+            try:
+                jax.clear_caches()
+                decode_fields(line, prefix, **dkw)
+            except Exception as exc:  # noqa: BLE001
+                print(f"# {prefix} bench leg failed: {exc!r}",
+                      file=sys.stderr)
+                line[f"{prefix}_error"] = type(exc).__name__
     print(json.dumps(line))
 
 

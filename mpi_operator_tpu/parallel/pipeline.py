@@ -213,11 +213,18 @@ def lm_stage_tp_specs(blocks, axis_name: str = "pp", tp_axis: str = "tp"):
     return jax.tree_util.tree_map_with_path(spec, blocks)
 
 
-def lm_stage_embed(cfg, wte, wpe, toks):
+def lm_stage_embed(cfg, wte, wpe, toks, pos_offset=None):
     """Stage-0 input embedding, shared by the GPipe and 1F1B schedules
-    (ONE definition so the pinned numerical parity can't drift)."""
+    (ONE definition so the pinned numerical parity can't drift).
+    pos_offset: traced start position of this sequence SHARD in the global
+    sequence (pp×sp: each sp rank embeds its own S/sp slice); None = the
+    shard is the whole sequence."""
     S = toks.shape[-1]
-    return wte[toks].astype(cfg.dtype) + wpe[:S][None].astype(cfg.dtype)
+    if pos_offset is None:
+        pos = wpe[:S]
+    else:
+        pos = lax.dynamic_slice_in_dim(wpe, pos_offset, S, 0)
+    return wte[toks].astype(cfg.dtype) + pos[None].astype(cfg.dtype)
 
 
 def lm_stage_head_loss(cfg, ln_f, ln_f_params, wte, y, tgt):
@@ -246,8 +253,8 @@ def stack_lm_params(params, num_layers: int):
     }
 
 
-def _lm_pipeline_local(cfg, axis_name: str, M: int, psum_axes, pp_params,
-                       tokens_local, targets_local):
+def _lm_pipeline_local(cfg, axis_name: str, M: int, psum_axes, seq_sharded,
+                       pp_params, tokens_local, targets_local):
     """Stage-sliced CausalLM forward + loss inside shard_map over pp.
 
     Each stage owns L/P consecutive blocks (lax.scan over the local layer
@@ -259,7 +266,14 @@ def _lm_pipeline_local(cfg, axis_name: str, M: int, psum_axes, pp_params,
     Returns the total cross-entropy SUM over all scored tokens, psummed
     over `psum_axes` — pp alone when the microbatch dim is replicated, pp
     plus the data axes when it is dp-sharded (pipeline_lm_loss picks); the
-    caller divides by the static global token count."""
+    caller divides by the static global token count.
+
+    pp×sp (seq_sharded=True): the stream's S dim is ALSO sharded over the
+    manual "sp" axis — each (pp, sp) device pipelines its own S/sp slice
+    of every owned microbatch; attention inside the stage body rings the
+    K/V shards over sp (cfg.attention="ring" → models._attend detects the
+    live sp axis and runs ring_attention_inner), positions offset by the
+    shard's global start, and the loss psum spans sp too."""
     from ..models.transformer import Block, _layer_norm
 
     n_stages = lax.axis_size(axis_name)
@@ -273,9 +287,10 @@ def _lm_pipeline_local(cfg, axis_name: str, M: int, psum_axes, pp_params,
     blocks = pp_params["blocks"]         # leaves [L/P, ...]
     block = Block(cfg)
     ln_f = _layer_norm(cfg, "ln_f")      # the unpiped model's exact module
+    pos_off = lax.axis_index("sp") * S if seq_sharded else None
 
     def embed(toks):
-        return lm_stage_embed(cfg, wte, wpe, toks)
+        return lm_stage_embed(cfg, wte, wpe, toks, pos_offset=pos_off)
 
     def stage_apply(h):
         def body(h, layer_params):
@@ -354,9 +369,30 @@ def pipeline_lm_loss(cfg, pp_params, tokens, targets, mesh: Mesh,
 
     data_deg = math.prod(mesh.shape[a] for a in BATCH_AXES)
     shard_mb = data_deg > 1 and tokens.shape[1] % data_deg == 0
-    stream_spec = (P(axis_name, BATCH_AXES) if shard_mb
-                   else P(axis_name))
-    psum_axes = (axis_name, *BATCH_AXES) if shard_mb else (axis_name,)
+    # pp×sp: the sequence dim shards over sp inside the pipeline — each
+    # stage tick rings its attention over the sp neighbors
+    sp_deg = dict(mesh.shape).get("sp", 1)
+    seq_sharded = sp_deg > 1
+    if seq_sharded:
+        if tokens.shape[2] % sp_deg:
+            raise ValueError(f"seq len {tokens.shape[2]} must divide over "
+                             f"sp={sp_deg}")
+        if tokens.shape[2] > cfg.max_len:
+            # the sp=1 path fails loudly on this (wpe[:S] shape mismatch);
+            # the sharded dynamic_slice would silently CLAMP the last
+            # ranks' position offsets and train on wrong embeddings
+            raise ValueError(f"seq len {tokens.shape[2]} exceeds "
+                             f"cfg.max_len={cfg.max_len} (the wpe table)")
+        if cfg.attention != "ring":
+            raise ValueError(
+                'pp×sp needs cfg.attention="ring" — a dense/flash stage '
+                "body would attend within its own S/sp shard only and "
+                "silently truncate context")
+    seq_axis = "sp" if seq_sharded else None
+    mb_axis = BATCH_AXES if shard_mb else None
+    stream_spec = P(axis_name, mb_axis, seq_axis)
+    psum_axes = (axis_name,) + (tuple(BATCH_AXES) if shard_mb else ()) \
+        + (("sp",) if seq_sharded else ())
     specs = {
         "wte": P(), "wpe": P(),
         "blocks": jax.tree.map(lambda _: P(axis_name),
@@ -375,7 +411,8 @@ def pipeline_lm_loss(cfg, pp_params, tokens, targets, mesh: Mesh,
     # the Megatron column/row collective pair inside the pipeline for free.
     manual = frozenset(a for a in mesh.axis_names if a != "tp")
     fn = shard_map(
-        functools.partial(_lm_pipeline_local, cfg, axis_name, M, psum_axes),
+        functools.partial(_lm_pipeline_local, cfg, axis_name, M, psum_axes,
+                          seq_sharded),
         mesh=mesh,
         in_specs=(specs, stream_spec, stream_spec),
         out_specs=P(),

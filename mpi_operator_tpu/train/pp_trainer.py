@@ -95,10 +95,29 @@ class PipelineLMTrainer:
                 f"microbatch size {mb} (global {self.config.global_batch_size}"
                 f" / M={self.num_microbatches}) must divide over the data "
                 f"axes (dcn×dp×fsdp = {data_deg})")
+        # pp×sp: the sequence dim of the stream shards over sp; each stage
+        # tick rings its attention over the sp neighbors
+        # (parallel/pipeline._lm_pipeline_local seq_sharded path)
+        self.sp = dict(mesh.shape).get("sp", 1)
+        if self.sp > 1:
+            if cfg.attention != "ring":
+                raise ValueError(
+                    'pp×sp needs attention="ring" (build the model with '
+                    "create_lm(..., attention=\"ring\") so stage bodies "
+                    "ring their K/V shards)")
+            if self.config.seq_len % self.sp:
+                raise ValueError(f"seq_len={self.config.seq_len} must "
+                                 f"divide over sp={self.sp}")
+            if schedule != "gpipe":
+                raise ValueError(
+                    "pp×sp composes with schedule='gpipe' only (the 1F1B "
+                    "in-schedule vjp does not ring the sequence axis yet)")
         self.tx = tx or make_adamw(self.config)
-        # token stream [M, mb, S]: M over pp, microbatch over data axes
+        # token stream [M, mb, S]: M over pp, microbatch over data axes,
+        # seq over sp when context-parallel
         self.batch_sharding = NamedSharding(
-            mesh, P("pp", ("dcn", "dp", "fsdp")))
+            mesh, P("pp", ("dcn", "dp", "fsdp"),
+                    "sp" if self.sp > 1 else None))
         self.replicated = NamedSharding(mesh, P())
         self._step = None
         self._state_shardings = None
@@ -132,8 +151,13 @@ class PipelineLMTrainer:
                                      params["ln_f"])}
 
     def init_state(self, rng: jax.Array) -> PPTrainState:
+        import dataclasses
+
         cfg = self.cfg
-        model = CausalLM(cfg)
+        # init on the dense twin: the attention impl owns no params, and
+        # "ring" (the pp×sp stage body) refuses to trace outside a live
+        # sp axis — which init legitimately is
+        model = CausalLM(dataclasses.replace(cfg, attention="dense"))
         dummy = jnp.zeros((2, self.config.seq_len), jnp.int32)
 
         def init_all(rng):

@@ -50,6 +50,63 @@ def device_peak_flops(device=None) -> Optional[float]:
     return None
 
 
+# HBM bandwidth per chip (bytes/s), by device_kind substring — the decode
+# roofline's denominator. Public figures: v2 700GB/s, v3 900, v4 1228,
+# v5e 819, v5p 2765, v6e (Trillium) 1640.
+_HBM_TABLE = (
+    ("v6e", 1640e9), ("v6 lite", 1640e9), ("trillium", 1640e9),
+    ("v5p", 2765e9),
+    ("v5 lite", 819e9), ("v5e", 819e9), ("v5litepod", 819e9),
+    ("v5", 2765e9),
+    ("v4", 1228e9),
+    ("v3", 900e9),
+    ("v2", 700e9),
+)
+
+
+def device_hbm_bandwidth(device=None) -> Optional[float]:
+    """Peak HBM bytes/s for one device; None when unknown (CPU/GPU)."""
+    import jax
+
+    device = device or jax.devices()[0]
+    kind = getattr(device, "device_kind", "").lower()
+    if "tpu" not in kind and device.platform != "tpu":
+        return None
+    for marker, bw in _HBM_TABLE:
+        if marker in kind:
+            return bw
+    return None
+
+
+def decode_bytes_per_step(num_params: int, num_layers: int,
+                          num_kv_heads: int, head_dim: int,
+                          batch: int, avg_len: float,
+                          param_bytes: int = 2,
+                          kv_cache_bytes: float = 2.0,
+                          kv_scale_bytes: float = 0.0) -> float:
+    """HBM bytes one autoregressive decode step must read — the roofline
+    numerator for MBU (model bandwidth utilization). Decode at small batch
+    is bandwidth-bound: every step re-reads the full parameter set once
+    (amortized over the whole batch) plus each sequence's KV cache at its
+    current length. `kv_cache_bytes` is per cached element (2 bf16, 1
+    int8); `kv_scale_bytes` covers quantization scales per (position,
+    head) pair per k/v tensor (4 for one f32 scale)."""
+    params = num_params * param_bytes
+    kv_per_pos = 2 * num_layers * num_kv_heads * (
+        head_dim * kv_cache_bytes + kv_scale_bytes)
+    return params + batch * avg_len * kv_per_pos
+
+
+def mbu(bytes_per_step: float, steps_per_sec: float,
+        device=None) -> Optional[float]:
+    """Achieved fraction of peak HBM bandwidth (single device). None when
+    the device's bandwidth is unknown."""
+    bw = device_hbm_bandwidth(device)
+    if not bw or not bytes_per_step:
+        return None
+    return bytes_per_step * steps_per_sec / bw
+
+
 def compiled_flops(compiled) -> Optional[float]:
     """Total FLOPs of one execution of a jax `Compiled`, from XLA's cost
     model. Returns None when the backend doesn't report it."""
@@ -132,7 +189,7 @@ def throughput_stats(flops_per_step: Optional[float], steps_per_sec: float,
     }
 
 
-__all__ = ["device_peak_flops", "compiled_flops",
+__all__ = ["device_peak_flops", "device_hbm_bandwidth", "compiled_flops",
            "resnet_train_flops_per_image",
            "transformer_train_flops_per_token", "param_count", "mfu",
-           "throughput_stats"]
+           "mbu", "decode_bytes_per_step", "throughput_stats"]
