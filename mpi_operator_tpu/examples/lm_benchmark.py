@@ -320,9 +320,14 @@ def run_lm_benchmark(
         try:
             toks, _ = synthetic_token_batch(
                 jax.random.PRNGKey(7), global_batch, seq_len, cfg_vocab)
-            _, diag = model.apply(
-                {"params": state.params}, toks,
-                mutable=["diagnostics", "intermediates"])
+            # jitted: an eager full-batch apply would per-op-dispatch the
+            # whole transformer through the (slow, droppy) tunneled
+            # compile service
+            _, diag = jax.jit(
+                lambda p, t: model.apply(
+                    {"params": p}, t,
+                    mutable=["diagnostics", "intermediates"])
+            )(state.params, toks)
             rates = jax.tree.leaves(diag.get("diagnostics", {}))
             if rates:
                 metrics["moe_drop_rate"] = float(
