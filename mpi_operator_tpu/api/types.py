@@ -207,6 +207,20 @@ class TPUJobSpec:
     #                 SIGKILL'd / infra loss); 1-127 are permanent failures
     restart_policy: str = "Never"
 
+    # Elastic membership, TPU-idiomatically (no strategy in the reference,
+    # SURVEY §2.3): XLA program shapes are fixed per topology, so
+    # elasticity is CHECKPOINT-RESTART elasticity — when workers are
+    # persistently unavailable the controller shrinks the job to the next
+    # valid v5e chip count (recorded in status.elastic_tpus, never by
+    # editing the user's spec), gang-restarts onto it, and training
+    # resumes from the latest checkpoint; once the shrunken world has run
+    # for a recovery window it tries the full spec size again. Mode A
+    # (tpus) single-slice only.
+    elastic: bool = False
+    # smallest chip count the controller may shrink to (default: any
+    # valid v5e size down to 1 chip)
+    min_tpus: Optional[int] = None
+
 
 # ---------------------------------------------------------------------------
 # Status — v1alpha2 condition model (ref common_types.go:23-156)
@@ -218,6 +232,9 @@ COND_RUNNING = "Running"
 COND_RESTARTING = "Restarting"
 COND_SUCCEEDED = "Succeeded"
 COND_FAILED = "Failed"
+# beyond the reference: True while elastic shrink has the job running
+# below its spec size (status.elastic_tpus set)
+COND_DEGRADED = "Degraded"
 
 # v1alpha1 launcher status surface kept for parity (ref types.go:102-116)
 LAUNCHER_ACTIVE = "Active"
@@ -256,6 +273,11 @@ class TPUJobStatus:
     completion_time: Optional[float] = None
     # controller-level gang restarts performed (restart_policy != "Never")
     restart_count: int = 0
+    # elastic membership (spec.elastic): the chip count the job currently
+    # runs at when shrunk below spec.tpus, and when that decision was
+    # made (drives the recovery-retry countdown). None = full size.
+    elastic_tpus: Optional[int] = None
+    elastic_since: Optional[float] = None
 
     # -- condition helpers (ref: v1alpha2 intent; pkg has no impl) ----------
     def get_condition(self, cond_type: str) -> Optional[JobCondition]:
@@ -338,7 +360,7 @@ __all__ = [
     "Container", "PodTemplateSpec",
     "TPUJobSpec", "JobCondition", "ReplicaStatus", "TPUJobStatus", "TPUJob",
     "COND_CREATED", "COND_RUNNING", "COND_RESTARTING", "COND_SUCCEEDED",
-    "COND_FAILED",
+    "COND_FAILED", "COND_DEGRADED",
     "LAUNCHER_ACTIVE", "LAUNCHER_SUCCEEDED", "LAUNCHER_FAILED",
     "new_tpu_job", "deepcopy_obj",
 ]

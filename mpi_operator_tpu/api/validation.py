@@ -216,6 +216,34 @@ def validate_spec(spec: TPUJobSpec,
                 f"with an explicit sliceTopology"
             )
 
+    if spec.elastic:
+        # checkpoint-restart elasticity needs a topology ladder to walk:
+        # Mode A chip counts, one slice (multi-slice shrink would have to
+        # re-plan the DCN mesh — not supported)
+        if spec.tpus is None:
+            errs.append(
+                "spec.elastic requires the tpus sizing mode (the "
+                "controller shrinks along the valid v5e chip-count ladder)"
+            )
+        if spec.num_slices > 1:
+            errs.append(
+                f"spec.elastic does not support numSlices="
+                f"{spec.num_slices} (> 1)"
+            )
+    if spec.min_tpus is not None:
+        if not spec.elastic:
+            errs.append("spec.minTpus requires spec.elastic")
+        if not _valid_tpu_count(spec.min_tpus):
+            errs.append(
+                f"spec.minTpus={spec.min_tpus} is not a valid v5e chip "
+                f"count {V5E_VALID_SLICE_CHIPS}"
+            )
+        elif spec.tpus is not None and spec.min_tpus > spec.tpus:
+            errs.append(
+                f"spec.minTpus={spec.min_tpus} exceeds spec.tpus="
+                f"{spec.tpus}"
+            )
+
     if spec.backoff_limit is not None and spec.backoff_limit < 0:
         errs.append(f"spec.backoffLimit must be >= 0, got {spec.backoff_limit}")
 

@@ -277,6 +277,8 @@ def _tpujob_spec_to_manifest(s: TPUJobSpec) -> dict:
         "gangScheduling": s.gang_scheduling or None,
         "cleanPodPolicy": s.clean_pod_policy,
         "restartPolicy": s.restart_policy,
+        "elastic": s.elastic or None,
+        "minTpus": s.min_tpus,
         "template": template_to_manifest(s.template),
     })
 
@@ -299,6 +301,8 @@ def _tpujob_spec_from_manifest(m: dict) -> TPUJobSpec:
         gang_scheduling=bool(m.get("gangScheduling", False)),
         clean_pod_policy=m.get("cleanPodPolicy", "Running"),
         restart_policy=m.get("restartPolicy", "Never"),
+        elastic=bool(m.get("elastic", False)),
+        min_tpus=m.get("minTpus"),
         template=template_from_manifest(m.get("template") or {}),
     )
 
@@ -310,6 +314,8 @@ def _tpujob_status_to_manifest(st: TPUJobStatus) -> dict:
         "startTime": rfc3339(st.start_time),
         "completionTime": rfc3339(st.completion_time),
         "restartCount": st.restart_count or None,
+        "elasticTpus": st.elastic_tpus,
+        "elasticSince": rfc3339(st.elastic_since),
         "conditions": [
             _prune({
                 "type": c.type,
@@ -336,6 +342,8 @@ def _tpujob_status_from_manifest(m: dict) -> TPUJobStatus:
         start_time=parse_time(m.get("startTime")),
         completion_time=parse_time(m.get("completionTime")),
         restart_count=int(m.get("restartCount", 0)),
+        elastic_tpus=m.get("elasticTpus"),
+        elastic_since=parse_time(m.get("elasticSince")),
     )
     for c in m.get("conditions") or []:
         st.conditions.append(JobCondition(

@@ -89,6 +89,19 @@ class RateLimitingQueue:
             heapq.heappush(self._waiting, (time.monotonic() + delay, key))
             self._lock.notify()
 
+    def add_after(self, key: str, delay: float) -> None:
+        """Enqueue `key` after `delay` seconds WITHOUT touching the
+        failure counter (workqueue.AddAfter): for scheduled re-syncs —
+        timeout checks, retry windows — not error backoff."""
+        if delay <= 0:
+            self.add(key)
+            return
+        with self._lock:
+            if self._shutting_down:
+                return
+            heapq.heappush(self._waiting, (time.monotonic() + delay, key))
+            self._lock.notify()
+
     def forget(self, key: str) -> None:
         with self._lock:
             self._failures.pop(key, None)

@@ -260,6 +260,11 @@ class ControllerConfig:
     discovery_image: Optional[str] = None
     # how long the discovery init step waits for worker DNS before failing
     discovery_timeout_seconds: int = 300
+    # elastic membership (spec.elastic): how long workers may sit not-Ready
+    # before the job shrinks to the next valid topology, and how long a
+    # shrunken job runs before the full spec size is retried
+    elastic_degraded_seconds: int = 300
+    elastic_recovery_seconds: int = 1800
 
 
 @dataclass
@@ -304,6 +309,16 @@ class TPUJobController:
         # delta baseline for cumulative worker-crash accounting; entries
         # are dropped once a job reaches a terminal state
         self._worker_restart_marks: Dict[tuple, dict] = {}
+        # elastic membership: when each job's workers were first observed
+        # not-Ready, and when a DEGRADED job's gang was first observed
+        # continuously Ready (the recovery countdown base — measuring
+        # from the shrink decision would restore a slow-to-schedule gang
+        # the instant it first turns Ready). In-memory — an operator
+        # restart conservatively restarts the countdowns. Injectable
+        # clock for tests.
+        self._not_ready_since: Dict[tuple, float] = {}
+        self._elastic_ready_since: Dict[tuple, float] = {}
+        self.now = time.time
 
         # Admission: reject invalid TPUJob specs at create/update, the CRD
         # openAPIV3-schema analogue (ref deploy/0-crd.yaml:16-99) — invalid
@@ -445,6 +460,8 @@ class TPUJobController:
             # work item no longer exists → drop (ref :431-436); release its
             # crash-baseline state too (jobs deleted mid-run would leak it)
             self._worker_restart_marks.pop((namespace, name), None)
+            self._not_ready_since.pop((namespace, name), None)
+            self._elastic_ready_since.pop((namespace, name), None)
             logger.debug("tpujob '%s' no longer exists", key)
             return
 
@@ -559,6 +576,16 @@ class TPUJobController:
             all(w is not None for w in workers)
             and total_ready == alloc.worker_replicas
         ) or alloc.worker_replicas == 0
+        # elastic membership: persistent worker unavailability shrinks the
+        # job to the next valid topology (status.elastic_tpus); a shrunken
+        # job that has run a recovery window retries the full spec size.
+        # Decisions land in STATUS this sync; the NEXT sync (triggered by
+        # the status watch event) materializes the new world through the
+        # ordinary resize/gang-restart machinery.
+        if (not done and job.spec.elastic and job.spec.tpus is not None
+                and alloc.worker_replicas > 0 and not resized):
+            job = self._elastic_reconcile(job, alloc, workers_ready, key)
+
         # `not resized`: in the resize sync itself the StatefulSet status
         # still shows the PRE-deletion ready counts (same-size template
         # edits included) — creating a launcher now would rendezvous
@@ -621,6 +648,103 @@ class TPUJobController:
                 self.api.update(sts)
 
     # ------------------------------------------------------------------
+    # elastic membership (spec.elastic) — checkpoint-restart elasticity
+    # ------------------------------------------------------------------
+
+    def _elastic_reconcile(self, job: TPUJob, alloc: AllocationResult,
+                           workers_ready: bool, key: str) -> TPUJob:
+        """One tick of the elastic state machine (no reference analogue —
+        SURVEY §2.3 lists elasticity as absent; MPI's answer was 'mpirun
+        dies'). TPU-idiomatic elasticity is checkpoint-restart: XLA
+        program shapes are fixed per topology, so changing the world size
+        means a gang restart resuming from the latest checkpoint — which
+        the resize machinery already does. This method only decides WHAT
+        size the world should be:
+
+          not Ready for > elastic_degraded_seconds → shrink to the next
+            valid v5e chip count >= minTpus (recorded in status, with a
+            Degraded condition + Warning Event);
+          Ready at a shrunken size for > elastic_recovery_seconds → try
+            the full spec size again (capacity may be back; if it isn't,
+            the degraded timer shrinks the job right back, so the job
+            oscillates at most once per recovery window).
+
+        Wake-ups are scheduled through queue.add_after — a pending
+        timeout fires even with no cluster events."""
+        now = self.now()
+        jkey = (job.metadata.namespace, job.metadata.name)
+        degraded = job.status.elastic_tpus is not None
+        if workers_ready:
+            self._not_ready_since.pop(jkey, None)
+            if not degraded:
+                self._elastic_ready_since.pop(jkey, None)
+                return job
+            # recovery counts CONTINUOUS readiness of the shrunken world,
+            # armed at its first Ready observation — not the shrink time
+            # (a gang that took the whole window to schedule would
+            # otherwise be restored the instant it first turns Ready)
+            ready_since = self._elastic_ready_since.setdefault(jkey, now)
+            wait = self.config.elastic_recovery_seconds - (now - ready_since)
+            if wait > 0:
+                self.queue.add_after(key, wait)
+                return job
+            self._elastic_ready_since.pop(jkey, None)
+            job.status.elastic_tpus = None
+            job.status.elastic_since = None
+            job.status.set_condition(api.JobCondition(
+                api.COND_DEGRADED, "False", "ElasticRestore",
+                f"retrying the full size (tpus={job.spec.tpus}) after the "
+                f"recovery window"))
+            job = self.api.update_status(job)
+            self.recorder.event(
+                job, "Normal", "ElasticRestore",
+                f"restoring to spec size tpus={job.spec.tpus}")
+            return job
+        self._elastic_ready_since.pop(jkey, None)   # continuity broken
+        since = self._not_ready_since.setdefault(jkey, now)
+        wait = self.config.elastic_degraded_seconds - (now - since)
+        if wait > 0:
+            self.queue.add_after(key, wait)
+            return job
+        next_total = self._next_elastic_total(job)
+        if next_total is None:
+            return job          # already at the floor; stay pending
+        current = job.status.elastic_tpus or job.spec.tpus
+        job.status.elastic_tpus = next_total
+        job.status.elastic_since = now
+        job.status.set_condition(api.JobCondition(
+            api.COND_DEGRADED, "True", "ElasticShrink",
+            f"workers not Ready for "
+            f"{self.config.elastic_degraded_seconds}s; shrinking "
+            f"{current} -> {next_total} chips (resumes from the latest "
+            f"checkpoint)"))
+        job = self.api.update_status(job)
+        self.recorder.event(
+            job, "Warning", "ElasticShrink",
+            f"shrinking to tpus={next_total} after persistent worker "
+            f"unavailability")
+        self._not_ready_since.pop(jkey, None)
+        return job
+
+    def _next_elastic_total(self, job: TPUJob) -> Optional[int]:
+        """Largest valid v5e chip count strictly below the current
+        effective size that the per-worker count can still tile
+        (divisible, or the single-worker `total < perWorker` form) and
+        that respects spec.minTpus."""
+        spec = job.spec
+        current = job.status.elastic_tpus or spec.tpus
+        per = (spec.tpus_per_worker
+               if spec.tpus_per_worker is not None
+               else self.config.tpus_per_worker)
+        floor = spec.min_tpus or 1
+        for c in sorted(api.V5E_VALID_SLICE_CHIPS, reverse=True):
+            if c >= current or c < floor:
+                continue
+            if c < per or c % per == 0:
+                return c
+        return None
+
+    # ------------------------------------------------------------------
     # gang-restart decision (v1alpha2 RestartPolicy, common_types.go:131-156)
     # ------------------------------------------------------------------
 
@@ -672,8 +796,11 @@ class TPUJobController:
 
         if spec.tpus is not None:
             # Mode A via tpus: pair with tpusPerWorker (spec overrides the
-            # cluster flag, ref :449-453)
+            # cluster flag, ref :449-453). An elastic shrink overrides the
+            # spec size through STATUS (the user's spec is never edited).
             total = spec.tpus
+            if spec.elastic and job.status.elastic_tpus is not None:
+                total = job.status.elastic_tpus
             per_worker = (
                 spec.tpus_per_worker
                 if spec.tpus_per_worker is not None
@@ -1248,8 +1375,19 @@ class TPUJobController:
                 **template.node_selector,
                 NS_ACCELERATOR: job.spec.accelerator_type,
             }
-            if job.spec.slice_topology:
-                template.node_selector[NS_TOPOLOGY] = job.spec.slice_topology
+            topo = job.spec.slice_topology
+            if job.spec.elastic and job.status.elastic_tpus is not None \
+                    and topo:
+                # the shrunken world must not stay pinned to the FULL
+                # size's topology nodepool (that's exactly the capacity
+                # that's gone) — recompute for the degraded chip count,
+                # or drop the selector if no canonical shape exists
+                from ..api.validation import V5E_TOPOLOGIES
+                shapes = V5E_TOPOLOGIES.get(
+                    alloc.worker_replicas * alloc.units_per_worker)
+                topo = shapes[0] if shapes else None
+            if topo:
+                template.node_selector[NS_TOPOLOGY] = topo
         template.metadata.labels = {
             **template.metadata.labels, LABEL_GROUP: job.metadata.name,
             "tpu_job_role": "worker",     # headless Service selector target
@@ -1519,9 +1657,13 @@ class TPUJobController:
         else:
             delta = 0
             # terminal: drop the delta baseline (bounded memory — the
-            # recorded .failed total lives on in status)
-            self._worker_restart_marks.pop(
-                (job.metadata.namespace, job.metadata.name), None)
+            # recorded .failed total lives on in status); the elastic
+            # timers too (a terminal job never reconciles elastically)
+            jkey = (job.metadata.namespace, job.metadata.name)
+            self._worker_restart_marks.pop(jkey, None)
+            if job.status.is_done():
+                self._not_ready_since.pop(jkey, None)
+                self._elastic_ready_since.pop(jkey, None)
         worker_failed = prev_failed + delta
         if delta > 0 and worker_failed >= 2:
             # repeated restarts = crash loop; one Warning per escalation

@@ -163,6 +163,40 @@ def test_mode_a_explicit_per_worker_divisibility():
                                  processing_units_per_worker=0))
 
 
+def test_elastic_validation():
+    """spec.elastic needs a topology ladder to walk: tpus mode, one
+    slice; minTpus requires elastic and must be a valid count <= tpus."""
+    validate_spec(TPUJobSpec(tpus=8, elastic=True))
+    validate_spec(TPUJobSpec(tpus=16, elastic=True, min_tpus=4))
+    with pytest.raises(ValidationError, match="tpus sizing mode"):
+        validate_spec(TPUJobSpec(replicas=2, elastic=True))
+    with pytest.raises(ValidationError, match="numSlices"):
+        validate_spec(TPUJobSpec(tpus=16, elastic=True, num_slices=2,
+                                 slice_topology="2x4"))
+    with pytest.raises(ValidationError, match="requires spec.elastic"):
+        validate_spec(TPUJobSpec(tpus=8, min_tpus=4))
+    with pytest.raises(ValidationError, match="not a valid v5e"):
+        validate_spec(TPUJobSpec(tpus=8, elastic=True, min_tpus=3))
+    with pytest.raises(ValidationError, match="exceeds"):
+        validate_spec(TPUJobSpec(tpus=8, elastic=True, min_tpus=16))
+
+
+def test_elastic_fields_round_trip_serialization():
+    from mpi_operator_tpu.api.types import ObjectMeta, TPUJob
+    from mpi_operator_tpu.cluster.serialize import (from_manifest,
+                                                    to_manifest)
+
+    job = TPUJob(metadata=ObjectMeta(name="e", namespace="d"),
+                 spec=TPUJobSpec(tpus=16, elastic=True, min_tpus=4))
+    job.status.elastic_tpus = 8
+    job.status.elastic_since = 1234567890.0
+    back = from_manifest(to_manifest(job))
+    assert back.spec.elastic is True
+    assert back.spec.min_tpus == 4
+    assert back.status.elastic_tpus == 8
+    assert abs(back.status.elastic_since - 1234567890.0) < 1.0
+
+
 def test_multislice_validation_is_per_slice():
     """Slice-shape constraints apply PER SLICE: tpus=512 over 2 slices is
     two valid v5e-256 slices; non-divisible counts fail at admission (the

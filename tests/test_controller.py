@@ -275,6 +275,163 @@ def test_zero_per_worker_flag_is_invalid_spec_not_crash():
     assert ">= 1" in cond.message
 
 
+# ---------------------------------------------------------------------------
+# elastic membership (spec.elastic — checkpoint-restart elasticity)
+# ---------------------------------------------------------------------------
+
+class FakeClock:
+    def __init__(self, t=1000.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+
+def _elastic_fixture(degraded=60, recovery=120, **job_kw):
+    f = Fixture(elastic_degraded_seconds=degraded,
+                elastic_recovery_seconds=recovery)
+    clock = FakeClock()
+    f.controller.now = clock
+    job = new_job(tpus=8)
+    job.spec.elastic = True
+    for k, v in job_kw.items():
+        setattr(job.spec, k, v)
+    f.seed(job)
+    return f, clock
+
+
+def test_elastic_shrinks_after_persistent_unavailability():
+    """Workers stuck not-Ready past the degraded window → the job shrinks
+    to the next valid v5e size via STATUS (spec untouched), records a
+    Degraded condition + Warning Event, and the next sync materializes
+    the smaller world through the ordinary resize machinery."""
+    f, clock = _elastic_fixture()
+    f.run("default/test")                  # creates the 2-worker STS
+    # workers exist but never become Ready; timer starts at first sync
+    f.run("default/test")
+    clock.t += 61                          # past elastic_degraded_seconds
+    f.run("default/test")
+    job = f.api.get(api.KIND, "default", "test")
+    assert job.spec.tpus == 8              # spec never edited
+    assert job.status.elastic_tpus == 4    # next valid count below 8
+    cond = job.status.get_condition(api.COND_DEGRADED)
+    assert cond is not None and cond.status == "True"
+    assert any(e.reason == "ElasticShrink" and e.type == "Warning"
+               for e in f.controller.recorder.events)
+    # next sync: the worker set converges to the 1-worker degraded world
+    f.run("default/test")
+    sts = f.api.get("StatefulSet", "default", "test" + WORKER_SUFFIX)
+    assert sts.spec.replicas == 1
+    env = sts.spec.template.main_container().env
+    assert env["TPU_NUM_PROCESSES"] == "1"
+
+
+def test_elastic_restores_after_recovery_window():
+    """A shrunken job that has run Ready for the recovery window retries
+    the full spec size (Degraded flips False, gang resizes back up)."""
+    f, clock = _elastic_fixture()
+    f.run("default/test")
+    f.run("default/test")
+    clock.t += 61
+    f.run("default/test")                  # shrink decision
+    f.run("default/test")                  # materialize 1-worker world
+    # the degraded gang comes up Ready
+    sts = f.api.get("StatefulSet", "default", "test" + WORKER_SUFFIX)
+    from mpi_operator_tpu.cluster.resources import StatefulSetStatus
+    sts.status = StatefulSetStatus(ready_replicas=1, replicas=1)
+    f.api.update(sts)
+    f.run("default/test")                  # running degraded; timer arms
+    clock.t += 121                         # past elastic_recovery_seconds
+    f.run("default/test")
+    job = f.api.get(api.KIND, "default", "test")
+    assert job.status.elastic_tpus is None
+    cond = job.status.get_condition(api.COND_DEGRADED)
+    assert cond is not None and cond.status == "False"
+    assert any(e.reason == "ElasticRestore"
+               for e in f.controller.recorder.events)
+    # next sync resizes the worker set back toward the full world
+    f.run("default/test")
+    sts = f.api.get("StatefulSet", "default", "test" + WORKER_SUFFIX)
+    assert sts.spec.replicas == 2
+
+
+def test_elastic_shrink_recomputes_topology_selector():
+    """The shrunken world must NOT stay pinned to the full size's
+    sliceTopology nodepool — that is exactly the capacity that's gone.
+    The selector is recomputed for the degraded chip count."""
+    f, clock = _elastic_fixture(slice_topology="2x4")
+    f.run("default/test")
+    f.run("default/test")
+    clock.t += 61
+    f.run("default/test")                  # shrink 8 -> 4
+    f.run("default/test")                  # materialize
+    sts = f.api.get("StatefulSet", "default", "test" + WORKER_SUFFIX)
+    sel = sts.spec.template.node_selector
+    assert sel["cloud.google.com/gke-tpu-topology"] == "2x2"   # 4 chips
+
+
+def test_elastic_recovery_counts_from_ready_not_shrink():
+    """A shrunken gang that took longer than the recovery window to
+    become Ready must still get a FULL window of degraded running before
+    restore — the countdown arms at the first Ready observation."""
+    f, clock = _elastic_fixture()
+    f.run("default/test")
+    f.run("default/test")
+    clock.t += 61
+    f.run("default/test")                  # shrink at t0
+    f.run("default/test")                  # materialize 1-worker world
+    clock.t += 200                         # way past recovery (120s)...
+    _seed_ready(f, "test", 1, 1)
+    f.run("default/test")                  # ...but Ready only NOW: arms
+    job = f.api.get(api.KIND, "default", "test")
+    assert job.status.elastic_tpus == 4    # NOT restored yet
+    clock.t += 121                         # a full window of Ready
+    f.run("default/test")
+    job = f.api.get(api.KIND, "default", "test")
+    assert job.status.elastic_tpus is None # now restored
+
+
+def test_elastic_respects_min_tpus_floor():
+    """minTpus floors the ladder: a job already at the floor stays
+    pending instead of shrinking further."""
+    f, clock = _elastic_fixture(min_tpus=8)
+    f.run("default/test")
+    f.run("default/test")
+    clock.t += 61
+    f.run("default/test")
+    job = f.api.get(api.KIND, "default", "test")
+    assert job.status.elastic_tpus is None          # 8 is the floor
+    assert job.status.get_condition(api.COND_DEGRADED) is None
+
+
+def test_elastic_timer_clears_when_workers_recover():
+    """Workers turning Ready inside the window must clear the countdown —
+    a later blip starts a FRESH window instead of inheriting the old
+    one."""
+    f, clock = _elastic_fixture()
+    f.run("default/test")
+    f.run("default/test")
+    clock.t += 50                          # inside the window
+    _seed_ready(f, "test", 2, 2)
+    f.run("default/test")                  # Ready → timer cleared
+    sts = f.api.get("StatefulSet", "default", "test" + WORKER_SUFFIX)
+    from mpi_operator_tpu.cluster.resources import StatefulSetStatus
+    sts.status = StatefulSetStatus(ready_replicas=0, replicas=2)
+    f.api.update(sts)
+    clock.t += 30                          # 50+30 > 60, but fresh window
+    f.run("default/test")
+    job = f.api.get(api.KIND, "default", "test")
+    assert job.status.elastic_tpus is None
+
+
+def _seed_ready(f, name, ready, replicas):
+    from mpi_operator_tpu.cluster.resources import StatefulSetStatus
+    sts = f.api.get("StatefulSet", "default", name + WORKER_SUFFIX)
+    sts.status = StatefulSetStatus(ready_replicas=ready, replicas=replicas)
+    f.api.update(sts)
+    return sts
+
+
 def test_custom_replicas_cpu():
     """Mode B with cpu resource type (ref TestAllResourcesCreatedCustom
     cpu variant :564-596)."""
