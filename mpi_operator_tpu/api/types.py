@@ -274,8 +274,11 @@ class TPUJobStatus:
     # controller-level gang restarts performed (restart_policy != "Never")
     restart_count: int = 0
     # elastic membership (spec.elastic): the chip count the job currently
-    # runs at when shrunk below spec.tpus, and when that decision was
-    # made (drives the recovery-retry countdown). None = full size.
+    # runs at when shrunk below spec.tpus, and when that shrink decision
+    # was made. elastic_since is OBSERVABILITY ONLY (kubectl shows when
+    # the job degraded): the restore countdown arms at the shrunken
+    # gang's first Ready observation, tracked in controller memory
+    # (TPUJobController._elastic_ready_since). None = full size.
     elastic_tpus: Optional[int] = None
     elastic_since: Optional[float] = None
 

@@ -142,11 +142,14 @@ def run_lm_benchmark(
                            lr_schedule=lr_schedule, decay_steps=decay_steps,
                            **opt_overrides)
     if pp > 1:
-        # GPipe over the pp axis: stage-sliced CausalLM with a pp-sharded
-        # microbatch stream (train/pp_trainer.py). bert (masked) stays on
-        # the unpiped trainer — the pipelined head is next-token xent.
-        if masked:
-            raise ValueError("--pp supports the causal LM (gpt2) only")
+        # GPipe over the pp axis: stage-sliced CausalLM — or MaskedLM
+        # (bert): the mask stream rides the relays and the last stage
+        # runs the MLM transform head (parallel/pipeline.py
+        # pipeline_mlm_loss)
+        if masked and pp_schedule != "gpipe":
+            raise ValueError("--pp with bert composes with --pp-schedule "
+                             "gpipe only (1F1B's in-schedule vjp is "
+                             "causal-only)")
         # learned-position requirement is validated by PipelineLMTrainer
         # itself (the invariant lives there)
         if moe_experts or ep > 1:
@@ -196,8 +199,18 @@ def run_lm_benchmark(
 
             def __next__(self):
                 self._rng, sub = jax.random.split(self._rng)
-                return synthetic_token_batch(sub, global_batch, seq_len,
-                                             cfg_vocab)
+                toks, tgts = synthetic_token_batch(sub, global_batch,
+                                                   seq_len, cfg_vocab)
+                if masked:
+                    # same MLM objective as the unpiped stream: targets
+                    # are the ORIGINAL tokens, inputs corrupted at the
+                    # masked slots with the mask id
+                    self._rng, msub = jax.random.split(self._rng)
+                    mask = jax.random.uniform(
+                        msub, toks.shape) < MLM_MASK_RATE
+                    return (jnp.where(mask, cfg_vocab - 1, toks), toks,
+                            mask.astype(jnp.float32))
+                return toks, tgts
 
             def close(self):
                 pass
@@ -212,9 +225,22 @@ def run_lm_benchmark(
             M = pp_trainer.num_microbatches
             mb = global_batch // M
 
-            def pp_transform(win):
-                return (win[:, :-1].reshape(M, mb, seq_len),
-                        win[:, 1:].reshape(M, mb, seq_len))
+            if masked:
+                pp_mlm_rng = np.random.RandomState(3)
+
+                def pp_transform(win):
+                    toks = win[:, :-1]
+                    mask = (pp_mlm_rng.random_sample(toks.shape)
+                            < MLM_MASK_RATE)
+                    return (np.where(mask, cfg_vocab - 1, toks)
+                            .astype(np.int32).reshape(M, mb, seq_len),
+                            toks.reshape(M, mb, seq_len),
+                            mask.astype(np.float32).reshape(M, mb,
+                                                            seq_len))
+            else:
+                def pp_transform(win):
+                    return (win[:, :-1].reshape(M, mb, seq_len),
+                            win[:, 1:].reshape(M, mb, seq_len))
 
             pp_stream = NpyTokenDataset(data_dir, global_batch, seq_len,
                                         sharding=pp_trainer.batch_sharding,

@@ -9,7 +9,8 @@ from .sharding import (  # noqa: F401
 )
 from .ring_attention import ring_attention, ring_attention_inner  # noqa: F401
 from .pipeline import (pipeline_apply, stack_stage_params, stack_lm_params,  # noqa: F401
-                       pipeline_lm_loss, bubble_fraction)
+                       stack_mlm_params, pipeline_lm_loss,
+                       pipeline_mlm_loss, bubble_fraction)
 from .pipeline_1f1b import (simulate_1f1b, interleave_blocks,  # noqa: F401
                             deinterleave_blocks, pipeline_lm_1f1b_grads)
 from .moe import MoeMlp  # noqa: F401

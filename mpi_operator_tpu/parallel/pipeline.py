@@ -253,8 +253,33 @@ def stack_lm_params(params, num_layers: int):
     }
 
 
+def stack_mlm_params(params, num_layers: int):
+    """stack_lm_params for the MaskedLM (BERT) family: same stacked-block
+    core plus the MLM-specific leaves — embedding LayerNorm, token-type
+    table, and the transform head (dense+LN+bias over the tied
+    decoder)."""
+    bb = params["backbone"]
+    blocks = jax.tree.map(
+        lambda *xs: jnp.stack(xs),
+        *[bb[f"block_{i}"] for i in range(num_layers)])
+    out = {
+        "wte": params["wte"]["embedding"],
+        "wpe": params["wpe"]["embedding"],
+        "blocks": blocks,
+        "ln_f": bb["ln_f"],
+        "ln_emb": params["ln_emb"],
+        "mlm_dense": params["mlm_dense"],
+        "mlm_ln": params["mlm_ln"],
+        "mlm_bias": params["mlm_bias"],
+    }
+    if "wtte" in params:
+        out["wtte"] = params["wtte"]["embedding"]
+    return out
+
+
 def _lm_pipeline_local(cfg, axis_name: str, M: int, psum_axes, seq_sharded,
-                       pp_params, tokens_local, targets_local):
+                       masked, pp_params, tokens_local, targets_local,
+                       *opt_mask):
     """Stage-sliced CausalLM forward + loss inside shard_map over pp.
 
     Each stage owns L/P consecutive blocks (lax.scan over the local layer
@@ -273,9 +298,17 @@ def _lm_pipeline_local(cfg, axis_name: str, M: int, psum_axes, seq_sharded,
     of every owned microbatch; attention inside the stage body rings the
     K/V shards over sp (cfg.attention="ring" → models._attend detects the
     live sp axis and runs ring_attention_inner), positions offset by the
-    shard's global start, and the loss psum spans sp too."""
-    from ..models.transformer import Block, _layer_norm
+    shard's global start, and the loss psum spans sp too.
 
+    masked=True (the MaskedLM/BERT family): a float mask stream rides the
+    relays next to the targets, stage 0's embed adds the token-type-0
+    row + the embedding LayerNorm, the last stage runs the MLM transform
+    head (dense+gelu+LN, tied decoder, vocab bias), and the return value
+    is the psummed (masked-xent sum, mask count) PAIR — masked mean
+    needs the dynamic global mask count, not a static token count."""
+    from ..models.transformer import Block, _dense, _layer_norm
+
+    mask_local = opt_mask[0] if opt_mask else None
     n_stages = lax.axis_size(axis_name)
     stage = lax.axis_index(axis_name)
     C = M // n_stages
@@ -290,7 +323,14 @@ def _lm_pipeline_local(cfg, axis_name: str, M: int, psum_axes, seq_sharded,
     pos_off = lax.axis_index("sp") * S if seq_sharded else None
 
     def embed(toks):
-        return lm_stage_embed(cfg, wte, wpe, toks, pos_offset=pos_off)
+        h = lm_stage_embed(cfg, wte, wpe, toks, pos_offset=pos_off)
+        if not masked:
+            return h
+        if "wtte" in pp_params:
+            # benchmark contract: token_types=None → all type 0
+            h = h + pp_params["wtte"][0][None, None].astype(cfg.dtype)
+        return _layer_norm(cfg, "ln_emb").apply(
+            {"params": pp_params["ln_emb"]}, h)
 
     def stage_apply(h):
         def body(h, layer_params):
@@ -298,79 +338,111 @@ def _lm_pipeline_local(cfg, axis_name: str, M: int, psum_axes, seq_sharded,
         h, _ = lax.scan(body, h, blocks)
         return h
 
-    def head_loss(y, tgt):
-        return lm_stage_head_loss(cfg, ln_f, pp_params["ln_f"], wte, y, tgt)
+    if masked:
+        def head_loss(y, tgt, msk):
+            h = ln_f.apply({"params": pp_params["ln_f"]}, y)
+            h = _dense(cfg.embed_dim, "mlm_dense", ("embed", "embed"),
+                       cfg.dtype).apply({"params": pp_params["mlm_dense"]},
+                                        h)
+            h = _layer_norm(cfg, "mlm_ln").apply(
+                {"params": pp_params["mlm_ln"]}, jax.nn.gelu(h))
+            from ..models.transformer import _head_matmul
+            logits = _head_matmul(h, wte.astype(cfg.dtype))
+            logits = logits + pp_params["mlm_bias"]
+            xent = optax.softmax_cross_entropy_with_integer_labels(
+                logits, tgt)
+            return (xent * msk).sum(), msk.sum()
+    else:
+        def head_loss(y, tgt, msk):
+            del msk
+            return (lm_stage_head_loss(cfg, ln_f, pp_params["ln_f"], wte,
+                                       y, tgt),
+                    jnp.zeros((), jnp.float32))
 
-    def inject(r_tok, r_tgt, tau):
+    def pick(arr, row):
+        return lax.dynamic_index_in_dim(arr, row, 0, keepdims=False)
+
+    def inject(r_tok, r_tgt, r_msk, tau):
         m_next = tau + 1 + stage
         own = (m_next // C == stage) & (m_next < M)
         row = jnp.clip(m_next - stage * C, 0, C - 1)
-        toks = lax.dynamic_index_in_dim(tokens_local, row, 0,
-                                        keepdims=False)
-        tgts = lax.dynamic_index_in_dim(targets_local, row, 0,
-                                        keepdims=False)
-        r_tok = jnp.where(own, toks, r_tok)
-        r_tgt = jnp.where(own, tgts, r_tgt)
-        return r_tok, r_tgt
+        r_tok = jnp.where(own, pick(tokens_local, row), r_tok)
+        r_tgt = jnp.where(own, pick(targets_local, row), r_tgt)
+        if mask_local is not None:
+            r_msk = jnp.where(own, pick(mask_local, row), r_msk)
+        return r_tok, r_tgt, r_msk
 
     zero = _vma_zero(blocks, jnp.float32)
 
     def tick(carry, tau):
-        r_tok, r_tgt, act, tgt, loss_sum = carry
+        r_tok, r_tgt, r_msk, act, tgt, msk, loss_sum, cnt_sum = carry
         cur_h = jnp.where(stage == 0, embed(r_tok), act)
         cur_t = jnp.where(stage == 0, r_tgt, tgt)
+        cur_m = jnp.where(stage == 0, r_msk, msk)
         y = stage_apply(cur_h)
         do_loss = (stage == n_stages - 1) & (tau >= n_stages - 1)
-        # the false branch's zero must carry the same pp-variance as the
+        # the false branch's zeros must carry the same pp-variance as the
         # real loss or cond rejects the branches as differently typed
-        loss_sum = loss_sum + lax.cond(
-            do_loss, lambda: head_loss(y, cur_t),
-            lambda: jnp.zeros((), jnp.float32) + zero)
+        l, c = lax.cond(
+            do_loss, lambda: head_loss(y, cur_t, cur_m),
+            lambda: (jnp.zeros((), jnp.float32) + zero,
+                     jnp.zeros((), jnp.float32) + zero))
+        loss_sum = loss_sum + l
+        cnt_sum = cnt_sum + c
         act = lax.ppermute(y, axis_name, _fwd_perm(n_stages))
         tgt = lax.ppermute(cur_t, axis_name, _fwd_perm(n_stages))
         r_tok = lax.ppermute(r_tok, axis_name, _bwd_perm(n_stages))
         r_tgt = lax.ppermute(r_tgt, axis_name, _bwd_perm(n_stages))
-        r_tok, r_tgt = inject(r_tok, r_tgt, tau)
-        return (r_tok, r_tgt, act, tgt, loss_sum), None
+        if mask_local is not None:       # mask rides only when masked
+            msk = lax.ppermute(cur_m, axis_name, _fwd_perm(n_stages))
+            r_msk = lax.ppermute(r_msk, axis_name, _bwd_perm(n_stages))
+        else:
+            msk = cur_m
+        r_tok, r_tgt, r_msk = inject(r_tok, r_tgt, r_msk, tau)
+        return (r_tok, r_tgt, r_msk, act, tgt, msk, loss_sum, cnt_sum), None
 
     r_tok0 = tokens_local[0]
     r_tgt0 = targets_local[0]
+    r_msk0 = (mask_local[0] if mask_local is not None
+              else jnp.zeros(r_tok0.shape, jnp.float32))
     act0 = jnp.zeros((r_tok0.shape[0], S, wte.shape[1]), cfg.dtype) \
         + zero.astype(cfg.dtype)
-    carry0 = (r_tok0, r_tgt0, act0, r_tgt0,
-              jnp.zeros((), jnp.float32) + zero)
-    (_, _, _, _, loss_sum), _ = lax.scan(tick, carry0, jnp.arange(T))
-    return lax.psum(loss_sum, psum_axes)
+    z32 = jnp.zeros((), jnp.float32) + zero
+    carry0 = (r_tok0, r_tgt0, r_msk0, act0, r_tgt0,
+              r_msk0 + zero.astype(r_msk0.dtype), z32, z32)
+    (_, _, _, _, _, _, loss_sum, cnt_sum), _ = lax.scan(
+        tick, carry0, jnp.arange(T))
+    return lax.psum(loss_sum, psum_axes), lax.psum(cnt_sum, psum_axes)
 
 
-def pipeline_lm_loss(cfg, pp_params, tokens, targets, mesh: Mesh,
-                     num_microbatches: int, axis_name: str = "pp"):
-    """Mean next-token cross-entropy of a pp-stage-sliced CausalLM.
+def _pipeline_stream_setup(cfg, mesh, pp_params, tokens, M,
+                           axis_name, masked):
+    """Shared prologue of pipeline_lm_loss / pipeline_mlm_loss — ONE
+    definition so the divisibility checks and sharding inference can't
+    drift between the causal and masked entry points.
 
-    cfg — TransformerConfig; cfg.num_layers must divide over pp.
-    pp_params — stack_lm_params() layout; blocks sharded over pp.
-    tokens/targets — [M, microbatch, S] int32, sharded over pp on M.
-    Equals models.CausalLM.apply + lm_loss on the same (restacked) params;
-    see tests/test_parallel.py::TestPipelineLM."""
+    The microbatch dim shards over the data axes whenever it divides, so
+    pp×dp genuinely splits the work (each dp rank pipelines its own slice
+    of every microbatch); otherwise it replicates (tiny test shapes). The
+    loss psum then spans pp AND the sharded data axes — the total is the
+    global sum either way. pp×sp: the sequence dim shards over sp inside
+    the pipeline — each stage tick rings its attention over the sp
+    neighbors. Returns (stream_spec, psum_axes, seq_sharded, specs,
+    manual)."""
+    from .mesh import BATCH_AXES
+
     n_stages = mesh.shape[axis_name]
-    M = num_microbatches
     if M % n_stages:
         raise ValueError(f"num_microbatches={M} must divide over "
                          f"pp={n_stages}")
     if cfg.num_layers % n_stages:
         raise ValueError(f"num_layers={cfg.num_layers} must divide over "
                          f"pp={n_stages}")
-    # The microbatch dim shards over the data axes whenever it divides, so
-    # pp×dp genuinely splits the work (each dp rank pipelines its own slice
-    # of every microbatch); otherwise it replicates (tiny test shapes).
-    # The loss psum then spans pp AND the sharded data axes — the total is
-    # the global sum either way.
-    from .mesh import BATCH_AXES
-
+    if masked and cfg.causal:
+        raise ValueError("pipeline_mlm_loss needs a causal=False "
+                         "(MaskedLM) config")
     data_deg = math.prod(mesh.shape[a] for a in BATCH_AXES)
     shard_mb = data_deg > 1 and tokens.shape[1] % data_deg == 0
-    # pp×sp: the sequence dim shards over sp inside the pipeline — each
-    # stage tick rings its attention over the sp neighbors
     sp_deg = dict(mesh.shape).get("sp", 1)
     seq_sharded = sp_deg > 1
     if seq_sharded:
@@ -393,12 +465,30 @@ def pipeline_lm_loss(cfg, pp_params, tokens, targets, mesh: Mesh,
     stream_spec = P(axis_name, mb_axis, seq_axis)
     psum_axes = (axis_name,) + (tuple(BATCH_AXES) if shard_mb else ()) \
         + (("sp",) if seq_sharded else ())
+    # stacked blocks shard over pp; every other leaf (embeddings, norms,
+    # the MLM head when masked) replicates
     specs = {
-        "wte": P(), "wpe": P(),
-        "blocks": jax.tree.map(lambda _: P(axis_name),
-                               pp_params["blocks"]),
-        "ln_f": jax.tree.map(lambda _: P(), pp_params["ln_f"]),
+        k: (jax.tree.map(lambda _: P(axis_name), v) if k == "blocks"
+            else jax.tree.map(lambda _: P(), v))
+        for k, v in pp_params.items()
     }
+    manual = frozenset(a for a in mesh.axis_names if a != "tp")
+    return stream_spec, psum_axes, seq_sharded, specs, manual
+
+
+def pipeline_lm_loss(cfg, pp_params, tokens, targets, mesh: Mesh,
+                     num_microbatches: int, axis_name: str = "pp"):
+    """Mean next-token cross-entropy of a pp-stage-sliced CausalLM.
+
+    cfg — TransformerConfig; cfg.num_layers must divide over pp.
+    pp_params — stack_lm_params() layout; blocks sharded over pp.
+    tokens/targets — [M, microbatch, S] int32, sharded over pp on M.
+    Equals models.CausalLM.apply + lm_loss on the same (restacked) params;
+    see tests/test_parallel.py::TestPipelineLM."""
+    M = num_microbatches
+    stream_spec, psum_axes, seq_sharded, specs, manual = \
+        _pipeline_stream_setup(cfg, mesh, pp_params, tokens, M, axis_name,
+                               masked=False)
     # check_vma=False: differentiating through lax.cond inside shard_map
     # trips a JAX varying-manual-axes bookkeeping bug (the residuals of the
     # two branches get different inferred variance); the error message
@@ -409,19 +499,46 @@ def pipeline_lm_loss(cfg, pp_params, tokens, targets, mesh: Mesh,
     # only the manual axes, and when the caller placed the block params
     # with lm_stage_tp_specs, GSPMD partitions each stage tick over tp —
     # the Megatron column/row collective pair inside the pipeline for free.
-    manual = frozenset(a for a in mesh.axis_names if a != "tp")
     fn = shard_map(
         functools.partial(_lm_pipeline_local, cfg, axis_name, M, psum_axes,
-                          seq_sharded),
+                          seq_sharded, False),
         mesh=mesh,
         in_specs=(specs, stream_spec, stream_spec),
-        out_specs=P(),
+        out_specs=(P(), P()),
         axis_names=manual,
         check_vma=False,
     )
-    loss_sum = fn(pp_params, tokens, targets)
+    loss_sum, _ = fn(pp_params, tokens, targets)
     return loss_sum / (tokens.shape[0] * tokens.shape[1] * tokens.shape[2])
 
 
+def pipeline_mlm_loss(cfg, pp_params, tokens, targets, mask, mesh: Mesh,
+                      num_microbatches: int, axis_name: str = "pp"):
+    """Masked-LM (BERT) cross-entropy over the MASKED positions of a
+    pp-stage-sliced MaskedLM — the same GPipe schedule as
+    pipeline_lm_loss with a float mask stream riding the relays and the
+    MLM transform head on the last stage. Equals models.MaskedLM.apply +
+    lm_loss(logits, targets, mask) on the same (stack_mlm_params)
+    params; the divisor is the DYNAMIC global mask count, psummed with
+    the loss."""
+    M = num_microbatches
+    stream_spec, psum_axes, seq_sharded, specs, manual = \
+        _pipeline_stream_setup(cfg, mesh, pp_params, tokens, M, axis_name,
+                               masked=True)
+    fn = shard_map(
+        functools.partial(_lm_pipeline_local, cfg, axis_name, M, psum_axes,
+                          seq_sharded, True),
+        mesh=mesh,
+        in_specs=(specs, stream_spec, stream_spec, stream_spec),
+        out_specs=(P(), P()),
+        axis_names=manual,
+        check_vma=False,
+    )
+    loss_sum, cnt = fn(pp_params, tokens, targets, mask)
+    # exact lm_loss parity: denom = max(global mask count, 1)
+    return loss_sum / jnp.maximum(cnt, 1.0)
+
+
 __all__ = ["pipeline_apply", "stack_stage_params", "stack_lm_params",
-           "lm_stage_tp_specs", "pipeline_lm_loss", "bubble_fraction"]
+           "stack_mlm_params", "lm_stage_tp_specs", "pipeline_lm_loss",
+           "pipeline_mlm_loss", "bubble_fraction"]

@@ -27,9 +27,10 @@ from flax import struct
 from flax.core import meta
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from ..models.transformer import CausalLM, TransformerConfig
+from ..models.transformer import CausalLM, MaskedLM, TransformerConfig
 from ..parallel.pipeline import (bubble_fraction, pipeline_lm_loss,
-                                 stack_lm_params)
+                                 pipeline_mlm_loss, stack_lm_params,
+                                 stack_mlm_params)
 from ..utils import flops
 from .lm_trainer import LMTrainerConfig, _opt_shardings, make_adamw
 
@@ -69,6 +70,22 @@ class PipelineLMTrainer:
                              "(virtual stages are a 1F1B concept)")
         self.schedule = schedule
         self.interleave = interleave
+        # masked LM (BERT family): GPipe only — the mask stream and the
+        # MLM transform head live in pipeline_mlm_loss; 1F1B's in-schedule
+        # vjp stays causal-only
+        self.masked = bool(self.config.masked_lm)
+        if self.masked and schedule != "gpipe":
+            raise ValueError("masked_lm composes with schedule='gpipe' "
+                             "only")
+        if self.masked and cfg.causal:
+            raise ValueError("masked_lm needs a causal=False (MaskedLM) "
+                             "config")
+        if not self.masked and not cfg.causal:
+            # next-token xent over a bidirectional model would leak every
+            # future token — loss collapses while learning a degenerate
+            # copy objective; refuse the mispairing loudly
+            raise ValueError("a causal=False (bert) config needs "
+                             "LMTrainerConfig(masked_lm=True)")
         if cfg.pos_embedding != "learned":
             raise ValueError(
                 f"the pipeline trainer supports learned-position models "
@@ -145,10 +162,12 @@ class PipelineLMTrainer:
             lambda leaf, spec: NamedSharding(
                 self.mesh, _divisible_spec(self.mesh, spec, leaf.shape)),
             params["blocks"], tp_specs)
-        return {"wte": self.replicated, "wpe": self.replicated,
-                "blocks": blocks_sh,
-                "ln_f": jax.tree.map(lambda _: self.replicated,
-                                     params["ln_f"])}
+        # everything outside the stacked blocks replicates (embeddings,
+        # norms, the MLM head leaves when masked)
+        out = {k: jax.tree.map(lambda _: self.replicated, v)
+               for k, v in params.items() if k != "blocks"}
+        out["blocks"] = blocks_sh
+        return out
 
     def init_state(self, rng: jax.Array) -> PPTrainState:
         import dataclasses
@@ -157,12 +176,14 @@ class PipelineLMTrainer:
         # init on the dense twin: the attention impl owns no params, and
         # "ring" (the pp×sp stage body) refuses to trace outside a live
         # sp axis — which init legitimately is
-        model = CausalLM(dataclasses.replace(cfg, attention="dense"))
+        family = MaskedLM if self.masked else CausalLM
+        stack = stack_mlm_params if self.masked else stack_lm_params
+        model = family(dataclasses.replace(cfg, attention="dense"))
         dummy = jnp.zeros((2, self.config.seq_len), jnp.int32)
 
         def init_all(rng):
             variables = meta.unbox(model.init(rng, dummy))
-            params = stack_lm_params(variables["params"], cfg.num_layers)
+            params = stack(variables["params"], cfg.num_layers)
             if self.schedule == "1f1b" and self.interleave > 1:
                 # 1F1B virtual stages: device-major chunk layout so a
                 # plain pp sharding hands each device its chunk stack
@@ -229,8 +250,14 @@ class PipelineLMTrainer:
         """Back to this trainer's live layout after a restore."""
         return self._permute_state(state, to_canonical=False)
 
-    def _step_fn(self, state: PPTrainState, tokens, targets):
-        if self.schedule == "1f1b":
+    def _step_fn(self, state: PPTrainState, tokens, targets, mask=None):
+        if self.masked:
+            def loss_fn(params):
+                return pipeline_mlm_loss(self.cfg, params, tokens, targets,
+                                         mask, self.mesh,
+                                         self.num_microbatches)
+            loss, grads = jax.value_and_grad(loss_fn)(state.params)
+        elif self.schedule == "1f1b":
             # 1F1B computes grads IN-SCHEDULE (backward ticks interleave
             # with forwards), so no outer jax.grad
             from ..parallel.pipeline_1f1b import pipeline_lm_1f1b_grads
@@ -252,20 +279,26 @@ class PipelineLMTrainer:
     def compile_step(self):
         if self._step is None:
             assert self._state_shardings is not None, "call init_state first"
+            n_streams = 3 if self.masked else 2
             self._step = jax.jit(
                 self._step_fn,
-                in_shardings=(self._state_shardings, self.batch_sharding,
-                              self.batch_sharding),
+                in_shardings=(self._state_shardings,)
+                + (self.batch_sharding,) * n_streams,
                 out_shardings=(self._state_shardings, self.replicated),
                 donate_argnums=(0,),
             )
         return self._step
 
-    def train_step(self, state, tokens, targets):
-        """tokens/targets: [M, microbatch, S] int32."""
+    def train_step(self, state, tokens, targets, mask=None):
+        """tokens/targets (+ float mask when masked): [M, microbatch, S]."""
+        if self.masked:
+            if mask is None:
+                raise ValueError("masked_lm train_step needs the mask "
+                                 "stream")
+            return self.compile_step()(state, tokens, targets, mask)
         return self.compile_step()(state, tokens, targets)
 
-    def microbatch(self, tokens, targets):
+    def microbatch(self, tokens, targets, mask=None):
         """Reshape a flat [B, S] batch into the [M, B/M, S] stream. For
         host arrays (synthetic streams) the jitted step's in_shardings do
         the placement. Device-committed flat batches should NOT come
@@ -275,8 +308,11 @@ class PipelineLMTrainer:
         stream pre-placed (benchmark() accepts it directly)."""
         M = self.num_microbatches
         B, S = tokens.shape
-        return (tokens.reshape(M, B // M, S),
-                targets.reshape(M, B // M, S))
+        out = (tokens.reshape(M, B // M, S),
+               targets.reshape(M, B // M, S))
+        if mask is not None:
+            out = out + (mask.reshape(M, B // M, S),)
+        return out
 
     # -- benchmark loop -----------------------------------------------------
 
@@ -290,23 +326,21 @@ class PipelineLMTrainer:
         async checkpointing, train/checkpoint.periodic_saver)."""
         cfg = self.config
 
-        def prepare(toks, tgts):
-            if toks.ndim == 2:
-                return self.microbatch(toks, tgts)
-            return toks, tgts
+        def prepare(batch):
+            if batch[0].ndim == 2:
+                return self.microbatch(*batch)
+            return batch
 
         it = iter(dataset)
         step = self.compile_step()
         for _ in range(max(1, warmup_steps)):
-            toks, tgts = next(it)
-            state, metrics = step(state, *prepare(toks, tgts))
+            state, metrics = step(state, *prepare(next(it)))
         float(metrics["loss"])
         base_step = int(state.step)      # one host read, OUTSIDE the loop
         tokens_per_step = cfg.global_batch_size * cfg.seq_len
         t0 = time.perf_counter()
         for i in range(1, num_steps + 1):
-            toks, tgts = next(it)
-            state, metrics = step(state, *prepare(toks, tgts))
+            state, metrics = step(state, *prepare(next(it)))
             if step_hook is not None:
                 step_hook(state, base_step + i)
         final_loss = float(metrics["loss"])         # host read barrier
