@@ -84,6 +84,13 @@ def main() -> None:
                         choices=["bfloat16", "float32"])
     parser.add_argument("--smoke", action="store_true",
                         help="tiny CPU config for CI/verification")
+    parser.add_argument("--budget-seconds", type=int, default=2400,
+                        help="wall-clock budget for the --workload all "
+                             "ladder: once exceeded, remaining legs are "
+                             "marked *_skipped instead of running, so "
+                             "the JSON record always lands inside the "
+                             "driver's timeout (legs run most-important "
+                             "first)")
     args = parser.parse_args()
 
     if args.smoke:
@@ -214,10 +221,13 @@ def main() -> None:
                                          kv_cache_dtype="int8", batch=32)),
     )
 
-    def run_decode_legs(line):
+    def run_decode_legs(line, skip_check=None):
         # per-leg isolation everywhere decode runs: a late leg's OOM must
-        # not discard the numbers measured minutes earlier
+        # not discard the numbers measured minutes earlier; skip_check
+        # (the --workload all wall-clock budget) may drop trailing legs
         for prefix, dkw in DECODE_LEGS:
+            if skip_check is not None and skip_check(prefix):
+                continue
             try:
                 decode_fields(line, prefix, **dkw)
             except Exception as exc:  # noqa: BLE001
@@ -311,6 +321,17 @@ def main() -> None:
         # between legs drops the previous executables' HBM residue
         # (measured: ~3pp MFU on the long-seq leg).
 
+        import time as _time
+        ladder_t0 = _time.perf_counter()
+
+        def over_budget(prefix):
+            if _time.perf_counter() - ladder_t0 <= args.budget_seconds:
+                return False
+            print(f"# {prefix} leg skipped: ladder wall-clock budget "
+                  f"({args.budget_seconds}s) exhausted", file=sys.stderr)
+            line[f"{prefix}_skipped"] = "budget"
+            return True
+
         def clear_residue():
             # drop compiled executables AND collect reference cycles
             # (trainer objects hold their jitted steps through bound
@@ -321,6 +342,8 @@ def main() -> None:
             jax.clear_caches()
 
         def lm_leg(prefix, **kw):
+            if over_budget(prefix):
+                return
             try:
                 clear_residue()
                 m = run_lm(**kw)
@@ -336,7 +359,7 @@ def main() -> None:
                       file=sys.stderr)
                 line[f"{prefix}_error"] = type(exc).__name__
 
-        steps = min(args.steps, 30)
+        steps = min(args.steps, 20)
         warm = min(args.warmup, 3)
         # BASELINE configs[2-4] ladder: GPT-2, BERT-large-class, llama
         lm_leg("gpt2", workload="gpt2", steps=steps, warmup=warm)
@@ -346,39 +369,42 @@ def main() -> None:
         # MoE: expert-capacity dispatch on one chip — MFU + drop rate
         lm_leg("moe", workload="gpt2",
                size=None if args.smoke else "small",
-               steps=min(args.steps, 20), warmup=warm, batch=16,
+               steps=steps, warmup=warm, batch=16,
                moe_experts=8)
         # long-context legs (VERDICT r02 next #5 + r03 next #1): tuned
         # configs — no remat, the kernel's 1024-tile auto policy
-        lm_leg("gpt2_seq2048", workload="gpt2", steps=min(args.steps, 20),
+        lm_leg("gpt2_seq2048", workload="gpt2", steps=steps,
                warmup=warm, batch=4, seq=2048)
         lm_leg("gpt2_seq4096", workload="gpt2", steps=min(args.steps, 15),
                warmup=warm, batch=2, seq=4096)
-        # ViT-B/16 (BASELINE configs[5] single-chip point; the multi-slice
-        # variant is the dryrun's dcn leg)
-        try:
-            clear_residue()
-            from mpi_operator_tpu.examples.lm_benchmark import (
-                run_vit_benchmark)
-            _vs, vm = retry_infra_once(lambda: run_vit_benchmark(
-                size="test" if args.smoke else "b16",
-                batch_per_device=2 if args.smoke else 256,
-                image_size=32 if args.smoke else args.image_size,
-                num_steps=steps, warmup_steps=warm,
-                dtype_name=args.dtype,
-                log=lambda s: print(s, file=sys.stderr)))
-            del _vs
-            line["vit_images_per_sec"] = round(vm["images_per_sec"], 1)
-            line.update({f"vit_{k}": v for k, v in mfu_fields(vm).items()})
-        except Exception as exc:  # noqa: BLE001
-            print(f"# vit bench leg failed: {exc!r}", file=sys.stderr)
-            line["vit_error"] = type(exc).__name__
         # the SAME decode suite as --workload generate (incl. both b32
         # sweep points) — the driver records only this default run, so a
         # leg measured in one mode but not here would be effectively
-        # unmeasured
+        # unmeasured. Runs BEFORE vit so the MBU roofline record survives
+        # a budget squeeze.
         clear_residue()
-        run_decode_legs(line)
+        run_decode_legs(line, skip_check=over_budget)
+        # ViT-B/16 (BASELINE configs[5] single-chip point; the multi-slice
+        # variant is the dryrun's dcn leg)
+        if not over_budget("vit"):
+            try:
+                clear_residue()
+                from mpi_operator_tpu.examples.lm_benchmark import (
+                    run_vit_benchmark)
+                _vs, vm = retry_infra_once(lambda: run_vit_benchmark(
+                    size="test" if args.smoke else "b16",
+                    batch_per_device=2 if args.smoke else 256,
+                    image_size=32 if args.smoke else args.image_size,
+                    num_steps=steps, warmup_steps=warm,
+                    dtype_name=args.dtype,
+                    log=lambda s: print(s, file=sys.stderr)))
+                del _vs
+                line["vit_images_per_sec"] = round(vm["images_per_sec"], 1)
+                line.update({f"vit_{k}": v
+                             for k, v in mfu_fields(vm).items()})
+            except Exception as exc:  # noqa: BLE001
+                print(f"# vit bench leg failed: {exc!r}", file=sys.stderr)
+                line["vit_error"] = type(exc).__name__
     print(json.dumps(line))
 
 

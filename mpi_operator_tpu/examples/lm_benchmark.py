@@ -167,9 +167,6 @@ def run_lm_benchmark(
             raise ValueError("--accum-steps is redundant with --pp: the "
                              "pipeline trainer already streams "
                              "microbatches; drop the flag")
-        if eval_steps:
-            raise ValueError("--eval-steps is not wired into the pipeline "
-                             "trainer; drop one of the flags")
         from ..train.pp_trainer import PipelineLMTrainer
         if n % (pp * tp * sp * num_slices):
             raise ValueError(f"{n} devices not divisible by pp={pp} × "
@@ -257,6 +254,15 @@ def run_lm_benchmark(
                 pp_state, pp_stream, num_steps=num_steps,
                 warmup_steps=warmup_steps, log=log,
                 step_hook=canonical_hook)
+            if eval_steps:
+                # held-out evaluation continues the stream past the
+                # trained batches (same contract as the unpiped path)
+                ev = pp_trainer.evaluate(pp_state, pp_stream,
+                                         num_batches=eval_steps)
+                pp_metrics.update(ev)
+                log(f"val_loss: {ev['val_loss']:.3f}  "
+                    f"perplexity: {ev['perplexity']:.1f}  "
+                    f"({eval_steps} batches)")
         finally:
             pp_stream.close()
         maybe_save(train_dir, pp_trainer.canonical_state(pp_state), log)

@@ -137,6 +137,7 @@ class PipelineLMTrainer:
                     "sp" if self.sp > 1 else None))
         self.replicated = NamedSharding(mesh, P())
         self._step = None
+        self._eval_step = None
         self._state_shardings = None
 
     @property
@@ -313,6 +314,52 @@ class PipelineLMTrainer:
         if mask is not None:
             out = out + (mask.reshape(M, B // M, S),)
         return out
+
+    # -- evaluation ---------------------------------------------------------
+
+    def compile_eval_step(self):
+        """Loss-only pipeline pass (no grads, no optimizer, state NOT
+        donated) — the pp analogue of LMTrainer.eval_step."""
+        if self._eval_step is None:
+            assert self._state_shardings is not None, "call init_state first"
+
+            def eval_fn(params, tokens, targets, mask=None):
+                if self.masked:
+                    return pipeline_mlm_loss(
+                        self.cfg, params, tokens, targets, mask,
+                        self.mesh, self.num_microbatches)
+                return pipeline_lm_loss(
+                    self.cfg, params, tokens, targets, self.mesh,
+                    self.num_microbatches)
+
+            n_streams = 3 if self.masked else 2
+            # params only (LMTrainer.compile_eval symmetry): the loss
+            # never reads the optimizer state, so don't plumb it through
+            self._eval_step = jax.jit(
+                eval_fn,
+                in_shardings=(self._state_shardings.params,)
+                + (self.batch_sharding,) * n_streams,
+                out_shardings=self.replicated,
+            )
+        return self._eval_step
+
+    def evaluate(self, state, dataset, num_batches: int = 10
+                 ) -> Dict[str, float]:
+        """Mean held-out loss + perplexity over `num_batches` batches —
+        same contract as LMTrainer.evaluate, same stream shapes as the
+        training loop (flat [B, S] pairs are microbatched here)."""
+        import math
+
+        step = self.compile_eval_step()
+        total = 0.0
+        it = iter(dataset)
+        for _ in range(num_batches):
+            batch = next(it)
+            if batch[0].ndim == 2:
+                batch = self.microbatch(*batch)
+            total += float(step(state.params, *batch))
+        mean = total / max(1, num_batches)
+        return {"val_loss": mean, "perplexity": math.exp(min(mean, 30.0))}
 
     # -- benchmark loop -----------------------------------------------------
 

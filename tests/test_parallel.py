@@ -391,6 +391,37 @@ class TestPipelineLM:
                 np.asarray(a), np.asarray(b), atol=3e-4,
                 err_msg=jax.tree_util.keystr(path))
 
+    def test_pp_trainer_evaluate(self):
+        """The pp loss-only eval pass: val_loss at the current params
+        equals the loss the next train_step reports (train computes loss
+        BEFORE applying the update), and perplexity = exp(val_loss)."""
+        import math as _math
+
+        from mpi_operator_tpu.train.lm_trainer import LMTrainerConfig
+        from mpi_operator_tpu.train.pp_trainer import PipelineLMTrainer
+
+        cfg = gpt2_config("test", attention="dense", dtype=jnp.float32,
+                          vocab_size=128, max_len=16, num_layers=2)
+        mesh = make_mesh(MeshConfig(pp=2, dp=4))
+        trainer = PipelineLMTrainer(
+            cfg, mesh, LMTrainerConfig(global_batch_size=16, seq_len=16,
+                                       warmup_steps=1),
+            num_microbatches=4)
+        state = trainer.init_state(jax.random.PRNGKey(0))
+        toks = jax.random.randint(jax.random.PRNGKey(1), (16, 17), 0, 128)
+        batch = trainer.microbatch(toks[:, :-1], toks[:, 1:])
+
+        class Rep:
+            def __iter__(self):
+                return iter([batch] * 4)
+
+        ev = trainer.evaluate(state, Rep(), num_batches=1)
+        _, m = trainer.train_step(state, *batch)
+        np.testing.assert_allclose(ev["val_loss"], float(m["loss"]),
+                                   atol=1e-5)
+        assert ev["perplexity"] == pytest.approx(
+            _math.exp(ev["val_loss"]), rel=1e-6)
+
     def test_masked_pp_trainer_step(self):
         """End-to-end pipelined BERT through PipelineLMTrainer
         (masked_lm=True): jitted step over the 3-stream (tokens, targets,
