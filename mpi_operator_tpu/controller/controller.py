@@ -526,6 +526,16 @@ class TPUJobController:
             # Returning (instead of raising) makes process_next_work_item
             # forget the key; the Warning Event + condition tell the user
             # why nothing is running.
+            if terminal:
+                # ... but NEVER for a job that already finished: editing a
+                # terminally-Failed/Succeeded job's spec invalid must not
+                # overwrite its terminal condition with the level-triggered
+                # InvalidTPUJobSpec reason (a later spec fix would clear
+                # that and resurrect the job despite restartPolicy Never).
+                # The terminal record wins; the bad spec is inert.
+                logger.info("tpujob '%s' is terminal; ignoring invalid "
+                            "spec edit: %s", key, exc)
+                return
             self._fail_invalid_spec(job, str(exc), launcher)
             return
         if invalid_spec and not done:
@@ -701,6 +711,16 @@ class TPUJobController:
                 f"restoring to spec size tpus={job.spec.tpus}")
             return job
         self._elastic_ready_since.pop(jkey, None)   # continuity broken
+        if job.status.get_condition(api.COND_RUNNING) is None:
+            # never yet Ready: a brand-new gang still scheduling/pulling
+            # images is not "lost capacity" — arming the degraded timer
+            # from the first sync would shrink a fresh job below spec
+            # before it ever ran at spec size. The Running condition is
+            # set exactly when the readiness gate first passes (launcher
+            # active), and it lives in STATUS, so this arming gate also
+            # survives operator restarts.
+            self._not_ready_since.pop(jkey, None)
+            return job
         since = self._not_ready_since.setdefault(jkey, now)
         wait = self.config.elastic_degraded_seconds - (now - since)
         if wait > 0:

@@ -52,6 +52,7 @@ def run_lm_benchmark(
     moe_experts: int = 0,
     moe_dropless: bool = False,
     ep: int = 1,
+    num_layers: Optional[int] = None,
     fused_xent: bool = False,
     flash_block_q: Optional[int] = None,
     flash_block_k: Optional[int] = None,
@@ -120,6 +121,10 @@ def run_lm_benchmark(
         overrides["flash_block_q"] = flash_block_q
     if flash_block_k:
         overrides["flash_block_k"] = flash_block_k
+    if num_layers:
+        # depth override: scaling studies + tiny pp×moe configs (the
+        # "test" presets are 2 layers, which can't tile moe_every×pp)
+        overrides["num_layers"] = num_layers
     model = create_lm(name, dtype=dtype, attention=attention, remat=remat,
                       remat_policy=remat_policy, max_len=max(seq_len, 32),
                       **overrides)
@@ -151,11 +156,12 @@ def run_lm_benchmark(
                              "gpipe only (1F1B's in-schedule vjp is "
                              "causal-only)")
         # learned-position requirement is validated by PipelineLMTrainer
-        # itself (the invariant lives there)
-        if moe_experts or ep > 1:
-            raise ValueError("--pp does not compose with --moe-experts/"
-                             "--ep yet; the stage body applies dense "
-                             "blocks only")
+        # itself (the invariant lives there); MoE composition constraints
+        # (gpipe-only, whole dense+MoE periods per stage) likewise
+        if moe_experts and pp_schedule != "gpipe":
+            raise ValueError("--pp with --moe-experts composes with "
+                             "--pp-schedule gpipe only (1F1B stage bodies "
+                             "are dense)")
         if fused_xent:
             raise ValueError("--fused-xent is not wired into the pipeline "
                              "trainer; drop one of the flags")
@@ -168,14 +174,19 @@ def run_lm_benchmark(
                              "pipeline trainer already streams "
                              "microbatches; drop the flag")
         from ..train.pp_trainer import PipelineLMTrainer
-        if n % (pp * tp * sp * num_slices):
+        if n % (pp * tp * ep * sp * num_slices):
             raise ValueError(f"{n} devices not divisible by pp={pp} × "
-                             f"tp={tp} × sp={sp} × slices={num_slices}")
-        # tp composes via GSPMD inside each stage; sp shards the stream's
-        # sequence dim and rings stage attention (train/pp_trainer.py)
-        pp_mesh = make_mesh(MeshConfig(pp=pp, tp=tp, sp=sp,
-                                       dp=n // (pp * tp * sp * num_slices),
-                                       dcn=num_slices))
+                             f"tp={tp} × ep={ep} × sp={sp} × "
+                             f"slices={num_slices}")
+        # tp composes via GSPMD inside each stage (Megatron collectives);
+        # ep likewise — the MoE stack's expert dim is PLACED over ep and
+        # the stage's dispatch einsums lower to the expert all-to-all; sp
+        # shards the stream's sequence dim and rings stage attention
+        # (train/pp_trainer.py)
+        pp_mesh = make_mesh(MeshConfig(
+            pp=pp, tp=tp, ep=ep, sp=sp,
+            dp=n // (pp * tp * ep * sp * num_slices),
+            dcn=num_slices))
         pp_trainer = PipelineLMTrainer(model.config, pp_mesh, tcfg,
                                        schedule=pp_schedule,
                                        interleave=pp_interleave)
@@ -558,6 +569,9 @@ def main(argv=None) -> int:
                              "the drop rate sown as an intermediate")
     parser.add_argument("--ep", type=int, default=1,
                         help="expert-parallel degree (shards MoE experts)")
+    parser.add_argument("--num-layers", type=int, default=0,
+                        help="override the preset's layer count (scaling "
+                             "studies; tiny pp×moe configs)")
     parser.add_argument("--accum-steps", type=int, default=1,
                         help="gradient accumulation: microbatches per "
                              "optimizer step (activation memory / N, "
@@ -642,7 +656,8 @@ def main(argv=None) -> int:
                 pp_interleave=args.pp_interleave, sp=args.sp,
                 moe_experts=args.moe_experts,
                 moe_dropless=args.moe_dropless,
-                ep=args.ep, fused_xent=args.fused_xent,
+                ep=args.ep, num_layers=args.num_layers or None,
+                fused_xent=args.fused_xent,
                 flash_block_q=args.flash_block_q or None,
                 flash_block_k=args.flash_block_k or None,
                 accum_steps=args.accum_steps,

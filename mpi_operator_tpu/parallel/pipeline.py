@@ -28,6 +28,17 @@ tick's compute. Per-stage activation residuals scale with M·L/P (each stage
 saves only its own layers' internals), which is the PP memory win.
 
 Bubble fraction is (P-1)/(M+P-1); callers pick M >= 4*P to keep it small.
+
+Known limitation (simulation only): running the pipeline with an AUTO
+axis active (tp or ep) at FULL model width (e.g. gpt2-small's
+768×50304) on virtual CPU devices can deadlock XLA:CPU's in-process
+collective rendezvous — the per-tick auto-axis all-reduces inside the
+scan race the cross-stage psum and one device trips the 40s termination
+timeout. The compiled HLO is identical to configs that pass (verified:
+narrow-vocab and narrow-embed variants run fine, as does the unpiped
+trainer at full width), so this is a host-simulation runtime artifact,
+not a sharding bug; the tiny-shape dryrun contract and real-TPU runs
+(different runtime, ICI collectives) are unaffected.
 """
 from __future__ import annotations
 
@@ -182,14 +193,21 @@ def stack_stage_params(per_stage_params):
 # Transformer integration: a stage-sliced GPT-2 with pipelined loss
 # ---------------------------------------------------------------------------
 
-def lm_stage_tp_specs(blocks, axis_name: str = "pp", tp_axis: str = "tp"):
+def lm_stage_tp_specs(blocks, axis_name: str = "pp", tp_axis: str = "tp",
+                      ep_axis: str = "ep"):
     """Megatron tensor-parallel PartitionSpecs for stack_lm_params' stacked
     block leaves: column-parallel QKV + fc_in (output dim over tp),
     row-parallel attn-out + fc_out (input dim over tp), everything else
     pp-only on the layer dim. Used by PipelineLMTrainer to PLACE the
     params; pipeline_lm_loss leaves tp to GSPMD (partial-manual shard_map)
     so the Megatron collectives appear inside each stage tick
-    automatically."""
+    automatically.
+
+    Also covers the MoE "moe" stack (stack_lm_params MoE layout): expert
+    FFN weights shard their expert dim over ep and their expert_mlp dim
+    over tp (parallel/moe.py logical axes), the router replicates — GSPMD
+    then lowers the stage's dispatch/combine einsums to the expert
+    all-to-all, again with no manual collective code."""
     def spec(path, leaf):
         ks = jax.tree_util.keystr(path)
         mlp_in = "fc_in" in ks
@@ -197,6 +215,12 @@ def lm_stage_tp_specs(blocks, axis_name: str = "pp", tp_axis: str = "tp"):
         qkv = any(k in ks for k in ("query", "key", "value"))
         attn_out = "attn" in ks and "'out'" in ks
         kernel = "kernel" in ks
+        if "w_in" in ks:                              # [L, e, E, mlp]
+            return P(axis_name, ep_axis, None, tp_axis)
+        if "w_out" in ks:                             # [L, e, mlp, E]
+            return P(axis_name, ep_axis, tp_axis, None)
+        if "router" in ks:                            # [L, E, e] — tiny
+            return P(axis_name)
         if mlp_in and kernel:
             return P(axis_name, None, tp_axis)
         if mlp_in:                                    # bias [L, mlp]
@@ -237,31 +261,63 @@ def lm_stage_head_loss(cfg, ln_f, ln_f_params, wte, y, tgt):
     return optax.softmax_cross_entropy_with_integer_labels(logits, tgt).sum()
 
 
-def stack_lm_params(params, num_layers: int):
+def _moe_layer_split(num_layers: int, num_experts: int, moe_every: int):
+    """(dense_idx, moe_idx) layer-index lists for a MoE config — the same
+    alternation Backbone builds (models/transformer.py: block i is MoE when
+    i % moe_every == moe_every - 1). Empty moe_idx for dense models."""
+    if not num_experts:
+        return list(range(num_layers)), []
+    moe_idx = [i for i in range(num_layers)
+               if i % moe_every == moe_every - 1]
+    dense_idx = [i for i in range(num_layers) if i not in set(moe_idx)]
+    return dense_idx, moe_idx
+
+
+def stack_lm_params(params, num_layers: int, num_experts: int = 0,
+                    moe_every: int = 2):
     """Restack unboxed CausalLM params (models/transformer.py) into the
     pipeline layout: blocks stacked on a leading layer dim (sharded over
-    pp), embeddings/ln_f replicated."""
+    pp), embeddings/ln_f replicated.
+
+    MoE configs (num_experts > 0): dense and MoE blocks have different
+    param trees, so they stack separately — dense blocks under "blocks"
+    [Ld, ...], MoE blocks under "moe" [Lm, ...], both in layer order and
+    both pp-sharded on dim 0. Because the alternation has period
+    `moe_every`, a stage's contiguous layer range holds contiguous rows
+    of BOTH stacks, so plain pp sharding hands each stage exactly its
+    own layers (pipeline callers enforce num_layers % (moe_every·pp)
+    == 0)."""
     bb = params["backbone"]
+    dense_idx, moe_idx = _moe_layer_split(num_layers, num_experts,
+                                          moe_every)
     blocks = jax.tree.map(
         lambda *xs: jnp.stack(xs),
-        *[bb[f"block_{i}"] for i in range(num_layers)])
-    return {
+        *[bb[f"block_{i}"] for i in dense_idx])
+    out = {
         "wte": params["wte"]["embedding"],
         "wpe": params["wpe"]["embedding"],
         "blocks": blocks,
         "ln_f": bb["ln_f"],
     }
+    if moe_idx:
+        out["moe"] = jax.tree.map(
+            lambda *xs: jnp.stack(xs),
+            *[bb[f"block_{i}"] for i in moe_idx])
+    return out
 
 
-def stack_mlm_params(params, num_layers: int):
+def stack_mlm_params(params, num_layers: int, num_experts: int = 0,
+                     moe_every: int = 2):
     """stack_lm_params for the MaskedLM (BERT) family: same stacked-block
-    core plus the MLM-specific leaves — embedding LayerNorm, token-type
-    table, and the transform head (dense+LN+bias over the tied
-    decoder)."""
+    core (incl. the separate "moe" stack for MoE configs) plus the
+    MLM-specific leaves — embedding LayerNorm, token-type table, and the
+    transform head (dense+LN+bias over the tied decoder)."""
     bb = params["backbone"]
+    dense_idx, moe_idx = _moe_layer_split(num_layers, num_experts,
+                                          moe_every)
     blocks = jax.tree.map(
         lambda *xs: jnp.stack(xs),
-        *[bb[f"block_{i}"] for i in range(num_layers)])
+        *[bb[f"block_{i}"] for i in dense_idx])
     out = {
         "wte": params["wte"]["embedding"],
         "wpe": params["wpe"]["embedding"],
@@ -272,6 +328,10 @@ def stack_mlm_params(params, num_layers: int):
         "mlm_ln": params["mlm_ln"],
         "mlm_bias": params["mlm_bias"],
     }
+    if moe_idx:
+        out["moe"] = jax.tree.map(
+            lambda *xs: jnp.stack(xs),
+            *[bb[f"block_{i}"] for i in moe_idx])
     if "wtte" in params:
         out["wtte"] = params["wtte"]["embedding"]
     return out
@@ -317,7 +377,8 @@ def _lm_pipeline_local(cfg, axis_name: str, M: int, psum_axes, seq_sharded,
 
     wte = pp_params["wte"]
     wpe = pp_params["wpe"]
-    blocks = pp_params["blocks"]         # leaves [L/P, ...]
+    blocks = pp_params["blocks"]         # leaves [Ld/P, ...]
+    moe_blocks = pp_params.get("moe")    # leaves [Lm/P, ...] (MoE configs)
     block = Block(cfg)
     ln_f = _layer_norm(cfg, "ln_f")      # the unpiped model's exact module
     pos_off = lax.axis_index("sp") * S if seq_sharded else None
@@ -332,11 +393,52 @@ def _lm_pipeline_local(cfg, axis_name: str, M: int, psum_axes, seq_sharded,
         return _layer_norm(cfg, "ln_emb").apply(
             {"params": pp_params["ln_emb"]}, h)
 
-    def stage_apply(h):
-        def body(h, layer_params):
-            return block.apply({"params": layer_params}, h), None
-        h, _ = lax.scan(body, h, blocks)
-        return h
+    if moe_blocks is None:
+        def stage_apply(h):
+            def body(h, layer_params):
+                return block.apply({"params": layer_params}, h), None
+            h, _ = lax.scan(body, h, blocks)
+            z = jnp.zeros((), jnp.float32)
+            return h, z, z
+    else:
+        # MoE stage body: this stage's layers alternate with period
+        # moe_every — (moe_every-1) dense blocks then one MoE block. The
+        # dense stack reshapes LOCALLY (free inside shard_map; the stored
+        # layout stays the flat [Ld, ...] the spec tables know) into
+        # [periods, moe_every-1, ...] and a scan over periods applies the
+        # run of dense blocks then the MoE block, collecting the
+        # load-balance aux loss (differentiated — part of the objective)
+        # and the sown drop rate (observable, parallel/moe.py).
+        moe_block = Block(cfg, use_moe=True)
+        n_periods = jax.tree.leaves(moe_blocks)[0].shape[0]
+
+        def stage_apply(h):
+            per_dense = jax.tree.map(
+                lambda leaf: leaf.reshape((n_periods, cfg.moe_every - 1)
+                                          + leaf.shape[1:]),
+                blocks)
+
+            def period(h, xs):
+                dense_p, moe_p = xs
+
+                def body(hh, lp):
+                    return block.apply({"params": lp}, hh), None
+                h, _ = lax.scan(body, h, dense_p)
+                # "diagnostics" carries the drop rate; sow() to an
+                # immutable collection is a silent no-op, so listing it
+                # here is what makes the rate observable in the pp path
+                h, mut = moe_block.apply(
+                    {"params": moe_p}, h,
+                    mutable=["intermediates", "diagnostics"])
+                aux = sum(jnp.asarray(a).mean() for a in
+                          jax.tree.leaves(mut.get("intermediates", {})))
+                drop = sum(jnp.asarray(d).mean() for d in
+                           jax.tree.leaves(mut.get("diagnostics", {})))
+                return h, (jnp.asarray(aux, jnp.float32),
+                           jnp.asarray(drop, jnp.float32))
+
+            h, (auxs, drops) = lax.scan(period, h, (per_dense, moe_blocks))
+            return h, auxs.sum(), drops.sum()
 
     if masked:
         def head_loss(y, tgt, msk):
@@ -375,11 +477,17 @@ def _lm_pipeline_local(cfg, axis_name: str, M: int, psum_axes, seq_sharded,
     zero = _vma_zero(blocks, jnp.float32)
 
     def tick(carry, tau):
-        r_tok, r_tgt, r_msk, act, tgt, msk, loss_sum, cnt_sum = carry
+        (r_tok, r_tgt, r_msk, act, tgt, msk, loss_sum, cnt_sum,
+         aux_sum, drop_sum) = carry
         cur_h = jnp.where(stage == 0, embed(r_tok), act)
         cur_t = jnp.where(stage == 0, r_tgt, tgt)
         cur_m = jnp.where(stage == 0, r_msk, msk)
-        y = stage_apply(cur_h)
+        y, aux_t, drop_t = stage_apply(cur_h)
+        # MoE bookkeeping counts only VALID ticks — stage s computes real
+        # microbatch m at tick tau = m + s, garbage during fill/drain
+        valid = ((tau >= stage) & (tau < stage + M)).astype(jnp.float32)
+        aux_sum = aux_sum + aux_t * valid
+        drop_sum = drop_sum + drop_t * valid
         do_loss = (stage == n_stages - 1) & (tau >= n_stages - 1)
         # the false branch's zeros must carry the same pp-variance as the
         # real loss or cond rejects the branches as differently typed
@@ -399,7 +507,8 @@ def _lm_pipeline_local(cfg, axis_name: str, M: int, psum_axes, seq_sharded,
         else:
             msk = cur_m
         r_tok, r_tgt, r_msk = inject(r_tok, r_tgt, r_msk, tau)
-        return (r_tok, r_tgt, r_msk, act, tgt, msk, loss_sum, cnt_sum), None
+        return (r_tok, r_tgt, r_msk, act, tgt, msk, loss_sum, cnt_sum,
+                aux_sum, drop_sum), None
 
     r_tok0 = tokens_local[0]
     r_tgt0 = targets_local[0]
@@ -409,10 +518,11 @@ def _lm_pipeline_local(cfg, axis_name: str, M: int, psum_axes, seq_sharded,
         + zero.astype(cfg.dtype)
     z32 = jnp.zeros((), jnp.float32) + zero
     carry0 = (r_tok0, r_tgt0, r_msk0, act0, r_tgt0,
-              r_msk0 + zero.astype(r_msk0.dtype), z32, z32)
-    (_, _, _, _, _, _, loss_sum, cnt_sum), _ = lax.scan(
+              r_msk0 + zero.astype(r_msk0.dtype), z32, z32, z32, z32)
+    (_, _, _, _, _, _, loss_sum, cnt_sum, aux_sum, drop_sum), _ = lax.scan(
         tick, carry0, jnp.arange(T))
-    return lax.psum(loss_sum, psum_axes), lax.psum(cnt_sum, psum_axes)
+    return (lax.psum(loss_sum, psum_axes), lax.psum(cnt_sum, psum_axes),
+            lax.psum(aux_sum, psum_axes), lax.psum(drop_sum, psum_axes))
 
 
 def _pipeline_stream_setup(cfg, mesh, pp_params, tokens, M,
@@ -465,26 +575,71 @@ def _pipeline_stream_setup(cfg, mesh, pp_params, tokens, M,
     stream_spec = P(axis_name, mb_axis, seq_axis)
     psum_axes = (axis_name,) + (tuple(BATCH_AXES) if shard_mb else ()) \
         + (("sp",) if seq_sharded else ())
-    # stacked blocks shard over pp; every other leaf (embeddings, norms,
-    # the MLM head when masked) replicates
+    # stacked blocks (dense AND moe stacks) shard over pp; every other
+    # leaf (embeddings, norms, the MLM head when masked) replicates
     specs = {
-        k: (jax.tree.map(lambda _: P(axis_name), v) if k == "blocks"
+        k: (jax.tree.map(lambda _: P(axis_name), v)
+            if k in ("blocks", "moe")
             else jax.tree.map(lambda _: P(), v))
         for k, v in pp_params.items()
     }
-    manual = frozenset(a for a in mesh.axis_names if a != "tp")
+    # tp AND ep stay AUTO axes (partial-manual shard_map): placement via
+    # lm_stage_tp_specs activates them, and GSPMD partitions each stage
+    # tick — Megatron collectives over tp, the MoE dispatch/combine
+    # einsums lowering to the expert all-to-all over ep — with no manual
+    # collective code in the schedule.
+    manual = frozenset(a for a in mesh.axis_names if a not in ("tp", "ep"))
     return stream_spec, psum_axes, seq_sharded, specs, manual
 
 
+def _finalize_moe(loss, aux_sum, drop_sum, pp_params, mesh, M, psum_axes,
+                  moe_aux_weight, with_moe_metrics):
+    """Shared epilogue of pipeline_lm_loss / pipeline_mlm_loss: fold the
+    psummed MoE aux into the objective and shape the return value — ONE
+    definition so the normalization can't drift between the causal and
+    masked entry points.
+
+    The psummed sums cover M microbatches × the full Lm block stack (the
+    pp psum re-joins the per-stage stacks) × one term per data/sp shard
+    in the psum (psum_axes encodes exactly which axes contributed). The
+    aux term is moe_aux_weight × Σ_blocks mean-per-application aux —
+    LMTrainer's convention (sum over blocks, mean over router
+    applications)."""
+    if "moe" not in pp_params:
+        return (loss, {}) if with_moe_metrics else loss
+    from .mesh import BATCH_AXES
+    n_periods = jax.tree.leaves(pp_params["moe"])[0].shape[0]
+    factor = 1
+    for a in psum_axes:
+        if a in BATCH_AXES or a == "sp":
+            factor *= mesh.shape[a]
+    aux = aux_sum / (M * factor)
+    loss = loss + moe_aux_weight * aux
+    if with_moe_metrics:
+        return loss, {"moe_aux": aux,
+                      "moe_drop_rate": drop_sum / (M * n_periods * factor)}
+    return loss
+
+
 def pipeline_lm_loss(cfg, pp_params, tokens, targets, mesh: Mesh,
-                     num_microbatches: int, axis_name: str = "pp"):
+                     num_microbatches: int, axis_name: str = "pp",
+                     moe_aux_weight: float = 0.01,
+                     with_moe_metrics: bool = False):
     """Mean next-token cross-entropy of a pp-stage-sliced CausalLM.
 
     cfg — TransformerConfig; cfg.num_layers must divide over pp.
     pp_params — stack_lm_params() layout; blocks sharded over pp.
     tokens/targets — [M, microbatch, S] int32, sharded over pp on M.
     Equals models.CausalLM.apply + lm_loss on the same (restacked) params;
-    see tests/test_parallel.py::TestPipelineLM."""
+    see tests/test_parallel.py::TestPipelineLM.
+
+    MoE configs (pp_params has a "moe" stack): the load-balance aux term
+    joins the objective as moe_aux_weight × Σ_blocks mean-per-application
+    aux — the router means are per (microbatch, data shard), the GShard
+    granularity, vs the unpiped trainer's full-batch means (exactly equal
+    in dropless mode on identical token sets; capacity mode budgets per
+    microbatch, which is the at-scale semantics). with_moe_metrics=True
+    additionally returns {"moe_aux", "moe_drop_rate"}."""
     M = num_microbatches
     stream_spec, psum_axes, seq_sharded, specs, manual = \
         _pipeline_stream_setup(cfg, mesh, pp_params, tokens, M, axis_name,
@@ -495,25 +650,30 @@ def pipeline_lm_loss(cfg, pp_params, tokens, targets, mesh: Mesh,
     # itself prescribes this workaround. Correctness is pinned by the
     # grads-vs-unpiped parity test (tests/test_parallel.py TestPipelineLM).
     #
-    # tp stays an AUTO axis (partial-manual shard_map): in_specs describe
+    # tp/ep stay AUTO axes (partial-manual shard_map): in_specs describe
     # only the manual axes, and when the caller placed the block params
     # with lm_stage_tp_specs, GSPMD partitions each stage tick over tp —
-    # the Megatron column/row collective pair inside the pipeline for free.
+    # the Megatron column/row collective pair inside the pipeline for free
+    # (and the MoE dispatch all-to-all over ep likewise).
     fn = shard_map(
         functools.partial(_lm_pipeline_local, cfg, axis_name, M, psum_axes,
                           seq_sharded, False),
         mesh=mesh,
         in_specs=(specs, stream_spec, stream_spec),
-        out_specs=(P(), P()),
+        out_specs=(P(), P(), P(), P()),
         axis_names=manual,
         check_vma=False,
     )
-    loss_sum, _ = fn(pp_params, tokens, targets)
-    return loss_sum / (tokens.shape[0] * tokens.shape[1] * tokens.shape[2])
+    loss_sum, _, aux_sum, drop_sum = fn(pp_params, tokens, targets)
+    loss = loss_sum / (tokens.shape[0] * tokens.shape[1] * tokens.shape[2])
+    return _finalize_moe(loss, aux_sum, drop_sum, pp_params, mesh, M,
+                         psum_axes, moe_aux_weight, with_moe_metrics)
 
 
 def pipeline_mlm_loss(cfg, pp_params, tokens, targets, mask, mesh: Mesh,
-                      num_microbatches: int, axis_name: str = "pp"):
+                      num_microbatches: int, axis_name: str = "pp",
+                      moe_aux_weight: float = 0.01,
+                      with_moe_metrics: bool = False):
     """Masked-LM (BERT) cross-entropy over the MASKED positions of a
     pp-stage-sliced MaskedLM — the same GPipe schedule as
     pipeline_lm_loss with a float mask stream riding the relays and the
@@ -530,13 +690,15 @@ def pipeline_mlm_loss(cfg, pp_params, tokens, targets, mask, mesh: Mesh,
                           seq_sharded, True),
         mesh=mesh,
         in_specs=(specs, stream_spec, stream_spec, stream_spec),
-        out_specs=(P(), P()),
+        out_specs=(P(), P(), P(), P()),
         axis_names=manual,
         check_vma=False,
     )
-    loss_sum, cnt = fn(pp_params, tokens, targets, mask)
+    loss_sum, cnt, aux_sum, drop_sum = fn(pp_params, tokens, targets, mask)
     # exact lm_loss parity: denom = max(global mask count, 1)
-    return loss_sum / jnp.maximum(cnt, 1.0)
+    loss = loss_sum / jnp.maximum(cnt, 1.0)
+    return _finalize_moe(loss, aux_sum, drop_sum, pp_params, mesh, M,
+                         psum_axes, moe_aux_weight, with_moe_metrics)
 
 
 __all__ = ["pipeline_apply", "stack_stage_params", "stack_lm_params",

@@ -100,6 +100,23 @@ class PipelineLMTrainer:
             raise ValueError(
                 f"num_layers={cfg.num_layers} must divide over "
                 f"pp×interleave={self.pp}×{self.interleave}")
+        # pp×MoE (GPipe): stages scan (dense-run, MoE-block) periods, so
+        # each stage's contiguous layer range must hold whole periods
+        self.moe = cfg.num_experts > 0
+        if self.moe:
+            if schedule != "gpipe":
+                raise ValueError("MoE composes with schedule='gpipe' only "
+                                 "(the 1F1B in-schedule vjp applies dense "
+                                 "stage bodies)")
+            if cfg.moe_every < 2:
+                raise ValueError(
+                    f"pp needs moe_every >= 2 (got {cfg.moe_every}); an "
+                    f"all-MoE stack has no dense blocks to period over")
+            if cfg.num_layers % (cfg.moe_every * self.pp):
+                raise ValueError(
+                    f"num_layers={cfg.num_layers} must divide over "
+                    f"moe_every×pp = {cfg.moe_every}×{self.pp} so every "
+                    f"stage owns whole dense+MoE periods")
         if self.config.global_batch_size % self.num_microbatches:
             raise ValueError(
                 f"global_batch_size={self.config.global_batch_size} must "
@@ -156,18 +173,25 @@ class PipelineLMTrainer:
 
         # blocks: layer dim over pp, plus Megatron tp on the mlp/attn dims
         # when tp > 1 (pipeline_lm_loss leaves tp to GSPMD, so placement IS
-        # the activation of tensor parallelism). _divisible_spec replicates
-        # any dim tp doesn't divide (tiny test configs).
-        tp_specs = lm_stage_tp_specs(params["blocks"])
-        blocks_sh = jax.tree.map(
-            lambda leaf, spec: NamedSharding(
-                self.mesh, _divisible_spec(self.mesh, spec, leaf.shape)),
-            params["blocks"], tp_specs)
+        # the activation of tensor parallelism) — and for the MoE stack
+        # the expert dim over ep, which is what makes GSPMD lower the
+        # stage's dispatch einsums to the expert all-to-all.
+        # _divisible_spec replicates any dim tp/ep doesn't divide (tiny
+        # test configs).
+        def place(tree):
+            return jax.tree.map(
+                lambda leaf, spec: NamedSharding(
+                    self.mesh, _divisible_spec(self.mesh, spec, leaf.shape)),
+                tree, lm_stage_tp_specs(tree))
+
+        stacked = ("blocks", "moe")
         # everything outside the stacked blocks replicates (embeddings,
         # norms, the MLM head leaves when masked)
         out = {k: jax.tree.map(lambda _: self.replicated, v)
-               for k, v in params.items() if k != "blocks"}
-        out["blocks"] = blocks_sh
+               for k, v in params.items() if k not in stacked}
+        for k in stacked:
+            if k in params:
+                out[k] = place(params[k])
         return out
 
     def init_state(self, rng: jax.Array) -> PPTrainState:
@@ -184,7 +208,9 @@ class PipelineLMTrainer:
 
         def init_all(rng):
             variables = meta.unbox(model.init(rng, dummy))
-            params = stack(variables["params"], cfg.num_layers)
+            params = stack(variables["params"], cfg.num_layers,
+                           num_experts=cfg.num_experts,
+                           moe_every=cfg.moe_every)
             if self.schedule == "1f1b" and self.interleave > 1:
                 # 1F1B virtual stages: device-major chunk layout so a
                 # plain pp sharding hands each device its chunk stack
@@ -252,12 +278,17 @@ class PipelineLMTrainer:
         return self._permute_state(state, to_canonical=False)
 
     def _step_fn(self, state: PPTrainState, tokens, targets, mask=None):
+        w = self.config.moe_aux_weight
+        moe_metrics = {}
         if self.masked:
             def loss_fn(params):
                 return pipeline_mlm_loss(self.cfg, params, tokens, targets,
                                          mask, self.mesh,
-                                         self.num_microbatches)
-            loss, grads = jax.value_and_grad(loss_fn)(state.params)
+                                         self.num_microbatches,
+                                         moe_aux_weight=w,
+                                         with_moe_metrics=True)
+            (loss, moe_metrics), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(state.params)
         elif self.schedule == "1f1b":
             # 1F1B computes grads IN-SCHEDULE (backward ticks interleave
             # with forwards), so no outer jax.grad
@@ -268,14 +299,17 @@ class PipelineLMTrainer:
         else:
             def loss_fn(params):
                 return pipeline_lm_loss(self.cfg, params, tokens, targets,
-                                        self.mesh, self.num_microbatches)
-            loss, grads = jax.value_and_grad(loss_fn)(state.params)
+                                        self.mesh, self.num_microbatches,
+                                        moe_aux_weight=w,
+                                        with_moe_metrics=True)
+            (loss, moe_metrics), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(state.params)
         updates, new_opt = state.tx.update(grads, state.opt_state,
                                            state.params)
         return state.replace(
             step=state.step + 1,
             params=optax.apply_updates(state.params, updates),
-            opt_state=new_opt), {"loss": loss}
+            opt_state=new_opt), {"loss": loss, **moe_metrics}
 
     def compile_step(self):
         if self._step is None:
@@ -324,13 +358,17 @@ class PipelineLMTrainer:
             assert self._state_shardings is not None, "call init_state first"
 
             def eval_fn(params, tokens, targets, mask=None):
+                # moe_aux_weight=0: the load-balance aux shapes gradients
+                # only — folding it into val_loss would inflate reported
+                # perplexity (same stance as LMTrainer._eval_fn)
                 if self.masked:
                     return pipeline_mlm_loss(
                         self.cfg, params, tokens, targets, mask,
-                        self.mesh, self.num_microbatches)
+                        self.mesh, self.num_microbatches,
+                        moe_aux_weight=0.0)
                 return pipeline_lm_loss(
                     self.cfg, params, tokens, targets, self.mesh,
-                    self.num_microbatches)
+                    self.num_microbatches, moe_aux_weight=0.0)
 
             n_streams = 3 if self.masked else 2
             # params only (LMTrainer.compile_eval symmetry): the loss
@@ -404,11 +442,16 @@ class PipelineLMTrainer:
             f"schedule={self.schedule}"
             + (f"×{self.interleave}" if self.interleave > 1 else "")
             + f" bubble={self.bubble:.1%}: {tps:.0f} tokens/sec")
+        extra = {}
+        if "moe_drop_rate" in metrics:
+            # observable router imbalance in the pp path (pipeline_lm_loss
+            # threads it out of the schedule; parallel/moe.py sows it)
+            extra["moe_drop_rate"] = float(metrics["moe_drop_rate"])
         return state, {"tokens_per_sec": tps,
                        "tokens_per_sec_per_device": tps / n,
                        "final_loss": final_loss,
                        "bubble_fraction": self.bubble,
-                       **stats}
+                       **stats, **extra}
 
 
 __all__ = ["PipelineLMTrainer", "PPTrainState"]

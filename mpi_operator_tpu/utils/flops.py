@@ -29,7 +29,7 @@ _PEAK_TABLE = (
     ("v6e", 918e12), ("v6 lite", 918e12), ("trillium", 918e12),
     ("v5p", 459e12),
     ("v5 lite", 197e12), ("v5e", 197e12), ("v5litepod", 197e12),
-    ("v5", 459e12),              # plain "TPU v5" = v5p
+    ("v5", 459e12),              # plain "TPU v5" = v5p (observed kind)
     ("v4", 275e12),
     ("v3", 123e12),
     ("v2", 45e12),
@@ -44,10 +44,7 @@ def device_peak_flops(device=None) -> Optional[float]:
     kind = getattr(device, "device_kind", "").lower()
     if "tpu" not in kind and device.platform != "tpu":
         return None
-    for marker, peak in _PEAK_TABLE:
-        if marker in kind:
-            return peak
-    return None
+    return _lookup(kind, _PEAK_TABLE)
 
 
 # HBM bandwidth per chip (bytes/s), by device_kind substring — the decode
@@ -57,11 +54,33 @@ _HBM_TABLE = (
     ("v6e", 1640e9), ("v6 lite", 1640e9), ("trillium", 1640e9),
     ("v5p", 2765e9),
     ("v5 lite", 819e9), ("v5e", 819e9), ("v5litepod", 819e9),
-    ("v5", 2765e9),
+    ("v5", 2765e9),              # plain "TPU v5" = v5p (observed kind)
     ("v4", 1228e9),
     ("v3", 900e9),
     ("v2", 700e9),
 )
+
+# The bare "v5" rows above are a last-resort fallback: real v5p chips
+# report device_kind "TPU v5" verbatim, so dropping the rows would
+# silently lose every mfu/mbu field on v5p. But an UNEXPECTED v5e kind
+# spelling landing on them would overstate peak bandwidth ~3.4x and
+# silently understate MBU — so any bare-marker match is logged loudly
+# (the advisor-r04 visibility remedy).
+_BARE_FALLBACK_WARNED = set()
+
+
+def _lookup(kind: str, table) -> Optional[float]:
+    for marker, val in table:
+        if marker in kind:
+            if marker == "v5" and kind not in _BARE_FALLBACK_WARNED:
+                _BARE_FALLBACK_WARNED.add(kind)
+                import sys
+                print(f"# flops: device_kind {kind!r} matched only the "
+                      f"bare 'v5' marker — assuming v5p peak figures; "
+                      f"if this is a v5e spelling, MFU/MBU are wrong",
+                      file=sys.stderr)
+            return val
+    return None
 
 
 def device_hbm_bandwidth(device=None) -> Optional[float]:
@@ -72,10 +91,7 @@ def device_hbm_bandwidth(device=None) -> Optional[float]:
     kind = getattr(device, "device_kind", "").lower()
     if "tpu" not in kind and device.platform != "tpu":
         return None
-    for marker, bw in _HBM_TABLE:
-        if marker in kind:
-            return bw
-    return None
+    return _lookup(kind, _HBM_TABLE)
 
 
 def decode_bytes_per_step(num_params: int, num_layers: int,

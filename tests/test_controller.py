@@ -238,6 +238,34 @@ def test_invalid_spec_message_refreshes_on_different_breakage():
     assert "numSlices" in cond.message
 
 
+def test_invalid_spec_edit_never_resurrects_terminal_job():
+    """A job already terminally Failed (reason TPUJobFailed) whose spec is
+    later edited invalid must keep its terminal condition — converting it
+    to the level-triggered InvalidTPUJobSpec reason would let a
+    subsequent spec FIX clear Failed and resurrect a finished job despite
+    restartPolicy Never (advisor r04)."""
+    f = Fixture()
+    f.api._admission.clear()
+    job = f.seed(new_job(tpus=8, restart_policy="Never"))
+    _seed_finished_launcher(f, job, succeeded=False)
+    f.run("default/test")                  # terminal: Failed/TPUJobFailed
+    job = f.api.get(api.KIND, "default", "test")
+    assert job.status.get_condition(api.COND_FAILED).reason == "TPUJobFailed"
+    job.spec.tpus = 7                      # edit the dead job's spec invalid
+    f.api.update(job)
+    f.run("default/test")
+    job = f.api.get(api.KIND, "default", "test")
+    cond = job.status.get_condition(api.COND_FAILED)
+    assert cond.status == "True"
+    assert cond.reason == "TPUJobFailed"   # NOT InvalidTPUJobSpec
+    job.spec.tpus = 8                      # ...and fixing it changes nothing
+    f.api.update(job)
+    actions = f.run("default/test")
+    job = f.api.get(api.KIND, "default", "test")
+    assert job.status.get_condition(api.COND_FAILED).status == "True"
+    assert ("create", "Job") not in verbs(actions)   # stays dead
+
+
 def test_midrun_invalid_spec_tears_down_gang():
     """A RUNNING job edited into an invalid spec must not strand its gang
     burning chips behind a Failed status: the launcher is deleted and the
@@ -300,15 +328,30 @@ def _elastic_fixture(degraded=60, recovery=120, **job_kw):
     return f, clock
 
 
+def _elastic_go_running(f, name="test", workers=2):
+    """Walk a fresh elastic gang to its first Running observation, then
+    break readiness. The degraded countdown only arms after the gang has
+    been Ready at least once (persisted as the Running condition) — a
+    brand-new gang still scheduling/pulling images is not lost capacity,
+    so without this warmup no elastic timer ever starts."""
+    f.run(f"default/{name}")               # creates the worker STS
+    _seed_ready(f, name, workers, workers)
+    f.run(f"default/{name}")               # readiness gate → launcher
+    launcher = f.api.get("Job", "default", name + LAUNCHER_SUFFIX)
+    launcher.status.active = 1
+    f.api.update(launcher)
+    f.run(f"default/{name}")               # Running condition lands
+    _seed_ready(f, name, 0, workers)       # ...and capacity is lost
+
+
 def test_elastic_shrinks_after_persistent_unavailability():
     """Workers stuck not-Ready past the degraded window → the job shrinks
     to the next valid v5e size via STATUS (spec untouched), records a
     Degraded condition + Warning Event, and the next sync materializes
     the smaller world through the ordinary resize machinery."""
     f, clock = _elastic_fixture()
-    f.run("default/test")                  # creates the 2-worker STS
-    # workers exist but never become Ready; timer starts at first sync
-    f.run("default/test")
+    _elastic_go_running(f)                 # first Ready observed, then lost
+    f.run("default/test")                  # not-Ready timer arms
     clock.t += 61                          # past elastic_degraded_seconds
     f.run("default/test")
     job = f.api.get(api.KIND, "default", "test")
@@ -330,8 +373,8 @@ def test_elastic_restores_after_recovery_window():
     """A shrunken job that has run Ready for the recovery window retries
     the full spec size (Degraded flips False, gang resizes back up)."""
     f, clock = _elastic_fixture()
-    f.run("default/test")
-    f.run("default/test")
+    _elastic_go_running(f)
+    f.run("default/test")                  # timer arms
     clock.t += 61
     f.run("default/test")                  # shrink decision
     f.run("default/test")                  # materialize 1-worker world
@@ -360,8 +403,8 @@ def test_elastic_shrink_recomputes_topology_selector():
     sliceTopology nodepool — that is exactly the capacity that's gone.
     The selector is recomputed for the degraded chip count."""
     f, clock = _elastic_fixture(slice_topology="2x4")
-    f.run("default/test")
-    f.run("default/test")
+    _elastic_go_running(f)
+    f.run("default/test")                  # timer arms
     clock.t += 61
     f.run("default/test")                  # shrink 8 -> 4
     f.run("default/test")                  # materialize
@@ -375,8 +418,8 @@ def test_elastic_recovery_counts_from_ready_not_shrink():
     become Ready must still get a FULL window of degraded running before
     restore — the countdown arms at the first Ready observation."""
     f, clock = _elastic_fixture()
-    f.run("default/test")
-    f.run("default/test")
+    _elastic_go_running(f)
+    f.run("default/test")                  # timer arms
     clock.t += 61
     f.run("default/test")                  # shrink at t0
     f.run("default/test")                  # materialize 1-worker world
@@ -395,8 +438,8 @@ def test_elastic_respects_min_tpus_floor():
     """minTpus floors the ladder: a job already at the floor stays
     pending instead of shrinking further."""
     f, clock = _elastic_fixture(min_tpus=8)
-    f.run("default/test")
-    f.run("default/test")
+    _elastic_go_running(f)
+    f.run("default/test")                  # timer arms
     clock.t += 61
     f.run("default/test")
     job = f.api.get(api.KIND, "default", "test")
@@ -409,19 +452,32 @@ def test_elastic_timer_clears_when_workers_recover():
     a later blip starts a FRESH window instead of inheriting the old
     one."""
     f, clock = _elastic_fixture()
-    f.run("default/test")
-    f.run("default/test")
+    _elastic_go_running(f)
+    f.run("default/test")                  # timer arms
     clock.t += 50                          # inside the window
     _seed_ready(f, "test", 2, 2)
     f.run("default/test")                  # Ready → timer cleared
-    sts = f.api.get("StatefulSet", "default", "test" + WORKER_SUFFIX)
-    from mpi_operator_tpu.cluster.resources import StatefulSetStatus
-    sts.status = StatefulSetStatus(ready_replicas=0, replicas=2)
-    f.api.update(sts)
+    _seed_ready(f, "test", 0, 2)
     clock.t += 30                          # 50+30 > 60, but fresh window
     f.run("default/test")
     job = f.api.get(api.KIND, "default", "test")
     assert job.status.elastic_tpus is None
+
+
+def test_elastic_never_shrinks_before_first_ready():
+    """A fresh elastic gang that takes longer than the degraded window to
+    schedule (image pulls, capacity waits) must NOT shrink below spec
+    before ever running at spec size — 'never yet Ready' is not 'lost
+    capacity'. The countdown arms only once the Running condition (set at
+    the first readiness-gate pass, persisted in status) exists."""
+    f, clock = _elastic_fixture()
+    f.run("default/test")                  # creates the 2-worker STS
+    f.run("default/test")                  # still scheduling...
+    clock.t += 3600                        # way past the degraded window
+    f.run("default/test")
+    job = f.api.get(api.KIND, "default", "test")
+    assert job.status.elastic_tpus is None
+    assert job.status.get_condition(api.COND_DEGRADED) is None
 
 
 def _seed_ready(f, name, ready, replicas):
