@@ -160,7 +160,7 @@ def main() -> None:
                         choices=["bfloat16", "float32"])
     parser.add_argument("--smoke", action="store_true",
                         help="tiny CPU config for CI/verification")
-    parser.add_argument("--budget-seconds", type=int, default=2400,
+    parser.add_argument("--budget-seconds", type=int, default=3000,
                         help="wall-clock budget for the --workload all "
                              "ladder: once exceeded, remaining legs are "
                              "marked *_skipped instead of running, so "
@@ -247,7 +247,7 @@ def main() -> None:
             line["moe_drop_rate"] = round(metrics["moe_drop_rate"], 4)
         print(json.dumps(line))
         return
-    def decode_leg(family, kv_cache_dtype=None, runs=3, batch=None):
+    def decode_leg(family, kv_cache_dtype=None, runs=2, batch=None):
         """Median-of-N decode throughput with spread — the r02 numbers
         swung 2.1k-3.5k on the tunneled chip with no variance reporting
         (VERDICT weak #2); the median + spread pins that down. Returns
@@ -291,24 +291,28 @@ def main() -> None:
             line[f"{prefix}_mbu"] = mbu_val
         return med
 
-    # batch sweep points: decode shifts from bandwidth- to compute-bound
-    # as the batch amortizes the param reads; the b32 points show where
-    # this chip sits on that curve
+    # primary decode legs (MBU rooflines) vs the b32 sweep points: decode
+    # shifts from bandwidth- to compute-bound as the batch amortizes the
+    # param reads; the b32 points show where this chip sits on that
+    # curve, and run LAST — sweep extras must never budget-starve vit
     DECODE_LEGS = (
         ("gpt2_decode", dict(family="gpt2")),
         ("llama_decode", dict(family="llama")),
         ("llama_int8kv_decode", dict(family="llama",
                                      kv_cache_dtype="int8")),
+    )
+    DECODE_SWEEP_LEGS = (
         ("llama_decode_b32", dict(family="llama", batch=32)),
         ("llama_int8kv_decode_b32", dict(family="llama",
                                          kv_cache_dtype="int8", batch=32)),
     )
 
-    def run_decode_legs(line, skip_check=None):
+    def run_decode_legs(line, skip_check=None,
+                        legs=DECODE_LEGS + DECODE_SWEEP_LEGS):
         # per-leg isolation everywhere decode runs: a late leg's OOM must
         # not discard the numbers measured minutes earlier; skip_check
         # (the --workload all wall-clock budget) may drop trailing legs
-        for prefix, dkw in DECODE_LEGS:
+        for prefix, dkw in legs:
             if skip_check is not None and skip_check(prefix):
                 continue
             try:
@@ -472,13 +476,13 @@ def main() -> None:
                warmup=warm, batch=4, seq=2048)
         lm_leg("gpt2_seq4096", workload="gpt2", steps=min(args.steps, 15),
                warmup=warm, batch=2, seq=4096)
-        # the SAME decode suite as --workload generate (incl. both b32
-        # sweep points) — the driver records only this default run, so a
-        # leg measured in one mode but not here would be effectively
-        # unmeasured. Runs BEFORE vit so the MBU roofline record survives
-        # a budget squeeze.
+        # the SAME decode suite as --workload generate — the driver
+        # records only this default run, so a leg measured in one mode
+        # but not here would be effectively unmeasured. Primary MBU
+        # rooflines run BEFORE vit; the b32 sweep extras run LAST (r05
+        # lesson: they budget-starved vit).
         clear_residue()
-        run_decode_legs(line, skip_check=over_budget)
+        run_decode_legs(line, skip_check=over_budget, legs=DECODE_LEGS)
         # ViT-B/16 (BASELINE configs[5] single-chip point; the multi-slice
         # variant is the dryrun's dcn leg)
         if not over_budget("vit"):
@@ -500,6 +504,9 @@ def main() -> None:
             except Exception as exc:  # noqa: BLE001
                 print(f"# vit bench leg failed: {exc!r}", file=sys.stderr)
                 line["vit_error"] = type(exc).__name__
+        clear_residue()
+        run_decode_legs(line, skip_check=over_budget,
+                        legs=DECODE_SWEEP_LEGS)
     print(json.dumps(line))
 
 

@@ -151,13 +151,11 @@ def run_lm_benchmark(
         # (bert): the mask stream rides the relays and the last stage
         # runs the MLM transform head (parallel/pipeline.py
         # pipeline_mlm_loss)
-        if masked and pp_schedule != "gpipe":
-            raise ValueError("--pp with bert composes with --pp-schedule "
-                             "gpipe only (1F1B's in-schedule vjp is "
-                             "causal-only)")
         # learned-position requirement is validated by PipelineLMTrainer
         # itself (the invariant lives there); MoE composition constraints
-        # (gpipe-only, whole dense+MoE periods per stage) likewise
+        # (gpipe-only, whole dense+MoE periods per stage) likewise. bert
+        # and --sp compose with BOTH schedules (1F1B consumes the mask at
+        # the last virtual stage / rings the sp shards in-schedule).
         if moe_experts and pp_schedule != "gpipe":
             raise ValueError("--pp with --moe-experts composes with "
                              "--pp-schedule gpipe only (1F1B stage bodies "
@@ -165,10 +163,6 @@ def run_lm_benchmark(
         if fused_xent:
             raise ValueError("--fused-xent is not wired into the pipeline "
                              "trainer; drop one of the flags")
-        if sp > 1 and pp_schedule != "gpipe":
-            raise ValueError("--pp --sp composes with --pp-schedule gpipe "
-                             "only (1F1B's in-schedule vjp does not ring "
-                             "the sequence axis yet)")
         if accum_steps > 1:
             raise ValueError("--accum-steps is redundant with --pp: the "
                              "pipeline trainer already streams "

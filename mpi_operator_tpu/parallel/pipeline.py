@@ -261,6 +261,41 @@ def lm_stage_head_loss(cfg, ln_f, ln_f_params, wte, y, tgt):
     return optax.softmax_cross_entropy_with_integer_labels(logits, tgt).sum()
 
 
+def lm_stage_mlm_embed(cfg, shared, toks, pos_offset=None):
+    """Stage-0 MaskedLM (BERT) embedding: token + position (+ type-0 row
+    when the config uses token types) through the embedding LayerNorm —
+    ONE definition shared by the GPipe and 1F1B schedules so the pinned
+    numerical parity can't drift. `shared` is the non-block half of the
+    stack_mlm_params layout."""
+    from ..models.transformer import _layer_norm
+
+    h = lm_stage_embed(cfg, shared["wte"], shared["wpe"], toks,
+                       pos_offset=pos_offset)
+    if "wtte" in shared:
+        # benchmark contract: token_types=None → all type 0
+        h = h + shared["wtte"][0][None, None].astype(cfg.dtype)
+    return _layer_norm(cfg, "ln_emb").apply({"params": shared["ln_emb"]}, h)
+
+
+def lm_stage_mlm_head_loss(cfg, shared, y, tgt, msk):
+    """Last-stage MLM transform head (ln_f → dense → gelu → LN → tied
+    decoder + vocab bias) + masked cross-entropy. Returns the (masked
+    xent SUM, mask count) pair — the mean needs the dynamic global mask
+    count, which the schedules psum separately. Shared by GPipe and
+    1F1B."""
+    from ..models.transformer import _dense, _head_matmul, _layer_norm
+
+    h = _layer_norm(cfg, "ln_f").apply({"params": shared["ln_f"]}, y)
+    h = _dense(cfg.embed_dim, "mlm_dense", ("embed", "embed"),
+               cfg.dtype).apply({"params": shared["mlm_dense"]}, h)
+    h = _layer_norm(cfg, "mlm_ln").apply(
+        {"params": shared["mlm_ln"]}, jax.nn.gelu(h))
+    logits = _head_matmul(h, shared["wte"].astype(cfg.dtype))
+    logits = logits + shared["mlm_bias"]
+    xent = optax.softmax_cross_entropy_with_integer_labels(logits, tgt)
+    return (xent * msk).sum(), msk.sum()
+
+
 def _moe_layer_split(num_layers: int, num_experts: int, moe_every: int):
     """(dense_idx, moe_idx) layer-index lists for a MoE config — the same
     alternation Backbone builds (models/transformer.py: block i is MoE when
@@ -366,7 +401,7 @@ def _lm_pipeline_local(cfg, axis_name: str, M: int, psum_axes, seq_sharded,
     head (dense+gelu+LN, tied decoder, vocab bias), and the return value
     is the psummed (masked-xent sum, mask count) PAIR — masked mean
     needs the dynamic global mask count, not a static token count."""
-    from ..models.transformer import Block, _dense, _layer_norm
+    from ..models.transformer import Block, _layer_norm
 
     mask_local = opt_mask[0] if opt_mask else None
     n_stages = lax.axis_size(axis_name)
@@ -384,14 +419,10 @@ def _lm_pipeline_local(cfg, axis_name: str, M: int, psum_axes, seq_sharded,
     pos_off = lax.axis_index("sp") * S if seq_sharded else None
 
     def embed(toks):
-        h = lm_stage_embed(cfg, wte, wpe, toks, pos_offset=pos_off)
-        if not masked:
-            return h
-        if "wtte" in pp_params:
-            # benchmark contract: token_types=None → all type 0
-            h = h + pp_params["wtte"][0][None, None].astype(cfg.dtype)
-        return _layer_norm(cfg, "ln_emb").apply(
-            {"params": pp_params["ln_emb"]}, h)
+        if masked:
+            return lm_stage_mlm_embed(cfg, pp_params, toks,
+                                      pos_offset=pos_off)
+        return lm_stage_embed(cfg, wte, wpe, toks, pos_offset=pos_off)
 
     if moe_blocks is None:
         def stage_apply(h):
@@ -442,18 +473,7 @@ def _lm_pipeline_local(cfg, axis_name: str, M: int, psum_axes, seq_sharded,
 
     if masked:
         def head_loss(y, tgt, msk):
-            h = ln_f.apply({"params": pp_params["ln_f"]}, y)
-            h = _dense(cfg.embed_dim, "mlm_dense", ("embed", "embed"),
-                       cfg.dtype).apply({"params": pp_params["mlm_dense"]},
-                                        h)
-            h = _layer_norm(cfg, "mlm_ln").apply(
-                {"params": pp_params["mlm_ln"]}, jax.nn.gelu(h))
-            from ..models.transformer import _head_matmul
-            logits = _head_matmul(h, wte.astype(cfg.dtype))
-            logits = logits + pp_params["mlm_bias"]
-            xent = optax.softmax_cross_entropy_with_integer_labels(
-                logits, tgt)
-            return (xent * msk).sum(), msk.sum()
+            return lm_stage_mlm_head_loss(cfg, pp_params, y, tgt, msk)
     else:
         def head_loss(y, tgt, msk):
             del msk

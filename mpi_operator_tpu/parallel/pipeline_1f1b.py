@@ -298,20 +298,33 @@ def deinterleave_blocks(blocks, num_stages: int, interleave: int):
 # LM integration: stage-sliced CausalLM under the 1F1B schedule
 # ---------------------------------------------------------------------------
 
-def _lm_1f1b_local(cfg, sched: Schedule, axis_name, psum_axes,
-                   tables, pp_params, tokens, targets):
-    """Device-local 1F1B over a stage-sliced CausalLM. pp_params["blocks"]
-    leaves arrive [v*Lc, ...] (this device's chunk stack, interleave_blocks
-    layout); tokens/targets [M, mb, S] are replicated across pp (raw int
-    streams are cheap; the relay-register trick stays GPipe-only)."""
-    from ..models.transformer import Block, _layer_norm
-    from .pipeline import lm_stage_embed, lm_stage_head_loss
+def _lm_1f1b_local(cfg, sched: Schedule, axis_name, psum_axes, masked,
+                   seq_sharded, tables, pp_params, tokens, targets,
+                   *opt_mask):
+    """Device-local 1F1B over a stage-sliced CausalLM — or MaskedLM
+    (masked=True: BERT-family embed/head via the shared
+    lm_stage_mlm_embed / lm_stage_mlm_head_loss, mask consumed directly
+    at the last virtual stage, mask COUNT accumulated alongside the loss
+    for the dynamic divisor). pp_params["blocks"] leaves arrive [v*Lc,
+    ...] (this device's chunk stack, interleave_blocks layout);
+    tokens/targets (+ mask) [M, mb, S] are replicated across pp (raw int
+    streams are cheap; the relay-register trick stays GPipe-only).
 
+    seq_sharded: the streams' S dim is ALSO sharded over the manual "sp"
+    axis — stage attention rings the K/V shards (cfg.attention="ring" →
+    ring_attention_inner via models._attend), positions offset by the
+    shard's global start, psums span sp."""
+    from ..models.transformer import Block, _layer_norm
+    from .pipeline import (lm_stage_embed, lm_stage_head_loss,
+                           lm_stage_mlm_embed, lm_stage_mlm_head_loss)
+
+    mask = opt_mask[0] if opt_mask else None
     v, Pn, M = sched.interleave, sched.num_stages, sched.num_microbatches
     stage = lax.axis_index(axis_name)
     S = tokens.shape[-1]
     E = pp_params["wte"].shape[1]
     mb = tokens.shape[1]
+    pos_off = lax.axis_index("sp") * S if seq_sharded else None
 
     wte, wpe = pp_params["wte"], pp_params["wpe"]
     blocks = jax.tree.map(
@@ -333,7 +346,11 @@ def _lm_1f1b_local(cfg, sched: Schedule, axis_name, psum_axes,
     # decides embed-in / head-out; lax.switch keeps one branch's cost.
     def f_first(shared, cparams, h_in, m):
         toks = lax.dynamic_index_in_dim(tokens, m, 0, keepdims=False)
-        h = lm_stage_embed(cfg, shared["wte"], shared["wpe"], toks)
+        if masked:
+            h = lm_stage_mlm_embed(cfg, shared, toks, pos_offset=pos_off)
+        else:
+            h = lm_stage_embed(cfg, shared["wte"], shared["wpe"], toks,
+                               pos_offset=pos_off)
         return stage_stack(cparams, h), jnp.zeros((), jnp.float32)
 
     def f_mid(shared, cparams, h_in, m):
@@ -343,16 +360,33 @@ def _lm_1f1b_local(cfg, sched: Schedule, axis_name, psum_axes,
     def f_last(shared, cparams, h_in, m):
         y = stage_stack(cparams, h_in)
         tgt = lax.dynamic_index_in_dim(targets, m, 0, keepdims=False)
-        loss = lm_stage_head_loss(cfg, ln_f, shared["ln_f"],
-                                  shared["wte"], y, tgt)
+        if masked:
+            msk = lax.dynamic_index_in_dim(mask, m, 0, keepdims=False)
+            loss, _ = lm_stage_mlm_head_loss(cfg, shared, y, tgt, msk)
+        else:
+            loss = lm_stage_head_loss(cfg, ln_f, shared["ln_f"],
+                                      shared["wte"], y, tgt)
         return y, loss        # act out unused (never sent)
 
     branches = (f_first, f_mid, f_last)
-    shared0 = {"wte": wte, "wpe": wpe, "ln_f": pp_params["ln_f"]}
+    # the generic non-block half of the stack layout (MLM head leaves and
+    # wtte included when masked) — all differentiated through the vjp
+    shared0 = {k: pv for k, pv in pp_params.items() if k != "blocks"}
+
+    # VMA seeding (same trick as GPipe's _vma_zero): fresh zeros are
+    # 'unvarying' under shard_map's manual-axes variance typing, while
+    # the scan writes values varying over pp (params) AND sp (the
+    # sharded stream). Without the seed, the sp-sharded case silently
+    # loses the banked activations — the last stage reads zeros and the
+    # loss collapses to ln(vocab) regardless of input.
+    from .pipeline import _vma_zero
+    zero = (_vma_zero(blocks, jnp.float32)
+            + tokens.astype(jnp.float32).sum() * 0)
 
     def zeros_grads():
-        return jax.tree.map(lambda x: jnp.zeros(x.shape, jnp.float32),
-                            {"shared": shared0, "blocks": blocks})
+        return jax.tree.map(
+            lambda x: jnp.zeros(x.shape, jnp.float32) + zero,
+            {"shared": shared0, "blocks": blocks})
 
     T = sched.ticks
     t_dir = tables["dir"]; t_role = tables["role"]
@@ -361,7 +395,7 @@ def _lm_1f1b_local(cfg, sched: Schedule, axis_name, psum_axes,
     t_rf = tables["recv_fwd_slot"]; t_rb = tables["recv_bwd_slot"]
 
     def tick(carry, tau):
-        h_buf, g_buf, loss_sum, grads = carry
+        h_buf, g_buf, loss_sum, cnt_sum, grads = carry
         direction = t_dir[tau, stage]
         role = t_role[tau, stage]
         c = t_chunk[tau, stage]
@@ -402,9 +436,73 @@ def _lm_1f1b_local(cfg, sched: Schedule, axis_name, psum_axes,
                     {"shared": shared0, "blocks_c": cparams}), \
                 jnp.zeros((mb, S, E), cfg.dtype)
 
-        out_act, loss_add, d, dh_out = lax.switch(
-            direction, (do_idle, do_fwd, do_bwd), None)
+        def sp_tick():
+            # seq-sharded path: the ring attention's sp ppermutes must
+            # run UNCONDITIONALLY — a manual-axis collective inside a
+            # lax.switch branch selected by a pp-varying predicate
+            # silently delivers zeros (verified by a 25-line repro; the
+            # auto-axis tp collectives are immune). So every tick runs
+            # ONE vjp of the stage body (ring hops outside any switch)
+            # and selects the COTANGENTS by direction instead: zero
+            # cotangent on FWD/IDLE ticks makes the unconditional
+            # backward contribute exactly nothing. Costs fwd+bwd every
+            # tick — the price of collective-uniformity across stages.
+            toks_m = lax.dynamic_index_in_dim(tokens, m, 0, keepdims=False)
+            tgt_m = lax.dynamic_index_in_dim(targets, m, 0, keepdims=False)
+            msk_m = (lax.dynamic_index_in_dim(mask, m, 0, keepdims=False)
+                     if masked else None)
+
+            def body_fn(shared, cp, h):
+                if masked:
+                    emb = lm_stage_mlm_embed(cfg, shared, toks_m,
+                                             pos_offset=pos_off)
+                else:
+                    emb = lm_stage_embed(cfg, shared["wte"], shared["wpe"],
+                                         toks_m, pos_offset=pos_off)
+                h0 = jnp.where(role == ROLE_FIRST, emb, h)
+                y = stage_stack(cp, h0)          # ring: unconditional
+                # the head is collective-free, so lax.cond is safe here
+                # (same structure GPipe uses)
+                if masked:
+                    loss = lax.cond(
+                        role == ROLE_LAST,
+                        lambda: lm_stage_mlm_head_loss(cfg, shared, y,
+                                                       tgt_m, msk_m)[0],
+                        lambda: jnp.zeros((), jnp.float32))
+                else:
+                    loss = lax.cond(
+                        role == ROLE_LAST,
+                        lambda: lm_stage_head_loss(cfg, ln_f,
+                                                   shared["ln_f"],
+                                                   shared["wte"], y, tgt_m),
+                        lambda: jnp.zeros((), jnp.float32))
+                return y, loss
+
+            (y, loss), vjp = jax.vjp(body_fn, shared0, cparams, h_in)
+            g_in = lax.dynamic_index_in_dim(g_buf, jnp.maximum(gs, 0), 0,
+                                            keepdims=False)
+            is_bwd = direction == BWD
+            g_act = jnp.where(is_bwd & (role != ROLE_LAST), g_in,
+                              jnp.zeros_like(g_in))
+            seed_loss = (is_bwd & (role == ROLE_LAST)).astype(jnp.float32)
+            d_shared, d_c, dh = vjp((g_act, seed_loss))
+            loss_add = loss * (direction == FWD).astype(jnp.float32)
+            return y, loss_add, {"shared": d_shared, "blocks_c": d_c}, dh
+
+        if seq_sharded:
+            out_act, loss_add, d, dh_out = sp_tick()
+        else:
+            out_act, loss_add, d, dh_out = lax.switch(
+                direction, (do_idle, do_fwd, do_bwd), None)
         loss_sum = loss_sum + loss_add
+        if masked:
+            # the dynamic divisor: count each microbatch's mask exactly
+            # once — at its last-virtual-stage FORWARD tick (the same
+            # tick whose loss term enters loss_sum)
+            counted = ((direction == FWD)
+                       & (role == ROLE_LAST)).astype(jnp.float32)
+            msk_m = lax.dynamic_index_in_dim(mask, m, 0, keepdims=False)
+            cnt_sum = cnt_sum + msk_m.sum() * counted
         grads = {
             "shared": jax.tree.map(lambda a, b: a + b, grads["shared"],
                                    d["shared"]),
@@ -432,38 +530,47 @@ def _lm_1f1b_local(cfg, sched: Schedule, axis_name, psum_axes,
         g_buf = lax.dynamic_update_index_in_dim(
             g_buf, jnp.where(rb >= 0, arriving_g, g_prev),
             jnp.maximum(rb, 0), 0)
-        return (h_buf, g_buf, loss_sum, grads), None
+        return (h_buf, g_buf, loss_sum, cnt_sum, grads), None
 
-    h_buf0 = jnp.zeros((sched.h_depth, mb, S, E), cfg.dtype)
-    g_buf0 = jnp.zeros((sched.g_depth, mb, S, E), cfg.dtype)
-    (_, _, loss_sum, grads), _ = lax.scan(
-        tick, (h_buf0, g_buf0, jnp.zeros((), jnp.float32), zeros_grads()),
+    zc = zero.astype(cfg.dtype)
+    h_buf0 = jnp.zeros((sched.h_depth, mb, S, E), cfg.dtype) + zc
+    g_buf0 = jnp.zeros((sched.g_depth, mb, S, E), cfg.dtype) + zc
+    z32 = jnp.zeros((), jnp.float32) + zero
+    (_, _, loss_sum, cnt_sum, grads), _ = lax.scan(
+        tick, (h_buf0, g_buf0, z32, z32, zeros_grads()),
         jnp.arange(T))
     loss_sum = lax.psum(loss_sum, psum_axes)
+    cnt_sum = lax.psum(cnt_sum, psum_axes)
     d_shared = jax.tree.map(lambda x: lax.psum(x, psum_axes),
                             grads["shared"])
     d_blocks = jax.tree.map(
         lambda x: x.reshape((x.shape[0] * x.shape[1],) + x.shape[2:]),
         grads["blocks"])
-    if len(psum_axes) > 1:      # data axes shard the microbatch dim
+    if len(psum_axes) > 1:      # data/sp axes shard the streams
         d_blocks = jax.tree.map(
             lambda x: lax.psum(x, psum_axes[1:]), d_blocks)
-    return loss_sum, d_shared, d_blocks
+    return loss_sum, cnt_sum, d_shared, d_blocks
 
 
 def pipeline_lm_1f1b_grads(cfg, pp_params, tokens, targets, mesh: Mesh,
                            num_microbatches: int, interleave: int = 1,
-                           axis_name: str = "pp"):
-    """Mean loss AND grads of a stage-sliced CausalLM under interleaved
-    1F1B. pp_params is the stack_lm_params layout with blocks PRE-PERMUTED
-    by interleave_blocks (identity when interleave=1), sharded over pp.
-    tokens/targets [M, mb, S] int32. Returns (loss, grads) with grads in
-    the same (permuted) layout — feed optax directly.
+                           axis_name: str = "pp", mask=None):
+    """Mean loss AND grads of a stage-sliced CausalLM — or MaskedLM when
+    `mask` is given — under interleaved 1F1B. pp_params is the
+    stack_lm_params / stack_mlm_params layout with blocks PRE-PERMUTED by
+    interleave_blocks (identity when interleave=1), sharded over pp.
+    tokens/targets (+ float mask) [M, mb, S]. Returns (loss, grads) with
+    grads in the same (permuted) layout — feed optax directly. Masked
+    objectives divide by the DYNAMIC global mask count (lm_loss parity);
+    on an sp mesh the streams' sequence dim shards over sp and stage
+    attention rings the K/V shards (cfg.attention="ring").
 
-    Matches pipeline_lm_loss + jax.grad numerically (same maths, different
-    schedule); pinned by tests/test_parallel.py::TestPipeline1F1B."""
+    Matches pipeline_lm_loss/-mlm_loss + jax.grad numerically (same
+    maths, different schedule); pinned by
+    tests/test_parallel.py::TestPipeline1F1B."""
     n_stages = mesh.shape[axis_name]
     M = num_microbatches
+    masked = mask is not None
     if M % n_stages:
         raise ValueError(f"num_microbatches={M} must divide over "
                          f"pp={n_stages}")
@@ -471,6 +578,16 @@ def pipeline_lm_1f1b_grads(cfg, pp_params, tokens, targets, mesh: Mesh,
         raise ValueError(
             f"num_layers={cfg.num_layers} must divide over pp×interleave="
             f"{n_stages}×{interleave}")
+    if masked and cfg.causal:
+        raise ValueError("a masked 1F1B objective needs a causal=False "
+                         "(MaskedLM) config")
+    if "moe" in pp_params:
+        # the 1F1B stage bodies scan the dense stack only — silently
+        # accepting a MoE layout would drop every expert FFN from the
+        # model and freeze the expert weights at zero grads
+        raise ValueError("1F1B does not compose with MoE param layouts "
+                         "(the stage bodies are dense); use the GPipe "
+                         "schedule (pipeline_lm_loss) for MoE")
     sched = simulate_1f1b(n_stages, M, interleave)
     tables = {k: jnp.asarray(getattr(sched, k)) for k in (
         "dir", "role", "chunk", "mb", "h_slot", "g_slot",
@@ -481,36 +598,59 @@ def pipeline_lm_1f1b_grads(cfg, pp_params, tokens, targets, mesh: Mesh,
 
     data_deg = _math.prod(mesh.shape[a] for a in BATCH_AXES)
     shard_mb = data_deg > 1 and tokens.shape[1] % data_deg == 0
-    stream_spec = P(None, BATCH_AXES) if shard_mb else P()
-    psum_axes = (axis_name, *BATCH_AXES) if shard_mb else (axis_name,)
+    sp_deg = dict(mesh.shape).get("sp", 1)
+    seq_sharded = sp_deg > 1
+    if seq_sharded:
+        # same invariants as the GPipe path (_pipeline_stream_setup)
+        if tokens.shape[2] % sp_deg:
+            raise ValueError(f"seq len {tokens.shape[2]} must divide over "
+                             f"sp={sp_deg}")
+        if tokens.shape[2] > cfg.max_len:
+            raise ValueError(f"seq len {tokens.shape[2]} exceeds "
+                             f"cfg.max_len={cfg.max_len} (the wpe table)")
+        if cfg.attention != "ring":
+            raise ValueError(
+                'pp×sp needs cfg.attention="ring" — a dense/flash stage '
+                "body would attend within its own S/sp shard only and "
+                "silently truncate context")
+    stream_spec = P(None, BATCH_AXES if shard_mb else None,
+                    "sp" if seq_sharded else None)
+    psum_axes = (axis_name,) + (tuple(BATCH_AXES) if shard_mb else ()) \
+        + (("sp",) if seq_sharded else ())
 
+    shared_keys = [k for k in pp_params if k != "blocks"]
     specs = {
-        "wte": P(), "wpe": P(),
-        "blocks": jax.tree.map(lambda _: P(axis_name),
-                               pp_params["blocks"]),
-        "ln_f": jax.tree.map(lambda _: P(), pp_params["ln_f"]),
+        k: (jax.tree.map(lambda _: P(axis_name), pp_params[k])
+            if k == "blocks"
+            else jax.tree.map(lambda _: P(), pp_params[k]))
+        for k in pp_params
     }
     manual = frozenset(a for a in mesh.axis_names if a != "tp")
+    n_streams = 3 if masked else 2
     fn = shard_map(
         functools.partial(_lm_1f1b_local, cfg, sched, axis_name,
-                          psum_axes, tables),
+                          psum_axes, masked, seq_sharded, tables),
         mesh=mesh,
-        in_specs=(specs, stream_spec, stream_spec),
-        out_specs=(P(), jax.tree.map(lambda _: P(), {
-            "wte": pp_params["wte"], "wpe": pp_params["wpe"],
-            "ln_f": pp_params["ln_f"]}),
-            jax.tree.map(lambda _: P(axis_name), pp_params["blocks"])),
+        in_specs=(specs,) + (stream_spec,) * n_streams,
+        out_specs=(P(), P(),
+                   {k: jax.tree.map(lambda _: P(), pp_params[k])
+                    for k in shared_keys},
+                   jax.tree.map(lambda _: P(axis_name),
+                                pp_params["blocks"])),
         axis_names=manual,
         check_vma=False,
     )
-    loss_sum, d_shared, d_blocks = fn(pp_params, tokens, targets)
-    denom = tokens.shape[0] * tokens.shape[1] * tokens.shape[2]
-    grads = {
-        "wte": d_shared["wte"] / denom,
-        "wpe": d_shared["wpe"] / denom,
-        "ln_f": jax.tree.map(lambda x: x / denom, d_shared["ln_f"]),
-        "blocks": jax.tree.map(lambda x: x / denom, d_blocks),
-    }
+    streams = (tokens, targets) + ((mask,) if masked else ())
+    loss_sum, cnt_sum, d_shared, d_blocks = fn(pp_params, *streams)
+    if masked:
+        # lm_loss parity: mean over the dynamic global mask count; the
+        # count doesn't depend on params, so grads-of-mean = grads/count
+        denom = jnp.maximum(cnt_sum, 1.0)
+    else:
+        denom = tokens.shape[0] * tokens.shape[1] * tokens.shape[2]
+    grads = {k: jax.tree.map(lambda x: x / denom, d_shared[k])
+             for k in shared_keys}
+    grads["blocks"] = jax.tree.map(lambda x: x / denom, d_blocks)
     return loss_sum / denom, grads
 
 

@@ -70,13 +70,11 @@ class PipelineLMTrainer:
                              "(virtual stages are a 1F1B concept)")
         self.schedule = schedule
         self.interleave = interleave
-        # masked LM (BERT family): GPipe only — the mask stream and the
-        # MLM transform head live in pipeline_mlm_loss; 1F1B's in-schedule
-        # vjp stays causal-only
+        # masked LM (BERT family): both schedules — GPipe relays the mask
+        # stream (pipeline_mlm_loss); 1F1B consumes it at the last
+        # virtual stage with the dynamic mask-count divisor
+        # (pipeline_lm_1f1b_grads mask=)
         self.masked = bool(self.config.masked_lm)
-        if self.masked and schedule != "gpipe":
-            raise ValueError("masked_lm composes with schedule='gpipe' "
-                             "only")
         if self.masked and cfg.causal:
             raise ValueError("masked_lm needs a causal=False (MaskedLM) "
                              "config")
@@ -142,10 +140,6 @@ class PipelineLMTrainer:
             if self.config.seq_len % self.sp:
                 raise ValueError(f"seq_len={self.config.seq_len} must "
                                  f"divide over sp={self.sp}")
-            if schedule != "gpipe":
-                raise ValueError(
-                    "pp×sp composes with schedule='gpipe' only (the 1F1B "
-                    "in-schedule vjp does not ring the sequence axis yet)")
         self.tx = tx or make_adamw(self.config)
         # token stream [M, mb, S]: M over pp, microbatch over data axes,
         # seq over sp when context-parallel
@@ -280,7 +274,16 @@ class PipelineLMTrainer:
     def _step_fn(self, state: PPTrainState, tokens, targets, mask=None):
         w = self.config.moe_aux_weight
         moe_metrics = {}
-        if self.masked:
+        if self.schedule == "1f1b":
+            # 1F1B computes grads IN-SCHEDULE (backward ticks interleave
+            # with forwards), so no outer jax.grad; mask= selects the
+            # masked-LM head + dynamic divisor
+            from ..parallel.pipeline_1f1b import pipeline_lm_1f1b_grads
+            loss, grads = pipeline_lm_1f1b_grads(
+                self.cfg, state.params, tokens, targets, self.mesh,
+                self.num_microbatches, interleave=self.interleave,
+                mask=mask if self.masked else None)
+        elif self.masked:
             def loss_fn(params):
                 return pipeline_mlm_loss(self.cfg, params, tokens, targets,
                                          mask, self.mesh,
@@ -289,13 +292,6 @@ class PipelineLMTrainer:
                                          with_moe_metrics=True)
             (loss, moe_metrics), grads = jax.value_and_grad(
                 loss_fn, has_aux=True)(state.params)
-        elif self.schedule == "1f1b":
-            # 1F1B computes grads IN-SCHEDULE (backward ticks interleave
-            # with forwards), so no outer jax.grad
-            from ..parallel.pipeline_1f1b import pipeline_lm_1f1b_grads
-            loss, grads = pipeline_lm_1f1b_grads(
-                self.cfg, state.params, tokens, targets, self.mesh,
-                self.num_microbatches, interleave=self.interleave)
         else:
             def loss_fn(params):
                 return pipeline_lm_loss(self.cfg, params, tokens, targets,
@@ -360,7 +356,15 @@ class PipelineLMTrainer:
             def eval_fn(params, tokens, targets, mask=None):
                 # moe_aux_weight=0: the load-balance aux shapes gradients
                 # only — folding it into val_loss would inflate reported
-                # perplexity (same stance as LMTrainer._eval_fn)
+                # perplexity (same stance as LMTrainer._eval_fn).
+                # 1F1B×interleave stores blocks in the device-major chunk
+                # layout; the GPipe eval pass needs canonical layer order
+                # or stages apply layers out of sequence.
+                if self.schedule == "1f1b" and self.interleave > 1:
+                    from ..parallel.pipeline_1f1b import deinterleave_blocks
+                    params = dict(params)
+                    params["blocks"] = deinterleave_blocks(
+                        params["blocks"], self.pp, self.interleave)
                 if self.masked:
                     return pipeline_mlm_loss(
                         self.cfg, params, tokens, targets, mask,

@@ -391,6 +391,57 @@ class TestPipelineLM:
                 np.asarray(a), np.asarray(b), atol=3e-4,
                 err_msg=jax.tree_util.keystr(path))
 
+    def test_masked_pp_sp_ring_matches_unpiped(self):
+        """pp×sp for the MASKED (BERT) pipeline (advisor r04): the
+        bidirectional ring-attention stage body under the pipeline with
+        the sp-sharded mask stream — loss AND grads must match the
+        unpiped dense MaskedLM on identical params (the causal pp×sp and
+        masked pp×dp combinations each had this pin; the composition now
+        does too)."""
+        import dataclasses
+
+        from mpi_operator_tpu.models.transformer import (MaskedLM,
+                                                         bert_config)
+        from mpi_operator_tpu.parallel import (pipeline_mlm_loss,
+                                               stack_mlm_params)
+        from mpi_operator_tpu.train.lm_trainer import lm_loss
+
+        cfg_ring = bert_config("test", attention="ring", dtype=jnp.float32,
+                               vocab_size=256, max_len=32)
+        model = MaskedLM(dataclasses.replace(cfg_ring, attention="dense"))
+        B, S, M = 8, 32, 4
+        orig = jax.random.randint(jax.random.PRNGKey(3), (B, S), 0,
+                                  cfg_ring.vocab_size)
+        mask = (jax.random.uniform(jax.random.PRNGKey(5), (B, S))
+                < 0.25).astype(jnp.float32)
+        toks = jnp.where(mask > 0, cfg_ring.vocab_size - 1, orig)
+        vs = meta.unbox(model.init(jax.random.PRNGKey(7), toks))
+        mesh = make_mesh(MeshConfig(pp=2, sp=2, dp=2))
+        pp_params = stack_mlm_params(vs["params"], cfg_ring.num_layers)
+        tk = toks.reshape(M, B // M, S)
+        tg = orig.reshape(M, B // M, S)
+        mk = mask.reshape(M, B // M, S)
+
+        ref = lm_loss(model.apply(vs, toks), orig, mask)
+        out = jax.jit(lambda p: pipeline_mlm_loss(
+            cfg_ring, p, tk, tg, mk, mesh, M))(pp_params)
+        np.testing.assert_allclose(np.asarray(ref), np.asarray(out),
+                                   atol=2e-5)
+        g_pipe = jax.jit(jax.grad(lambda p: pipeline_mlm_loss(
+            cfg_ring, p, tk, tg, mk, mesh, M)))(pp_params)
+        g_ref = stack_mlm_params(
+            jax.grad(lambda p: lm_loss(
+                model.apply({"params": p}, toks), orig, mask))(
+                vs["params"]),
+            cfg_ring.num_layers)
+        flat_p, _ = jax.tree_util.tree_flatten_with_path(g_pipe)
+        flat_r = jax.tree_util.tree_flatten_with_path(g_ref)[0]
+        assert [p for p, _ in flat_p] == [p for p, _ in flat_r]
+        for (path, a), (_, b) in zip(flat_p, flat_r):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), atol=3e-4,
+                err_msg=jax.tree_util.keystr(path))
+
     def _moe_setup(self, dropless):
         """4-layer GPT-2 test config with MoE every 2nd block (blocks 1,3)
         — pp=2 stages each own one (dense, MoE) period."""
@@ -812,6 +863,121 @@ class TestPipeline1F1B:
     def test_1f1b_interleaved_matches_gpipe(self):
         self._parity(pp=2, dp=4, v=2, L=4)
 
+    @pytest.mark.parametrize("v", [1, 2])
+    def test_1f1b_masked_matches_gpipe(self, v):
+        """Masked-LM (BERT) under 1F1B (VERDICT r04 next #3): the mask is
+        consumed at the last virtual stage, the divisor is the DYNAMIC
+        global mask count — loss and grads must match the GPipe
+        pipeline_mlm_loss + jax.grad on identical params."""
+        from flax.core import meta
+        from mpi_operator_tpu.models.transformer import (MaskedLM,
+                                                         bert_config)
+        from mpi_operator_tpu.parallel.pipeline import (pipeline_mlm_loss,
+                                                        stack_mlm_params)
+        from mpi_operator_tpu.parallel.pipeline_1f1b import (
+            interleave_blocks, pipeline_lm_1f1b_grads)
+
+        cfg = bert_config("test", attention="dense", dtype=jnp.float32,
+                          vocab_size=128, max_len=16, num_layers=2 * v)
+        mesh = make_mesh(MeshConfig(pp=2, dp=4))
+        model = MaskedLM(cfg)
+        M, mb, S = 4, 2, 16
+        orig = jax.random.randint(jax.random.PRNGKey(1), (M, mb, S), 0, 128)
+        msk = (jax.random.uniform(jax.random.PRNGKey(5), (M, mb, S))
+               < 0.25).astype(jnp.float32)
+        toks = jnp.where(msk > 0, cfg.vocab_size - 1, orig)
+        vs = meta.unbox(model.init(jax.random.PRNGKey(0),
+                                   jnp.zeros((2, S), jnp.int32)))
+        pp_params = stack_mlm_params(vs["params"], cfg.num_layers)
+        loss_g, grads_g = jax.jit(jax.value_and_grad(
+            lambda p: pipeline_mlm_loss(cfg, p, toks, orig, msk, mesh, M)))(
+                pp_params)
+        params_v = dict(pp_params)
+        params_v["blocks"] = interleave_blocks(pp_params["blocks"], 2, v)
+        loss_f, grads_f = jax.jit(lambda p: pipeline_lm_1f1b_grads(
+            cfg, p, toks, orig, mesh, M, interleave=v, mask=msk))(params_v)
+        np.testing.assert_allclose(np.asarray(loss_g), np.asarray(loss_f),
+                                   atol=2e-5)
+        gb = interleave_blocks(grads_g["blocks"], 2, v)
+        jax.tree.map(lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=2e-4),
+            gb, grads_f["blocks"])
+        for k in ("wte", "mlm_bias", "mlm_dense", "ln_emb"):
+            jax.tree.map(lambda a, b: np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), atol=2e-4),
+                grads_g[k], grads_f[k])
+
+    def test_1f1b_masked_sp_ring_matches_gpipe(self):
+        """The full composition: masked-LM × sp × 1F1B — bidirectional
+        ring stage bodies, sp-sharded mask stream, dynamic divisor, all
+        under the in-schedule vjp. Pinned against the GPipe mlm path."""
+        from flax.core import meta
+        from mpi_operator_tpu.models.transformer import (MaskedLM,
+                                                         bert_config)
+        import dataclasses
+        from mpi_operator_tpu.parallel.pipeline import (pipeline_mlm_loss,
+                                                        stack_mlm_params)
+        from mpi_operator_tpu.parallel.pipeline_1f1b import (
+            pipeline_lm_1f1b_grads)
+
+        cfg = bert_config("test", attention="ring", dtype=jnp.float32,
+                          vocab_size=128, max_len=32)
+        mesh = make_mesh(MeshConfig(pp=2, sp=2, dp=2))
+        model = MaskedLM(dataclasses.replace(cfg, attention="dense"))
+        M, mb, S = 4, 2, 32
+        orig = jax.random.randint(jax.random.PRNGKey(1), (M, mb, S), 0, 128)
+        msk = (jax.random.uniform(jax.random.PRNGKey(5), (M, mb, S))
+               < 0.25).astype(jnp.float32)
+        toks = jnp.where(msk > 0, cfg.vocab_size - 1, orig)
+        vs = meta.unbox(model.init(jax.random.PRNGKey(0),
+                                   jnp.zeros((2, S), jnp.int32)))
+        pp_params = stack_mlm_params(vs["params"], cfg.num_layers)
+        loss_g, grads_g = jax.jit(jax.value_and_grad(
+            lambda p: pipeline_mlm_loss(cfg, p, toks, orig, msk, mesh, M)))(
+                pp_params)
+        loss_f, grads_f = jax.jit(lambda p: pipeline_lm_1f1b_grads(
+            cfg, p, toks, orig, mesh, M, mask=msk))(pp_params)
+        np.testing.assert_allclose(np.asarray(loss_g), np.asarray(loss_f),
+                                   rtol=1e-4)
+        jax.tree.map(lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=5e-4),
+            grads_g["blocks"], grads_f["blocks"])
+
+    def test_1f1b_sp_ring_matches_gpipe(self):
+        """pp×sp under 1F1B (VERDICT r04 next #3): the streams' sequence
+        dim sharded over sp, stage attention ringing in-schedule — loss
+        and grads must match the GPipe pp×sp path on identical params."""
+        from flax.core import meta
+        from mpi_operator_tpu.parallel.pipeline import (pipeline_lm_loss,
+                                                        stack_lm_params)
+        from mpi_operator_tpu.parallel.pipeline_1f1b import (
+            pipeline_lm_1f1b_grads)
+
+        cfg = gpt2_config("test", attention="ring", dtype=jnp.float32,
+                          vocab_size=128, max_len=32)
+        mesh = make_mesh(MeshConfig(pp=2, sp=2, dp=2))
+        import dataclasses
+        model = CausalLM(dataclasses.replace(cfg, attention="dense"))
+        M, mb, S = 4, 2, 32
+        toks = jax.random.randint(jax.random.PRNGKey(1), (M, mb, S), 0, 128)
+        tgts = jnp.roll(toks, -1, axis=-1)
+        vs = meta.unbox(model.init(jax.random.PRNGKey(0),
+                                   jnp.zeros((2, S), jnp.int32)))
+        pp_params = stack_lm_params(vs["params"], cfg.num_layers)
+        loss_g, grads_g = jax.jit(jax.value_and_grad(
+            lambda p: pipeline_lm_loss(cfg, p, toks, tgts, mesh, M)))(
+                pp_params)
+        loss_f, grads_f = jax.jit(lambda p: pipeline_lm_1f1b_grads(
+            cfg, p, toks, tgts, mesh, M))(pp_params)
+        # rtol, not tight atol: the 1F1B per-stage recompute-vjp orders
+        # the ring reductions differently from GPipe's autodiff — f32
+        # noise at ~2.5e-5 relative on this config
+        np.testing.assert_allclose(np.asarray(loss_g), np.asarray(loss_f),
+                                   rtol=1e-4)
+        jax.tree.map(lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=5e-4),
+            grads_g["blocks"], grads_f["blocks"])
+
     def test_1f1b_trainer_step(self):
         """End-to-end: PipelineLMTrainer(schedule='1f1b', interleave=2)
         runs a full train step (grads in-schedule + optimizer) and the
@@ -859,6 +1025,19 @@ class TestPipeline1F1B:
         jax.tree.map(lambda a, b: np.testing.assert_array_equal(
             np.asarray(a), np.asarray(b)),
             g.canonical_state(gs).params, f.canonical_state(fs).params)
+        # evaluate() must de-interleave before the GPipe eval pass — with
+        # the raw chunk layout the stages would apply layers out of order
+        toks = jax.random.randint(jax.random.PRNGKey(3), (16, 17), 0, 128)
+        batch = g.microbatch(toks[:, :-1], toks[:, 1:])
+
+        class Rep:
+            def __iter__(self):
+                return iter([batch] * 2)
+
+        ev_g = g.evaluate(gs, Rep(), num_batches=1)
+        ev_f = f.evaluate(fs, Rep(), num_batches=1)
+        np.testing.assert_allclose(ev_g["val_loss"], ev_f["val_loss"],
+                                   rtol=1e-5)
         # live layouts really are permuted relative to each other
         diff = jax.tree.leaves(jax.tree.map(
             lambda a, b: float(jnp.abs(a - b).max()),
