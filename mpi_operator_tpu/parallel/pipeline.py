@@ -251,12 +251,22 @@ def lm_stage_embed(cfg, wte, wpe, toks, pos_offset=None):
     return wte[toks].astype(cfg.dtype) + pos[None].astype(cfg.dtype)
 
 
-def lm_stage_head_loss(cfg, ln_f, ln_f_params, wte, y, tgt):
+def lm_stage_head_loss(cfg, ln_f, ln_f_params, wte, y, tgt,
+                       fused: bool = False):
     """Last-stage ln_f + tied head + summed token cross-entropy, shared by
-    both pipeline schedules."""
+    both pipeline schedules. fused=True runs the chunked tied-head xent
+    (train.lm_trainer.fused_lm_loss with denom=1 → the SUM): the
+    [mb·S, vocab] logits never materialize on the last stage — the same
+    memory trade the unpiped --fused-xent path makes, paid once per
+    microbatch tick. Collective-free either way, so it is safe inside the
+    schedules' lax.cond."""
+    h = ln_f.apply({"params": ln_f_params}, y)
+    if fused:
+        from ..train.lm_trainer import fused_lm_loss
+        return fused_lm_loss(h, wte.astype(cfg.dtype), tgt,
+                             denom=jnp.ones((), jnp.float32))
     from ..models.transformer import _head_matmul
 
-    h = ln_f.apply({"params": ln_f_params}, y)
     logits = _head_matmul(h, wte.astype(cfg.dtype))
     return optax.softmax_cross_entropy_with_integer_labels(logits, tgt).sum()
 
@@ -373,8 +383,8 @@ def stack_mlm_params(params, num_layers: int, num_experts: int = 0,
 
 
 def _lm_pipeline_local(cfg, axis_name: str, M: int, psum_axes, seq_sharded,
-                       masked, pp_params, tokens_local, targets_local,
-                       *opt_mask):
+                       masked, fused_xent, pp_params, tokens_local,
+                       targets_local, *opt_mask):
     """Stage-sliced CausalLM forward + loss inside shard_map over pp.
 
     Each stage owns L/P consecutive blocks (lax.scan over the local layer
@@ -478,7 +488,7 @@ def _lm_pipeline_local(cfg, axis_name: str, M: int, psum_axes, seq_sharded,
         def head_loss(y, tgt, msk):
             del msk
             return (lm_stage_head_loss(cfg, ln_f, pp_params["ln_f"], wte,
-                                       y, tgt),
+                                       y, tgt, fused=fused_xent),
                     jnp.zeros((), jnp.float32))
 
     def pick(arr, row):
@@ -644,7 +654,8 @@ def _finalize_moe(loss, aux_sum, drop_sum, pp_params, mesh, M, psum_axes,
 def pipeline_lm_loss(cfg, pp_params, tokens, targets, mesh: Mesh,
                      num_microbatches: int, axis_name: str = "pp",
                      moe_aux_weight: float = 0.01,
-                     with_moe_metrics: bool = False):
+                     with_moe_metrics: bool = False,
+                     fused_xent: bool = False):
     """Mean next-token cross-entropy of a pp-stage-sliced CausalLM.
 
     cfg — TransformerConfig; cfg.num_layers must divide over pp.
@@ -677,7 +688,7 @@ def pipeline_lm_loss(cfg, pp_params, tokens, targets, mesh: Mesh,
     # (and the MoE dispatch all-to-all over ep likewise).
     fn = shard_map(
         functools.partial(_lm_pipeline_local, cfg, axis_name, M, psum_axes,
-                          seq_sharded, False),
+                          seq_sharded, False, fused_xent),
         mesh=mesh,
         in_specs=(specs, stream_spec, stream_spec),
         out_specs=(P(), P(), P(), P()),
@@ -707,7 +718,7 @@ def pipeline_mlm_loss(cfg, pp_params, tokens, targets, mask, mesh: Mesh,
                                masked=True)
     fn = shard_map(
         functools.partial(_lm_pipeline_local, cfg, axis_name, M, psum_axes,
-                          seq_sharded, True),
+                          seq_sharded, True, False),
         mesh=mesh,
         in_specs=(specs, stream_spec, stream_spec, stream_spec),
         out_specs=(P(), P(), P(), P()),

@@ -299,8 +299,8 @@ def deinterleave_blocks(blocks, num_stages: int, interleave: int):
 # ---------------------------------------------------------------------------
 
 def _lm_1f1b_local(cfg, sched: Schedule, axis_name, psum_axes, masked,
-                   seq_sharded, tables, pp_params, tokens, targets,
-                   *opt_mask):
+                   seq_sharded, fused_xent, tables, pp_params, tokens,
+                   targets, *opt_mask):
     """Device-local 1F1B over a stage-sliced CausalLM — or MaskedLM
     (masked=True: BERT-family embed/head via the shared
     lm_stage_mlm_embed / lm_stage_mlm_head_loss, mask consumed directly
@@ -365,7 +365,8 @@ def _lm_1f1b_local(cfg, sched: Schedule, axis_name, psum_axes, masked,
             loss, _ = lm_stage_mlm_head_loss(cfg, shared, y, tgt, msk)
         else:
             loss = lm_stage_head_loss(cfg, ln_f, shared["ln_f"],
-                                      shared["wte"], y, tgt)
+                                      shared["wte"], y, tgt,
+                                      fused=fused_xent)
         return y, loss        # act out unused (never sent)
 
     branches = (f_first, f_mid, f_last)
@@ -474,7 +475,8 @@ def _lm_1f1b_local(cfg, sched: Schedule, axis_name, psum_axes, masked,
                         role == ROLE_LAST,
                         lambda: lm_stage_head_loss(cfg, ln_f,
                                                    shared["ln_f"],
-                                                   shared["wte"], y, tgt_m),
+                                                   shared["wte"], y, tgt_m,
+                                                   fused=fused_xent),
                         lambda: jnp.zeros((), jnp.float32))
                 return y, loss
 
@@ -554,7 +556,8 @@ def _lm_1f1b_local(cfg, sched: Schedule, axis_name, psum_axes, masked,
 
 def pipeline_lm_1f1b_grads(cfg, pp_params, tokens, targets, mesh: Mesh,
                            num_microbatches: int, interleave: int = 1,
-                           axis_name: str = "pp", mask=None):
+                           axis_name: str = "pp", mask=None,
+                           fused_xent: bool = False):
     """Mean loss AND grads of a stage-sliced CausalLM — or MaskedLM when
     `mask` is given — under interleaved 1F1B. pp_params is the
     stack_lm_params / stack_mlm_params layout with blocks PRE-PERMUTED by
@@ -629,7 +632,8 @@ def pipeline_lm_1f1b_grads(cfg, pp_params, tokens, targets, mesh: Mesh,
     n_streams = 3 if masked else 2
     fn = shard_map(
         functools.partial(_lm_1f1b_local, cfg, sched, axis_name,
-                          psum_axes, masked, seq_sharded, tables),
+                          psum_axes, masked, seq_sharded, fused_xent,
+                          tables),
         mesh=mesh,
         in_specs=(specs,) + (stream_spec,) * n_streams,
         out_specs=(P(), P(),

@@ -78,6 +78,13 @@ class PipelineLMTrainer:
         if self.masked and cfg.causal:
             raise ValueError("masked_lm needs a causal=False (MaskedLM) "
                              "config")
+        # chunked tied-head xent on the LAST stage (lm_stage_head_loss
+        # fused=True): causal models only, like the unpiped path
+        self.fused_xent = bool(self.config.fused_xent)
+        if self.fused_xent and self.masked:
+            raise ValueError("fused_xent supports the causal LM only "
+                             "(BERT's MLM head has extra layers before "
+                             "the tied decoder)")
         if not self.masked and not cfg.causal:
             # next-token xent over a bidirectional model would leak every
             # future token — loss collapses while learning a degenerate
@@ -282,7 +289,8 @@ class PipelineLMTrainer:
             loss, grads = pipeline_lm_1f1b_grads(
                 self.cfg, state.params, tokens, targets, self.mesh,
                 self.num_microbatches, interleave=self.interleave,
-                mask=mask if self.masked else None)
+                mask=mask if self.masked else None,
+                fused_xent=self.fused_xent)
         elif self.masked:
             def loss_fn(params):
                 return pipeline_mlm_loss(self.cfg, params, tokens, targets,
@@ -297,7 +305,8 @@ class PipelineLMTrainer:
                 return pipeline_lm_loss(self.cfg, params, tokens, targets,
                                         self.mesh, self.num_microbatches,
                                         moe_aux_weight=w,
-                                        with_moe_metrics=True)
+                                        with_moe_metrics=True,
+                                        fused_xent=self.fused_xent)
             (loss, moe_metrics), grads = jax.value_and_grad(
                 loss_fn, has_aux=True)(state.params)
         updates, new_opt = state.tx.update(grads, state.opt_state,
@@ -372,7 +381,8 @@ class PipelineLMTrainer:
                         moe_aux_weight=0.0)
                 return pipeline_lm_loss(
                     self.cfg, params, tokens, targets, self.mesh,
-                    self.num_microbatches, moe_aux_weight=0.0)
+                    self.num_microbatches, moe_aux_weight=0.0,
+                    fused_xent=self.fused_xent)
 
             n_streams = 3 if self.masked else 2
             # params only (LMTrainer.compile_eval symmetry): the loss

@@ -231,7 +231,8 @@ class Trainer:
         if flops_per_step is None:
             per_image = flops.resnet_train_flops_per_image(
                 getattr(self.model, "arch", "") or "",
-                self.config.image_size)
+                self.config.image_size,
+                stem=getattr(self.model, "stem", "conv7"))
             flops_per_step = (per_image * self.config.global_batch_size
                               if per_image else None)
         stats = flops.throughput_stats(

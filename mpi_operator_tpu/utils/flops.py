@@ -155,12 +155,20 @@ _RESNET_FWD_FLOPS_224 = {
 
 
 def resnet_train_flops_per_image(model_name: str,
-                                 image_size: int = 224) -> Optional[float]:
+                                 image_size: int = 224,
+                                 stem: str = "conv7") -> Optional[float]:
     """fwd+bwd FLOPs per image ≈ 3× forward (bwd ≈ 2× fwd); conv FLOPs
-    scale with spatial area, so rescale from the 224px table."""
+    scale with spatial area, so rescale from the 224px table. The "s2d"
+    stem (models/resnet.py) replaces the 7×7/s2 conv with a 2×2 conv on
+    the 4×4 space-to-depth input — fewer actual FLOPs, so the table
+    value is adjusted or the reported MFU would overstate work done."""
     fwd = _RESNET_FWD_FLOPS_224.get(model_name)
     if fwd is None:
         return None
+    if stem == "s2d":
+        # at 224px: conv7 stem = 2·112²·64·(7·7·3) = 236.0 MF fwd;
+        # s2d stem = 2·56²·64·(2·2·48) = 77.1 MF fwd
+        fwd = fwd - (236.0e6 - 77.1e6)
     return 3.0 * fwd * (image_size / 224.0) ** 2
 
 

@@ -464,6 +464,41 @@ def test_elastic_timer_clears_when_workers_recover():
     assert job.status.elastic_tpus is None
 
 
+def test_elastic_window_rearms_on_operator_restart():
+    """The degraded/recovery COUNTDOWNS are process-memory by design: a
+    new controller re-observes not-Ready and starts a FRESH window (the
+    level-triggered-acceptable trade — a restart can delay a shrink by
+    up to one window, never cause a spurious one). The ARMING gate (has
+    the gang ever been Ready) is NOT process-memory: it rides the
+    persisted Running condition, so a restarted operator still knows a
+    once-Ready gang from a never-Ready one. Pinned here; documented in
+    README."""
+    f, clock = _elastic_fixture()
+    _elastic_go_running(f)
+    f.run("default/test")                  # timer arms in controller #1
+    clock.t += 45                          # 45s of the 60s window elapse
+
+    # operator restart: fresh controller, same API server state
+    f2 = Fixture.__new__(Fixture)
+    f2.api = f.api
+    from mpi_operator_tpu.controller import TPUJobController
+    from mpi_operator_tpu.controller.controller import ControllerConfig
+    f2.controller = TPUJobController(
+        f.api, config=ControllerConfig(elastic_degraded_seconds=60,
+                                       elastic_recovery_seconds=120))
+    f2.controller.factory.start_all()
+    f2.controller.now = clock
+    f2.run("default/test")                 # re-arms a FRESH window
+    clock.t += 30                          # 45 + 30 > 60 but fresh window
+    f2.run("default/test")
+    job = f.api.get(api.KIND, "default", "test")
+    assert job.status.elastic_tpus is None  # NOT shrunk yet
+    clock.t += 31                           # full fresh window elapses
+    f2.run("default/test")
+    job = f.api.get(api.KIND, "default", "test")
+    assert job.status.elastic_tpus == 4     # now it shrinks
+
+
 def test_elastic_never_shrinks_before_first_ready():
     """A fresh elastic gang that takes longer than the degraded window to
     schedule (image pulls, capacity waits) must NOT shrink below spec
