@@ -386,6 +386,7 @@ def run_generate_benchmark(
     temperature: float = 0.0,
     family: str = "gpt2",
     kv_cache_dtype: Optional[str] = None,
+    decode_kernel: Optional[bool] = None,
     log: Callable[[str], None] = print,
 ) -> Dict[str, float]:
     """Inference benchmark: KV-cache autoregressive decode throughput
@@ -393,7 +394,10 @@ def run_generate_benchmark(
     amortized in) for the gpt2 AND llama families (llama's GQA cache is
     num_heads/num_kv_heads× smaller, the decode-bandwidth win) — the
     inference half the reference has no analogue for. kv_cache_dtype=
-    "int8" halves the cache bytes again (quantized storage)."""
+    "int8" halves the cache bytes again (quantized storage).
+    decode_kernel: None = auto (the Pallas decode fast path on TPU, the
+    dense oracle elsewhere); True/False forces one side — the knob the
+    bench ladder uses to keep kernel-vs-dense an A/B on the same leg."""
     import time
 
     import jax
@@ -404,9 +408,15 @@ def run_generate_benchmark(
     from ..parallel import MeshConfig, make_mesh
 
     dtype = jnp.bfloat16 if dtype_name == "bfloat16" else jnp.float32
+    if decode_kernel is None:
+        # auto: the Pallas fast path wherever it compiles to Mosaic; CPU
+        # runs keep the dense oracle (interpret-mode pallas inside the
+        # decode scan is a simulation, not a measurement)
+        decode_kernel = jax.default_backend() == "tpu"
     name = f"{family}-{size}" if size else family
     model = create_lm(name, dtype=dtype,
                       kv_cache_dtype=kv_cache_dtype,
+                      decode_kernel=decode_kernel,
                       max_len=max(prompt_len + new_tokens, 32))
     mesh = make_mesh(MeshConfig(dp=jax.device_count()))
     variables, _ = shard_init(
@@ -461,13 +471,15 @@ def run_generate_benchmark(
         param_bytes=2 if dtype_name == "bfloat16" else 4,
         kv_cache_bytes=kv_elem_bytes, kv_scale_bytes=kv_scale_bytes)
     mbu_val = _flops.mbu(bytes_per_step, steps_per_sec=tps / batch)
-    log(f"generate {name}{' kv=int8' if kv_cache_dtype == 'int8' else ''}: "
+    log(f"generate {name}{' kv=int8' if kv_cache_dtype == 'int8' else ''}"
+        f"{' kernel' if decode_kernel else ''}: "
         f"batch={batch} prompt={prompt_len} "
         f"new={new_tokens}: {tps:.0f} new tokens/sec"
         + (f"  MBU {mbu_val:.1%}" if mbu_val is not None else ""))
     return {"decode_tokens_per_sec": tps,
             "tokens_per_iter": batch * new_tokens,
             "mbu": mbu_val,
+            "decode_kernel": bool(decode_kernel),
             "decode_bytes_per_step": bytes_per_step,
             "wall_seconds": dt}
 

@@ -132,7 +132,8 @@ def _generate_jit(dmodel, params, prompt, max_new_tokens, temperature,
 def generate(model, params, prompt, max_new_tokens: int,
              temperature: float = 0.0, rng: Optional[jax.Array] = None,
              eos_id: Optional[int] = None, top_k: Optional[int] = None,
-             top_p: Optional[float] = None) -> GenerateResult:
+             top_p: Optional[float] = None,
+             decode_kernel: Optional[bool] = None) -> GenerateResult:
     """Generate `max_new_tokens` continuations of `prompt` [B, P] int32.
 
     model — a trained CausalLM (training config; this fn builds the
@@ -140,6 +141,11 @@ def generate(model, params, prompt, max_new_tokens: int,
     sampling at the given temperature using `rng`, optionally filtered to
     the `top_k` most likely tokens and/or the `top_p` nucleus. `eos_id`
     freezes a row once it emits that token.
+
+    decode_kernel — None inherits the model config; True routes the
+    single-token decode steps through the Pallas decode-attention fast
+    path (GQA-native, length-aware cache reads, fused int8 dequant);
+    False pins the dense oracle. Prefill always runs dense.
     """
     cfg = model.config
     if not cfg.causal:
@@ -165,7 +171,9 @@ def generate(model, params, prompt, max_new_tokens: int,
     if top_p is not None and not 0.0 < top_p <= 1.0:
         raise ValueError(f"top_p={top_p} must be in (0, 1]")
     dmodel = type(model)(dataclasses.replace(
-        cfg, decode=True, attention="dense", remat=False))
+        cfg, decode=True, attention="dense", remat=False,
+        decode_kernel=(cfg.decode_kernel if decode_kernel is None
+                       else decode_kernel)))
     return _generate_jit(dmodel, params, prompt, int(max_new_tokens),
                          jnp.float32(temperature),
                          rng if rng is not None else jax.random.PRNGKey(0),

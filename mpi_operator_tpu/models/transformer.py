@@ -80,6 +80,15 @@ class TransformerConfig:
     # — halves cache HBM vs bf16, so the bandwidth-bound decode step reads
     # half the bytes. Dequantized transiently at attend time.
     kv_cache_dtype: Optional[str] = None
+    # decode fast path: single-token decode steps run the Pallas decode
+    # kernel (ops/attention.decode_attention) — GQA-native (no repeated-KV
+    # transient), length-aware cache reads (only the filled prefix
+    # streams), int8 dequant fused into the cache read. False keeps the
+    # dense einsum path, the CPU/correctness oracle. Prefill and tile-
+    # unaligned cache lengths always use the dense path.
+    decode_kernel: bool = False
+    # decode-kernel k-tile (None = ops.attention.decode_block_k default)
+    decode_block_k: Optional[int] = None
     remat: bool = False                # jax.checkpoint each block
     # what remat may KEEP: "none" recomputes everything (min memory, ~2×
     # block fwd recompute); "dots" saves matmul outputs with no batch dims
@@ -216,8 +225,18 @@ class Attention(nn.Module):
         multi-token prefill call and the steady-state single-token steps —
         the cursor (`cache_index`) advances by the call's length. RoPE is
         applied HERE (cursor-offset absolute positions) so cached keys
-        are pre-rotated; GQA caches the unrepeated kv_heads and repeats
-        only the transient attend operands."""
+        are pre-rotated.
+
+        Cache layout is kv-head-MAJOR [B, KV, L, D] (scales [B, KV, L]) —
+        the tiled form the Pallas decode kernel streams directly, and the
+        layout whose head axis tp-shards cleanly (logical "heads" → tp,
+        parallel/sharding.py "cache" rule for the length axis). GQA
+        caches the unrepeated kv_heads; with cfg.decode_kernel the
+        single-token steps run ops.attention.decode_attention, which is
+        GQA-native AND length-aware (only the filled prefix streams, int8
+        dequant fused into the read) — the dense path below stays the
+        correctness oracle and handles prefill + unaligned cache
+        lengths."""
         cfg = self.config
         B, S, H, D = q.shape
         KV = k.shape[2]
@@ -229,11 +248,16 @@ class Attention(nn.Module):
         if cfg.pos_embedding == "rope":
             q = rope(q, pos)
             k = rope(k, pos)
+        # incoming projections are [B, S, KV, D]; the cache wants the
+        # kv-head-major [B, KV, S, D] slab
+        k_t = k.transpose(0, 2, 1, 3)
+        v_t = v.transpose(0, 2, 1, 3)
+        k_scale = v_scale = None
         if cfg.kv_cache_dtype == "int8":
             # symmetric per-vector int8: scale = max|x|/127 over the head
             # dim, stored alongside. The cache is the decode bandwidth
-            # bottleneck (every step re-reads all L positions), so halving
-            # its bytes beats the tiny dequant cost.
+            # bottleneck (every step re-reads the filled prefix), so
+            # halving its bytes beats the tiny dequant cost.
             def quant(x):
                 scale = jnp.max(jnp.abs(x), axis=-1, keepdims=True) \
                     .astype(jnp.float32) / 127.0
@@ -243,58 +267,68 @@ class Attention(nn.Module):
                 return q8, scale[..., 0]
 
             ck = self.variable("cache", "cached_key", jnp.zeros,
-                               (B, L, KV, D), jnp.int8)
+                               (B, KV, L, D), jnp.int8)
             cv = self.variable("cache", "cached_value", jnp.zeros,
-                               (B, L, KV, D), jnp.int8)
+                               (B, KV, L, D), jnp.int8)
             ks = self.variable("cache", "key_scale", jnp.zeros,
-                               (B, L, KV), jnp.float32)
+                               (B, KV, L), jnp.float32)
             vs = self.variable("cache", "value_scale", jnp.zeros,
-                               (B, L, KV), jnp.float32)
-            k8, k_sc = quant(k)
-            v8, v_sc = quant(v)
-            ck.value = jax.lax.dynamic_update_slice(
-                ck.value, k8, (0, cur, 0, 0))
-            cv.value = jax.lax.dynamic_update_slice(
-                cv.value, v8, (0, cur, 0, 0))
+                               (B, KV, L), jnp.float32)
+            k8, k_sc = quant(k_t)
+            v8, v_sc = quant(v_t)
+            ck.value = _constrain_cache(jax.lax.dynamic_update_slice(
+                ck.value, k8, (0, 0, cur, 0)))
+            cv.value = _constrain_cache(jax.lax.dynamic_update_slice(
+                cv.value, v8, (0, 0, cur, 0)))
             ks.value = jax.lax.dynamic_update_slice(
-                ks.value, k_sc, (0, cur, 0))
+                ks.value, k_sc, (0, 0, cur))
             vs.value = jax.lax.dynamic_update_slice(
-                vs.value, v_sc, (0, cur, 0))
+                vs.value, v_sc, (0, 0, cur))
             ci.value = cur + S
-            keys = (ck.value.astype(cfg.dtype)
-                    * ks.value[..., None].astype(cfg.dtype))
-            values = (cv.value.astype(cfg.dtype)
-                      * vs.value[..., None].astype(cfg.dtype))
+            k_scale, v_scale = ks.value, vs.value
         else:
             ck = self.variable("cache", "cached_key", jnp.zeros,
-                               (B, L, KV, D), k.dtype)
+                               (B, KV, L, D), k.dtype)
             cv = self.variable("cache", "cached_value", jnp.zeros,
-                               (B, L, KV, D), v.dtype)
-            ck.value = jax.lax.dynamic_update_slice(ck.value, k,
-                                                    (0, cur, 0, 0))
-            cv.value = jax.lax.dynamic_update_slice(cv.value, v,
-                                                    (0, cur, 0, 0))
+                               (B, KV, L, D), v.dtype)
+            ck.value = _constrain_cache(jax.lax.dynamic_update_slice(
+                ck.value, k_t, (0, 0, cur, 0)))
+            cv.value = _constrain_cache(jax.lax.dynamic_update_slice(
+                cv.value, v_t, (0, 0, cur, 0)))
             ci.value = cur + S
+
+        if cfg.decode_kernel and S == 1:
+            from ..ops.attention import decode_attention, decode_block_k
+            if L % decode_block_k(L, cfg.decode_block_k) == 0:
+                out = decode_attention(
+                    q[:, 0], ck.value, cv.value, cur,
+                    k_scale=k_scale, v_scale=v_scale,
+                    block_k=cfg.decode_block_k)
+                return out[:, None]
+        # dense oracle path (prefill, CPU correctness, unaligned L)
+        if cfg.kv_cache_dtype == "int8":
+            keys = (ck.value.astype(cfg.dtype)
+                    * k_scale[..., None].astype(cfg.dtype))
+            values = (cv.value.astype(cfg.dtype)
+                      * v_scale[..., None].astype(cfg.dtype))
+        else:
             keys, values = ck.value, cv.value
         if KV != H:
-            keys = jnp.repeat(keys, H // KV, axis=2)
-            values = jnp.repeat(values, H // KV, axis=2)
-        logits = jnp.einsum("bqhd,bkhd->bhqk", q, keys)
+            keys = jnp.repeat(keys, H // KV, axis=1)
+            values = jnp.repeat(values, H // KV, axis=1)
+        logits = jnp.einsum("bqhd,bhkd->bhqk", q, keys)
         logits = logits.astype(jnp.float32) / jnp.sqrt(D)
         visible = jnp.arange(L)[None, :] <= pos[:, None]       # [S, L]
         logits = jnp.where(visible[None, None], logits, -1e30)
         probs = jax.nn.softmax(logits, axis=-1).astype(cfg.dtype)
-        return jnp.einsum("bhqk,bkhd->bqhd", probs, values)
+        return jnp.einsum("bhqk,bhkd->bqhd", probs, values)
 
 
 def _axis_bound(name: str) -> bool:
     """True when `name` is a live collective axis (we're tracing inside
     shard_map/pmap over it)."""
-    try:
-        jax.lax.axis_size(name)
-        return True
-    except NameError:
-        return False
+    from ..utils.compat import axis_bound
+    return axis_bound(name)
 
 
 def _attend(q, k, v, mask, cfg: TransformerConfig):
@@ -448,6 +482,15 @@ def _constrain(x):
     GSPMD infers clashing layouts around the layernorms and pays an
     involuntary full rematerialization in the backward."""
     return nn.with_logical_constraint(x, ("batch", "seq", "embed"))
+
+
+def _constrain_cache(x):
+    """Pin the decode KV cache to its serving layout: batch-sharded rows,
+    kv-head axis over tp ("heads" rule), length+head-dim replicated (the
+    "cache" rule). A no-op outside activation_rules_scope — generate()'s
+    plain-jit path lets GSPMD propagate the layout from the tp-sharded
+    projection params instead."""
+    return nn.with_logical_constraint(x, ("batch", "heads", "cache", "kv"))
 
 
 class Block(nn.Module):

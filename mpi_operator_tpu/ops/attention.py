@@ -45,12 +45,7 @@ NEG_INF = -1e30
 LANES = 128        # minor-dim width for row-statistic tensors
 
 
-def _out_struct(shape, dtype, *like):
-    """Pallas out_shape carrying the varying-manual-axes of its inputs, so
-    the kernels type-check under shard_map's default check_vma (ring
-    attention launches them inside a manual region)."""
-    vma = frozenset().union(*(jax.typeof(x).vma for x in like))
-    return jax.ShapeDtypeStruct(shape, dtype, vma=vma)
+from ..utils.compat import out_struct as _out_struct  # noqa: E402
 
 
 # ---------------------------------------------------------------------------
@@ -456,4 +451,165 @@ def flash_attention(q, k, v, causal: bool = True,
     return out.reshape(B, H, S, D).transpose(0, 2, 1, 3)
 
 
-__all__ = ["flash_attention"]
+# ---------------------------------------------------------------------------
+# Decode attention (single-query KV-cache step)
+# ---------------------------------------------------------------------------
+
+def _decode_kernel(cur_ref, q_ref, k_ref, v_ref, ks_ref, vs_ref, o_ref,
+                   acc_ref, m_ref, l_ref, *, sm_scale, block_k):
+    """One decode step for one (batch, kv-head) pair: grid (B, KV, nk),
+    k innermost. q block [G, D] holds ALL query heads of the group (GQA
+    runs natively — no repeated-KV transient anywhere). Length-aware:
+    k blocks past the cache cursor are skipped (their index_map pins to
+    the boundary block, so the pipeline re-uses the already-resident
+    block instead of streaming dead cache), and the boundary block masks
+    columns beyond the cursor. int8 caches dequantize BLOCKWISE in VMEM
+    (ks/vs are the per-position scales) — the bf16 cache transient the
+    dense path materializes in HBM never exists here."""
+    ki = pl.program_id(2)
+    nk = pl.num_programs(2)
+    cur = cur_ref[0]
+
+    @pl.when(ki == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+        m_ref[:] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+
+    @pl.when(ki * block_k <= cur)
+    def _attend():
+        q = q_ref[0, 0]                           # [G, D]
+        k = k_ref[0, 0]                           # [block_k, D]
+        v = v_ref[0, 0]
+        if ks_ref is not None:
+            # fused dequant: int8 cache block × per-position f32 scale,
+            # in the compute dtype (matches the dense oracle's
+            # cast-then-scale arithmetic exactly)
+            k = k.astype(q.dtype) * ks_ref[0, 0].astype(q.dtype)
+            v = v.astype(q.dtype) * vs_ref[0, 0].astype(q.dtype)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * sm_scale   # [G, block_k]
+        cols = jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        s = jnp.where(ki * block_k + cols <= cur, s, NEG_INF)
+
+        m_prev = m_ref[:, :1]
+        l_prev = l_ref[:, :1]
+        m_cur = jnp.max(s, axis=-1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        alpha = jnp.exp(m_prev - m_new)
+        p = jnp.exp(s - m_new)
+        l_new = l_prev * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        acc_ref[:] = acc_ref[:] * alpha + jax.lax.dot_general(
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        m_ref[:, :1] = m_new
+        l_ref[:, :1] = l_new
+
+    @pl.when(ki == nk - 1)
+    def _finalize():
+        l = jnp.maximum(l_ref[:, :1], 1e-30)
+        o_ref[0, 0] = (acc_ref[:] / l).astype(o_ref.dtype)
+
+
+def decode_block_k(max_len: int, block_k: Optional[int] = None) -> int:
+    """The k-tile the decode kernel will use for a cache of `max_len`
+    positions (callers gate on `max_len % decode_block_k(...) == 0`).
+    128 default: small tiles keep the length-aware skip granular — at
+    prompt=128/new=128 the second 128-tile streams only after the cache
+    actually grows past it, which is where the halved bytes/step comes
+    from — while staying Mosaic-legal for bf16 (16, 128) AND int8
+    (32, 128) cache tilings."""
+    return min(block_k or 128, max_len)
+
+
+def decode_attention(q, k_cache, v_cache, cache_index,
+                     k_scale=None, v_scale=None,
+                     block_k: Optional[int] = None,
+                     interpret: Optional[bool] = None):
+    """Single-step KV-cache attention — the decode fast path.
+
+    q            [B, H, D]      this step's queries (RoPE already applied)
+    k_cache/v_cache [B, KV, L, D]  the kv-head-major cache; bf16/f32, or
+                 int8 when k_scale/v_scale are given
+    cache_index  scalar int32   absolute position of this step's token;
+                 the kernel attends to cache positions <= cache_index and
+                 never streams the unfilled suffix
+    k_scale/v_scale [B, KV, L] f32  int8 per-(position, head) scales
+
+    Returns [B, H, D]. GQA (H > KV) is native: each kv head serves its
+    whole query group from one cache block — the [B, H, L, D] repeated
+    transient of the dense path never materializes. The cache length L
+    must tile by `decode_block_k(L, block_k)`; callers fall back to the
+    dense oracle otherwise.
+    """
+    B, H, D = q.shape
+    _, KV, L, _ = k_cache.shape
+    if H % KV:
+        raise ValueError(f"H={H} must be a multiple of KV={KV}")
+    G = H // KV
+    bk = decode_block_k(L, block_k)
+    if L % bk:
+        raise ValueError(f"cache len {L} does not tile by block_k={bk}; "
+                         f"use the dense decode path")
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    nk = L // bk
+    quantized = k_scale is not None
+
+    def last_blk(cur_ref):
+        return jnp.minimum(cur_ref[0] // bk, nk - 1)
+
+    q4 = q.reshape(B, KV, G, D)       # query head h ↔ kv head h // G,
+    #                                   matching jnp.repeat(kv, G, axis)
+    in_specs = [
+        pl.BlockSpec((1, 1, G, D), lambda b, h, ki, cur: (b, h, 0, 0)),
+        pl.BlockSpec((1, 1, bk, D),
+                     lambda b, h, ki, cur: (b, h,
+                                            jnp.minimum(ki, last_blk(cur)),
+                                            0)),
+        pl.BlockSpec((1, 1, bk, D),
+                     lambda b, h, ki, cur: (b, h,
+                                            jnp.minimum(ki, last_blk(cur)),
+                                            0)),
+    ]
+    args = [q4, k_cache, v_cache]
+    kern = functools.partial(_decode_kernel, sm_scale=1.0 / (D ** 0.5),
+                             block_k=bk)
+    if quantized:
+        # [B, KV, L] → [B, KV, L, 1]: a trailing unit lane dim makes the
+        # scale block Mosaic-legal (last dim equal to the array dim)
+        scale_spec = pl.BlockSpec(
+            (1, 1, bk, 1),
+            lambda b, h, ki, cur: (b, h, jnp.minimum(ki, last_blk(cur)), 0))
+        in_specs += [scale_spec, scale_spec]
+        args += [k_scale[..., None], v_scale[..., None]]
+    else:
+        inner = kern
+
+        def kern(cur_ref, q_ref, k_ref, v_ref, o_ref, *scratch,
+                 _inner=inner):
+            return _inner(cur_ref, q_ref, k_ref, v_ref, None, None, o_ref,
+                          *scratch)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(B, KV, nk),
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec((1, 1, G, D),
+                               lambda b, h, ki, cur: (b, h, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((G, D), jnp.float32),      # acc
+            pltpu.VMEM((G, LANES), jnp.float32),  # running max m
+            pltpu.VMEM((G, LANES), jnp.float32),  # running sum l
+        ],
+    )
+    out = pl.pallas_call(
+        kern,
+        grid_spec=grid_spec,
+        out_shape=_out_struct((B, KV, G, D), q.dtype, q, k_cache, v_cache),
+        interpret=interpret,
+    )(jnp.asarray(cache_index, jnp.int32).reshape(1), *args)
+    return out.reshape(B, H, D)
+
+
+__all__ = ["flash_attention", "decode_attention", "decode_block_k"]

@@ -24,7 +24,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
-from jax import shard_map
+from ..utils.compat import axis_size, shard_map
 
 
 # ---------------------------------------------------------------------------
@@ -74,7 +74,7 @@ def hierarchical_allreduce_mean(x, ici_axes: Sequence[str], dcn_axis: str):
     flat = x.reshape(-1)
     n_ici = 1
     for a in ici_axes:
-        n_ici *= lax.axis_size(a)
+        n_ici *= axis_size(a)
     pad = (-flat.shape[0]) % n_ici
     if pad:
         flat = jnp.pad(flat, (0, pad))
@@ -90,7 +90,7 @@ def hierarchical_allreduce_mean(x, ici_axes: Sequence[str], dcn_axis: str):
     full = lax.all_gather(shard, ici_axes[0], axis=0, tiled=True)
     if pad:
         full = full[:-pad]
-    total = lax.axis_size(dcn_axis) * n_ici
+    total = axis_size(dcn_axis) * n_ici
     return (full / total).reshape(x.shape)
 
 

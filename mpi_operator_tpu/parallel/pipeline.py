@@ -44,13 +44,14 @@ from __future__ import annotations
 
 import functools
 import math
+import sys
 from typing import Any, Callable
 
 import jax
 import jax.numpy as jnp
 import optax
 from jax import lax
-from jax import shard_map
+from ..utils.compat import axis_size, shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
 
@@ -92,7 +93,7 @@ def _pipeline_local(stage_fn: Callable, axis_name: str, M: int,
     stacked dim already local). x_local: [M/P, mb, ...] — this stage's
     chunk of the microbatch stream. Returns [M/P, mb, ...] outputs (each
     microbatch relayed back to the stage that owns its input chunk)."""
-    n_stages = lax.axis_size(axis_name)
+    n_stages = axis_size(axis_name)
     stage = lax.axis_index(axis_name)
     C = M // n_stages
 
@@ -414,7 +415,7 @@ def _lm_pipeline_local(cfg, axis_name: str, M: int, psum_axes, seq_sharded,
     from ..models.transformer import Block, _layer_norm
 
     mask_local = opt_mask[0] if opt_mask else None
-    n_stages = lax.axis_size(axis_name)
+    n_stages = axis_size(axis_name)
     stage = lax.axis_index(axis_name)
     C = M // n_stages
     T = M + n_stages - 1
@@ -555,6 +556,46 @@ def _lm_pipeline_local(cfg, axis_name: str, M: int, psum_axes, seq_sharded,
             lax.psum(aux_sum, psum_axes), lax.psum(drop_sum, psum_axes))
 
 
+# one warning per process — the schedule may be traced many times
+_CPU_AUTO_WARNED = False
+
+
+def _warn_cpu_auto_deadlock(cfg, mesh):
+    """Runtime heads-up for the module-docstring limitation: on the
+    XLA:CPU backend, an ACTIVE auto axis (tp or ep degree > 1) combined
+    with full model width (gpt2-small's 768×50304 reproduces it; narrow
+    test shapes don't) can deadlock the in-process collective rendezvous
+    — the run hangs ~40s per tick then dies on the termination timeout,
+    which looks like a sharding bug but isn't. Warn loudly up front so
+    the user recognizes the hang instead of bisecting their config."""
+    global _CPU_AUTO_WARNED
+    if _CPU_AUTO_WARNED:
+        return
+    try:
+        if jax.default_backend() != "cpu":
+            return
+    except Exception:  # noqa: BLE001 — backend probe must never raise
+        return
+    shape = dict(mesh.shape)
+    if max(shape.get("tp", 1), shape.get("ep", 1)) <= 1:
+        return
+    # the documented failing regime is full-width; tiny test/dryrun
+    # shapes (head matmuls ≲ 0.5M elements) rendezvous fine
+    if cfg.embed_dim * cfg.vocab_size < 8_000_000:
+        return
+    _CPU_AUTO_WARNED = True
+    print(
+        "WARNING: pipeline schedule on the XLA:CPU backend with an "
+        f"active AUTO axis (tp={shape.get('tp', 1)}, "
+        f"ep={shape.get('ep', 1)}) at full model width "
+        f"(embed_dim*vocab_size={cfg.embed_dim * cfg.vocab_size}) is "
+        "known to deadlock XLA:CPU's in-process collective rendezvous "
+        "(~40s/tick then a termination timeout — see "
+        "parallel/pipeline.py module docstring). Use narrower dims for "
+        "CPU simulation or run on a real TPU backend.",
+        file=sys.stderr)
+
+
 def _pipeline_stream_setup(cfg, mesh, pp_params, tokens, M,
                            axis_name, masked):
     """Shared prologue of pipeline_lm_loss / pipeline_mlm_loss — ONE
@@ -619,6 +660,7 @@ def _pipeline_stream_setup(cfg, mesh, pp_params, tokens, M,
     # einsums lowering to the expert all-to-all over ep — with no manual
     # collective code in the schedule.
     manual = frozenset(a for a in mesh.axis_names if a not in ("tp", "ep"))
+    _warn_cpu_auto_deadlock(cfg, mesh)
     return stream_spec, psum_axes, seq_sharded, specs, manual
 
 

@@ -40,7 +40,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 from jax import lax
-from jax import shard_map
+from ..utils.compat import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
 # dir codes in the schedule tables
@@ -628,7 +628,12 @@ def pipeline_lm_1f1b_grads(cfg, pp_params, tokens, targets, mesh: Mesh,
             else jax.tree.map(lambda _: P(), pp_params[k]))
         for k in pp_params
     }
-    manual = frozenset(a for a in mesh.axis_names if a != "tp")
+    # tp AND ep stay AUTO, matching _pipeline_stream_setup: claiming ep
+    # as manual here would desugar the MoE dispatch/combine einsums'
+    # expert all-to-all differently between the 1F1B and GPipe paths
+    manual = frozenset(a for a in mesh.axis_names if a not in ("tp", "ep"))
+    from .pipeline import _warn_cpu_auto_deadlock
+    _warn_cpu_auto_deadlock(cfg, mesh)
     n_streams = 3 if masked else 2
     fn = shard_map(
         functools.partial(_lm_1f1b_local, cfg, sched, axis_name,

@@ -37,7 +37,7 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 from jax import lax
-from jax import shard_map
+from ..utils.compat import axis_size, shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
 NEG_INF = -1e30
@@ -73,7 +73,7 @@ def _block_attend(q, k, v, bias_mask, prev):
 
 def _ring_dense_inner(q, k, v, axis_name: str, causal: bool):
     """Dense-einsum ring body — call INSIDE shard_map/pmap."""
-    n = lax.axis_size(axis_name)
+    n = axis_size(axis_name)
     my_idx = lax.axis_index(axis_name)
     B, S, H, D = q.shape
 
@@ -133,7 +133,7 @@ def _ring_flash_fwd_pass(axis_name, causal, block_q, block_k, interpret,
     """Forward ring over [BH, S, D] shards. Returns (out, lse [BH, S])."""
     from ..ops.attention import _flash_fwd
 
-    n = lax.axis_size(axis_name)
+    n = axis_size(axis_name)
     my_idx = lax.axis_index(axis_name)
     BH, S, D = q.shape
     sm_scale = 1.0 / (D ** 0.5)
@@ -207,7 +207,7 @@ def _ring_flash_core_bwd(axis_name, causal, block_q, block_k, interpret,
     from ..ops.attention import LANES, _dq_call, _dkv_call
 
     q, k, v, out, lse = res
-    n = lax.axis_size(axis_name)
+    n = axis_size(axis_name)
     my_idx = lax.axis_index(axis_name)
     BH, S, D = q.shape
     sm_scale = 1.0 / (D ** 0.5)

@@ -53,6 +53,12 @@ DEFAULT_RULES: Tuple[Tuple[str, Any], ...] = (
     ("expert_mlp", "tp"),
     ("layers", "pp"),
     ("norm", None),
+    # decode KV-cache length axis (models/transformer._constrain_cache):
+    # the cache is [batch, kv-heads, L, head_dim] — batch over the data
+    # axes, kv-heads over tp (the "heads" rule), L replicated. Keeping the
+    # length axis unsharded is what lets the decode kernel's length-aware
+    # reads stream a contiguous filled prefix per (batch, head).
+    ("cache", None),
 )
 
 # ACTIVATION rules (flax nn.with_logical_constraint at residual-stream
@@ -71,6 +77,7 @@ ACTIVATION_RULES: Tuple[Tuple[str, Any], ...] = (
     ("kv", None),
     ("mlp", "tp"),
     ("vocab", "tp"),
+    ("cache", None),       # decode KV-cache length axis, replicated
 )
 
 

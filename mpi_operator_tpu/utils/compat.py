@@ -1,0 +1,103 @@
+"""jax version compatibility — ONE place that knows which API vintage is
+installed.
+
+The codebase is written against the current jax surface (`jax.shard_map`,
+`jax.typeof(...).vma`, `jax.lax.axis_size`); the container may carry an
+older release (0.4.x) where shard_map still lives in jax.experimental with
+the (check_rep, auto) parameter spelling. Every module imports the
+new-style names from here instead of sniffing versions locally, so the
+whole repo flips vintage in one file.
+
+Exports:
+  shard_map(f, *, mesh, in_specs, out_specs, axis_names=None,
+            check_vma=None)
+      — the modern keyword surface. On legacy jax, `axis_names` (the
+      MANUAL axes) is translated to `auto` (its complement over
+      mesh.axis_names) and `check_vma` to `check_rep`.
+  out_struct(shape, dtype, *like)
+      — jax.ShapeDtypeStruct carrying the union of the `like` operands'
+      varying-manual-axes when the installed jax tracks VMA; a plain
+      struct otherwise (legacy jax has no vma typing to satisfy).
+  axis_bound(name)
+      — True when `name` is a live collective axis at trace time.
+"""
+from __future__ import annotations
+
+import jax
+
+try:
+    from jax import shard_map as _native_shard_map
+except ImportError:                                   # jax < 0.6
+    _native_shard_map = None
+    from jax.experimental.shard_map import shard_map as _legacy_shard_map
+
+try:
+    HAS_VMA = hasattr(jax.typeof(0.0), "vma")
+except AttributeError:                                # jax < 0.6
+    HAS_VMA = False
+
+
+if _native_shard_map is not None:
+    def shard_map(f, *, mesh, in_specs, out_specs, axis_names=None,
+                  check_vma=None):
+        kw = {}
+        if axis_names is not None:
+            kw["axis_names"] = axis_names
+        if check_vma is not None:
+            kw["check_vma"] = check_vma
+        return _native_shard_map(f, mesh=mesh, in_specs=in_specs,
+                                 out_specs=out_specs, **kw)
+else:
+    def shard_map(f, *, mesh, in_specs, out_specs, axis_names=None,
+                  check_vma=None):
+        kw = {}
+        if axis_names is not None:
+            auto = frozenset(mesh.axis_names) - frozenset(axis_names)
+            if auto:
+                kw["auto"] = auto
+                # legacy partial-auto shard_map can't infer replication
+                # through auto-axis regions; rep checking must be off
+                # unless the caller explicitly asked for it
+                if check_vma is None:
+                    check_vma = False
+        if check_vma is not None:
+            kw["check_rep"] = bool(check_vma)
+        return _legacy_shard_map(f, mesh=mesh, in_specs=in_specs,
+                                 out_specs=out_specs, **kw)
+
+
+def out_struct(shape, dtype, *like):
+    """Pallas out_shape carrying the varying-manual-axes of its inputs, so
+    kernels type-check under shard_map's default VMA checker (ring
+    attention launches them inside a manual region). Plain struct on
+    legacy jax (no vma typing there to satisfy)."""
+    if HAS_VMA:
+        vma = frozenset().union(*(jax.typeof(x).vma for x in like))
+        return jax.ShapeDtypeStruct(shape, dtype, vma=vma)
+    return jax.ShapeDtypeStruct(shape, dtype)
+
+
+def axis_size(name) -> int:
+    """Static size of a bound collective axis — `jax.lax.axis_size` where
+    it exists; `lax.psum(1, name)` (which constant-folds to a Python int
+    at trace time) on legacy jax."""
+    if hasattr(jax.lax, "axis_size"):
+        return jax.lax.axis_size(name)
+    return jax.lax.psum(1, name)
+
+
+def axis_bound(name: str) -> bool:
+    """True when `name` is a live collective axis (tracing inside
+    shard_map/pmap over it)."""
+    try:
+        if hasattr(jax.lax, "axis_size"):
+            jax.lax.axis_size(name)
+        else:                                         # jax < 0.5
+            jax.lax.axis_index(name)
+        return True
+    except NameError:
+        return False
+
+
+__all__ = ["shard_map", "out_struct", "axis_size", "axis_bound",
+           "HAS_VMA"]

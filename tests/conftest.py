@@ -18,3 +18,10 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 from mpi_operator_tpu.utils.hostplatform import force_host_platform  # noqa: E402
 
 force_host_platform(8)
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: multi-second compile variants, excluded from the tier-1 "
+        "gate (-m 'not slow')")

@@ -1,0 +1,60 @@
+"""bench.py harness behavior: the per-leg JSONL contract.
+
+Every measured leg appends one fsync'd {"leg": ...} record to --jsonl
+BEFORE the ladder moves on, so a bench process killed mid-ladder (the
+driver timeout, an OOM kill, a lost tunnel) still leaves the finished
+legs parseable on disk. The test runs a real bench.py subprocess on a
+SHRUNKEN leg list (--decode-legs), SIGKILLs it the moment the first
+record lands, and parses what survived — the acceptance shape of the
+failure mode this feature exists for.
+"""
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+BENCH = os.path.join(REPO, "bench.py")
+
+
+def _read_records(path):
+    if not os.path.exists(path):
+        return []
+    with open(path) as fh:
+        return [json.loads(line) for line in fh if line.strip()]
+
+
+def test_killed_mid_ladder_leaves_parseable_leg_records(tmp_path):
+    jsonl = str(tmp_path / "legs.jsonl")
+    env = os.environ.copy()
+    env["JAX_PLATFORMS"] = "cpu"
+    proc = subprocess.Popen(
+        [sys.executable, BENCH, "--smoke", "--workload", "generate",
+         "--decode-legs", "gpt2_decode,llama_decode",
+         "--jsonl", jsonl],
+        cwd=REPO, env=env,
+        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+    try:
+        deadline = time.monotonic() + 300
+        while time.monotonic() < deadline:
+            if _read_records(jsonl):
+                break                       # first leg landed — kill now
+            if proc.poll() is not None:
+                break                       # finished before we could kill
+            time.sleep(0.5)
+        else:
+            pytest.fail("no leg record within 300s")
+    finally:
+        if proc.poll() is None:
+            proc.send_signal(signal.SIGKILL)
+        proc.wait(timeout=60)
+
+    records = _read_records(jsonl)          # must parse line-by-line
+    assert records, "killed ladder left no per-leg records"
+    assert all("leg" in r for r in records)
+    first = next(r for r in records if r["leg"] == "gpt2_decode")
+    assert first["gpt2_decode_tokens_per_sec"] > 0

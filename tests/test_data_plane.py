@@ -48,7 +48,7 @@ def test_explicit_allreduce_matches_mean():
 
 def test_hierarchical_allreduce_matches_flat():
     """Two-phase ICI/DCN allreduce must equal a plain global mean."""
-    from jax import shard_map
+    from mpi_operator_tpu.utils.compat import shard_map
     mesh = make_mesh(MeshConfig(dp=4, dcn=2))
     x = jax.random.normal(jax.random.PRNGKey(0), (8, 33))  # odd inner dim
 
@@ -65,7 +65,7 @@ def test_hierarchical_allreduce_matches_flat():
 
 
 def test_allreduce_gradients_pytree():
-    from jax import shard_map
+    from mpi_operator_tpu.utils.compat import shard_map
     mesh = make_mesh(MeshConfig.data_parallel(8))
     tree = {"w": jnp.ones((8, 2)), "b": jnp.arange(8, dtype=jnp.float32)}
     fn = shard_map(lambda t: allreduce_gradients(t, ("dp",)),
@@ -231,7 +231,7 @@ def test_benchmark_profile_dir_writes_trace(tmp_path):
 def test_alltoall_matches_transpose():
     """alltoall over n ranks is a block transpose: rank i's j-th chunk
     lands as rank j's i-th chunk."""
-    from jax import shard_map
+    from mpi_operator_tpu.utils.compat import shard_map
     from jax.sharding import PartitionSpec as P
 
     from mpi_operator_tpu.parallel import MeshConfig, make_mesh
