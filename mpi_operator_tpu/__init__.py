@@ -6,3 +6,8 @@ pallas kernels) replacing the Horovod/NCCL container images the reference
 delegates to."""
 
 __version__ = "0.1.0"
+
+# importing the package applies the jax/flax API shims (utils/compat.py)
+# before any model code runs — e.g. the flax duplicate-logical-axis-name
+# patch that MaskedLM's ("embed", "embed") mlm_dense kernel needs
+from .utils import compat as _compat  # noqa: E402,F401

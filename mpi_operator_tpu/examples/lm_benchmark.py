@@ -56,6 +56,7 @@ def run_lm_benchmark(
     fused_xent: bool = False,
     flash_block_q: Optional[int] = None,
     flash_block_k: Optional[int] = None,
+    tp_overlap: bool = False,
     accum_steps: int = 1,
     data_dir: Optional[str] = None,
     train_dir: Optional[str] = None,
@@ -125,6 +126,17 @@ def run_lm_benchmark(
         # depth override: scaling studies + tiny pp×moe configs (the
         # "test" presets are 2 layers, which can't tile moe_every×pp)
         overrides["num_layers"] = num_layers
+    if tp_overlap:
+        # ring collective-matmul projections + vocab-parallel overlapped
+        # loss (parallel/collectives.py): only meaningful with a tp ring
+        if tp <= 1:
+            raise ValueError("--tp-overlap needs --tp > 1 (nothing to "
+                             "ring over)")
+        if pp > 1:
+            raise ValueError("--tp-overlap composes with the flat trainer "
+                             "only (the pipeline's partial-manual "
+                             "shard_map already binds pp)")
+        overrides["tp_overlap"] = True
     model = create_lm(name, dtype=dtype, attention=attention, remat=remat,
                       remat_policy=remat_policy, max_len=max(seq_len, 32),
                       **overrides)
@@ -598,6 +610,10 @@ def main(argv=None) -> int:
     parser.add_argument("--flash-block-k", type=int, default=0,
                         help="flash-attention k tile (0 = kernel auto "
                              "policy, see --flash-block-q)")
+    parser.add_argument("--tp-overlap", action="store_true",
+                        help="ring collective-matmul TP projections + "
+                             "overlapped vocab-parallel loss (needs "
+                             "--tp > 1; see README 'TP overlap')")
     parser.add_argument("--fused-xent", action="store_true",
                         help="chunked tied-head cross-entropy: the full "
                              "[B*S, vocab] logits never hit HBM - slower "
@@ -675,6 +691,7 @@ def main(argv=None) -> int:
                 fused_xent=args.fused_xent,
                 flash_block_q=args.flash_block_q or None,
                 flash_block_k=args.flash_block_k or None,
+                tp_overlap=args.tp_overlap,
                 accum_steps=args.accum_steps,
                 num_slices=info.num_slices,
                 attention=args.attention, remat=args.remat,

@@ -89,6 +89,17 @@ class TransformerConfig:
     decode_kernel: bool = False
     # decode-kernel k-tile (None = ops.attention.decode_block_k default)
     decode_block_k: Optional[int] = None
+    # latency-hiding tensor parallelism: run the tp-sharded projections
+    # (Attention qkv/out, Mlp in/out, and the fused-LM-loss logits matmul)
+    # as explicit ring collective-matmuls
+    # (parallel/collectives.allgather_matmul / matmul_reducescatter) under
+    # shard_map, with the tp all-gather/reduce-scatter decomposed into
+    # ppermute hops hidden behind the per-shard matmuls. False keeps the
+    # GSPMD einsum path — the correctness oracle (identical params either
+    # way, so checkpoints swap freely). Engages only when an ambient mesh
+    # has tp>1 and shapes divide (seq, heads, kv_heads, mlp_dim by tp);
+    # decode and pipeline-stage bodies always use the oracle path.
+    tp_overlap: bool = False
     remat: bool = False                # jax.checkpoint each block
     # what remat may KEEP: "none" recomputes everything (min memory, ~2×
     # block fwd recompute); "dots" saves matmul outputs with no batch dims
@@ -128,6 +139,63 @@ def _dense(features, name, logical_axes, dtype):
         bias_init=nn.with_logical_partitioning(
             nn.initializers.zeros, (logical_axes[-1],)),
     )
+
+
+class _ProjParams(nn.Module):
+    """Parameter container producing the SAME tree (names, shapes, init
+    fns, logical axes) as the nn.Dense/DenseGeneral it stands in for,
+    without running the matmul. The tp_overlap path consumes the kernels
+    explicitly inside shard_map (ring collective-matmuls,
+    parallel/collectives.py), so parameters trained on either path load
+    directly on the other."""
+    kernel_shape: tuple
+    bias_shape: tuple
+    kernel_axes: tuple
+    bias_axes: tuple
+
+    @nn.compact
+    def __call__(self):
+        k = self.param(
+            "kernel",
+            nn.with_logical_partitioning(kernel_init, self.kernel_axes),
+            self.kernel_shape, jnp.float32)
+        b = self.param(
+            "bias",
+            nn.with_logical_partitioning(nn.initializers.zeros,
+                                         self.bias_axes),
+            self.bias_shape, jnp.float32)
+        return k, b
+
+
+def tp_overlap_ring(cfg: "TransformerConfig", mesh, seq_len: int) -> int:
+    """Ring size for the tp-overlap path, or 0 for the oracle path.
+
+    Engages when cfg.tp_overlap is set, an ambient mesh carries tp>1, and
+    we're NOT decoding or already inside a manual region (pipeline-stage
+    bodies run under shard_map over pp — nesting another manual region
+    over tp there is the oracle path's job). Raises at trace time on
+    layouts the ring can't express rather than letting GSPMD produce an
+    opaque placement error: sp>1 (both would shard the sequence dim) and
+    seq_len not divisible by tp (the rotating shards must tile)."""
+    if not cfg.tp_overlap or cfg.decode or mesh is None:
+        return 0
+    shape = dict(mesh.shape)
+    tp = shape.get("tp", 1)
+    if tp <= 1:
+        return 0
+    if _axis_bound("tp") or _axis_bound("pp"):
+        return 0
+    if shape.get("sp", 1) > 1:
+        raise ValueError(
+            f"tp_overlap=True does not compose with sp={shape['sp']}>1 — "
+            f"both shard the sequence dim (the ring rotates seq-over-tp "
+            f"shards); set sp=1 or tp_overlap=False")
+    if seq_len % tp:
+        raise ValueError(
+            f"tp_overlap=True needs seq_len={seq_len} divisible by tp={tp}"
+            f" (the ring rotates one seq shard per rank); pad the sequence"
+            f" or disable tp_overlap")
+    return tp
 
 
 def rope(x, positions, base: float = 10000.0):
@@ -181,6 +249,14 @@ class Attention(nn.Module):
                 f" when num_heads={H} is (K/V heads shard over tp); choose "
                 f"tp from the divisors of num_kv_heads")
 
+        ring = tp_overlap_ring(cfg, mesh, S)
+        if ring and (H % ring or KV % ring):
+            raise ValueError(
+                f"tp_overlap=True needs num_heads={H} and kv_heads={KV} "
+                f"divisible by tp={ring} (head groups are the ring's "
+                f"stationary weight shards); choose tp from their common "
+                f"divisors or disable tp_overlap")
+
         def proj(heads, name):
             return nn.DenseGeneral(
                 axis=-1, dtype=cfg.dtype, features=(heads, D), name=name,
@@ -189,9 +265,12 @@ class Attention(nn.Module):
                 bias_init=nn.with_logical_partitioning(
                     nn.initializers.zeros, ("heads", "kv")),
             )
-        q = proj(H, "query")(x)
-        k = proj(KV, "key")(x)
-        v = proj(KV, "value")(x)
+        if ring:
+            q, k, v = self._overlap_qkv(x, mesh, ring)
+        else:
+            q = proj(H, "query")(x)
+            k = proj(KV, "key")(x)
+            v = proj(KV, "value")(x)
 
         if cfg.pos_embedding == "rope" and not cfg.decode:
             pos = jnp.arange(S) if positions is None else positions
@@ -209,6 +288,8 @@ class Attention(nn.Module):
                 v = jnp.repeat(v, H // KV, axis=2)
             out = _attend(q, k, v, mask=mask, cfg=cfg)
 
+        if ring:
+            return self._overlap_out(out, mesh, ring)
         out = nn.DenseGeneral(
             features=E, axis=(-2, -1), dtype=cfg.dtype, name="out",
             kernel_init=nn.with_logical_partitioning(
@@ -217,6 +298,83 @@ class Attention(nn.Module):
                 nn.initializers.zeros, ("embed",)),
         )(out)
         return out
+
+    def _overlap_qkv(self, x, mesh, tp):
+        """Fused qkv as ONE ring allgather_matmul: the three column-parallel
+        kernels concatenate along their (tp-local) output columns, so a
+        single rotation of the seq-over-tp x shards feeds all three
+        projections — one ring's worth of hops for q, k, AND v."""
+        from ..parallel.collectives import allgather_matmul
+        from ..parallel.sharding import (tp_manual_spec,
+                                         tp_overlap_activation_spec)
+        from ..utils.compat import shard_map
+        cfg = self.config
+        H, D, KV = cfg.num_heads, cfg.head_dim, cfg.kv_heads
+        E = x.shape[-1]
+        wq, bq = _ProjParams((E, H, D), (H, D), ("embed", "heads", "kv"),
+                             ("heads", "kv"), name="query")()
+        wk, bk = _ProjParams((E, KV, D), (KV, D), ("embed", "heads", "kv"),
+                             ("heads", "kv"), name="key")()
+        wv, bv = _ProjParams((E, KV, D), (KV, D), ("embed", "heads", "kv"),
+                             ("heads", "kv"), name="value")()
+        Hl, KVl = H // tp, KV // tp
+
+        def body(x_l, wq, bq, wk, bk, wv, bv):
+            w_cat = jnp.concatenate(
+                [wq.reshape(E, Hl * D), wk.reshape(E, KVl * D),
+                 wv.reshape(E, KVl * D)], axis=-1).astype(cfg.dtype)
+            y = allgather_matmul(x_l.astype(cfg.dtype), w_cat, "tp")
+            lead = y.shape[:-1]
+            q = y[..., :Hl * D].reshape(lead + (Hl, D)) + bq.astype(cfg.dtype)
+            k = (y[..., Hl * D:(Hl + KVl) * D].reshape(lead + (KVl, D))
+                 + bk.astype(cfg.dtype))
+            v = (y[..., (Hl + KVl) * D:].reshape(lead + (KVl, D))
+                 + bv.astype(cfg.dtype))
+            return q, k, v
+
+        w_spec = tp_manual_spec(("embed", "heads", "kv"))
+        b_spec = tp_manual_spec(("heads", "kv"))
+        head_spec = jax.sharding.PartitionSpec(
+            ("dcn", "dp", "fsdp"), None, "tp", None)
+        fn = shard_map(
+            body, mesh=mesh,
+            in_specs=(tp_overlap_activation_spec(3),
+                      w_spec, b_spec, w_spec, b_spec, w_spec, b_spec),
+            out_specs=(head_spec, head_spec, head_spec),
+            check_vma=False)
+        return fn(x, wq, bq, wk, bk, wv, bv)
+
+    def _overlap_out(self, a, mesh, tp):
+        """Row-parallel output projection as a ring matmul_reducescatter:
+        each rank contracts its head group and the partial [B,S,E] sums
+        rotate home one seq shard at a time, every hop hidden behind the
+        next partial's matmul. Returns the seq-over-tp sharded [B, S, E]
+        (the Block residual gathers it back via the activation rules)."""
+        from ..parallel.collectives import matmul_reducescatter
+        from ..parallel.sharding import (tp_manual_spec,
+                                         tp_overlap_activation_spec)
+        from ..utils.compat import shard_map
+        cfg = self.config
+        H, D, E = cfg.num_heads, cfg.head_dim, cfg.embed_dim
+        wo, bo = _ProjParams((H, D, E), (E,), ("heads", "kv", "embed"),
+                             ("embed",), name="out")()
+        Hl = H // tp
+
+        def body(a_l, w_l, b):
+            flat = a_l.reshape(a_l.shape[:-2] + (Hl * D,)).astype(cfg.dtype)
+            y = matmul_reducescatter(
+                flat, w_l.reshape(Hl * D, E).astype(cfg.dtype), "tp")
+            return y + b.astype(cfg.dtype)
+
+        fn = shard_map(
+            body, mesh=mesh,
+            in_specs=(jax.sharding.PartitionSpec(
+                          ("dcn", "dp", "fsdp"), None, "tp", None),
+                      tp_manual_spec(("heads", "kv", "embed")),
+                      tp_manual_spec(("embed",))),
+            out_specs=tp_overlap_activation_spec(3),
+            check_vma=False)
+        return fn(a, wo, bo)
 
     def _decode_attend(self, q, k, v):
         """KV-cache attention for autoregressive decoding: append this
@@ -443,19 +601,80 @@ class Mlp(nn.Module):
     @nn.compact
     def __call__(self, x):
         cfg = self.config
+        if cfg.activation not in ("gelu", "swiglu"):
+            raise ValueError(f"activation={cfg.activation!r}; expected "
+                             f"'gelu' or 'swiglu'")
+        from ..parallel.sharding import current_mesh
+        ring = tp_overlap_ring(cfg, current_mesh(), x.shape[-2])
+        if ring:
+            return self._overlap_ffn(x, current_mesh(), ring)
         if cfg.activation == "swiglu":
             gate = _dense(cfg.mlp_dim, "fc_gate", ("embed", "mlp"),
                           cfg.dtype)(x)
             up = _dense(cfg.mlp_dim, "fc_in", ("embed", "mlp"),
                         cfg.dtype)(x)
             h = nn.silu(gate) * up
-        elif cfg.activation == "gelu":
+        else:
             h = nn.gelu(_dense(cfg.mlp_dim, "fc_in", ("embed", "mlp"),
                                cfg.dtype)(x))
-        else:
-            raise ValueError(f"activation={cfg.activation!r}; expected "
-                             f"'gelu' or 'swiglu'")
         return _dense(cfg.embed_dim, "fc_out", ("mlp", "embed"), cfg.dtype)(h)
+
+    def _overlap_ffn(self, x, mesh, tp):
+        """The whole FFN as ONE manual region: allgather_matmul for the
+        column-parallel in/gate matmuls (fused into a single ring by
+        concatenating their tp-local columns), the activation on the
+        tp-local hidden columns, matmul_reducescatter for the row-parallel
+        out matmul. Entry slices the replicated residual into seq-over-tp
+        shards for free; the exit reduce-scatter leaves the output
+        seq-sharded and the Block residual gathers it."""
+        from ..parallel.collectives import (allgather_matmul,
+                                            matmul_reducescatter)
+        from ..parallel.sharding import (tp_manual_spec,
+                                         tp_overlap_activation_spec)
+        from ..utils.compat import shard_map
+        cfg = self.config
+        E, M = cfg.embed_dim, cfg.mlp_dim
+        if M % tp:
+            raise ValueError(
+                f"tp_overlap=True needs mlp_dim={M} divisible by tp={tp} "
+                f"(hidden columns are the ring's stationary weight shards)"
+                f"; resize mlp_dim or disable tp_overlap")
+        swiglu = cfg.activation == "swiglu"
+        if swiglu:
+            wg, bg = _ProjParams((E, M), (M,), ("embed", "mlp"), ("mlp",),
+                                 name="fc_gate")()
+        wi, bi = _ProjParams((E, M), (M,), ("embed", "mlp"), ("mlp",),
+                             name="fc_in")()
+        wo, bo = _ProjParams((M, E), (E,), ("mlp", "embed"), ("embed",),
+                             name="fc_out")()
+        Ml = M // tp
+
+        def body(x_l, *ws):
+            if swiglu:
+                wg_l, bg_l, wi_l, bi_l, wo_l, bo_l = ws
+                w_cat = jnp.concatenate([wg_l, wi_l], -1).astype(cfg.dtype)
+                y = allgather_matmul(x_l.astype(cfg.dtype), w_cat, "tp")
+                h = (nn.silu(y[..., :Ml] + bg_l.astype(cfg.dtype))
+                     * (y[..., Ml:] + bi_l.astype(cfg.dtype)))
+            else:
+                wi_l, bi_l, wo_l, bo_l = ws
+                h = nn.gelu(
+                    allgather_matmul(x_l.astype(cfg.dtype),
+                                     wi_l.astype(cfg.dtype), "tp")
+                    + bi_l.astype(cfg.dtype))
+            y = matmul_reducescatter(h, wo_l.astype(cfg.dtype), "tp")
+            return y + bo_l.astype(cfg.dtype)
+
+        col_specs = (tp_manual_spec(("embed", "mlp")),
+                     tp_manual_spec(("mlp",)))
+        in_specs = (tp_overlap_activation_spec(3),) \
+            + (col_specs if swiglu else ()) + col_specs \
+            + (tp_manual_spec(("mlp", "embed")), tp_manual_spec(("embed",)))
+        fn = shard_map(body, mesh=mesh, in_specs=in_specs,
+                       out_specs=tp_overlap_activation_spec(3),
+                       check_vma=False)
+        args = (x, wg, bg, wi, bi, wo, bo) if swiglu else (x, wi, bi, wo, bo)
+        return fn(*args)
 
 
 def _layer_norm(cfg, name):
@@ -763,6 +982,7 @@ def create_vit(name: str = "vit-b16", num_classes: int = 1000, **overrides):
 __all__ = [
     "TransformerConfig", "Attention", "Mlp", "Block", "Backbone",
     "CausalLM", "MaskedLM", "ViT", "dense_attention", "rope",
+    "tp_overlap_ring",
     "gpt2_config", "llama_config", "bert_config", "vit_config",
     "create_lm", "create_vit",
 ]

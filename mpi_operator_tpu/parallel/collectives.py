@@ -95,6 +95,251 @@ def hierarchical_allreduce_mean(x, ici_axes: Sequence[str], dcn_axis: str):
 
 
 # ---------------------------------------------------------------------------
+# Ring collective-matmuls — latency-hiding tensor parallelism
+# ---------------------------------------------------------------------------
+#
+# GSPMD serializes the tp-axis all-gather/reduce-scatter around every
+# projection: the full collective completes before the matmul issues. The
+# two primitives below decompose those collectives into `lax.ppermute`
+# neighbor hops and consume each arriving shard immediately, so XLA
+# schedules the next hop CONCURRENTLY with the current shard's matmul —
+# the same overlap schedule ring_attention.py uses for K/V blocks, applied
+# to the Megatron projection pair. Both carry a custom_vjp so the backward
+# pass gets the mirrored overlapped form (each primitive's cotangent is
+# built from the other's ring plus a rotating weight-gradient
+# accumulation) instead of whatever GSPMD would re-derive.
+#
+# Call these INSIDE shard_map over `axis_name` (models/transformer.py does
+# this behind TransformerConfig.tp_overlap; the plain einsum path stays
+# the correctness oracle).
+
+
+def _ring_perm(n):
+    return [(j, (j + 1) % n) for j in range(n)]
+
+
+def _rows(x, start, size):
+    """Slice `size` rows from the second-to-last dim at traced `start`."""
+    return lax.dynamic_slice_in_dim(x, start, size, axis=x.ndim - 2)
+
+
+def _tie(z, *like):
+    """Add a zero derived from `like` so fresh zeros/constants inherit the
+    operands' varying-manual-axes under shard_map's VMA typing (the
+    ring_attention carry-derivation trick; a no-op numerically and folded
+    by XLA)."""
+    t = jnp.zeros((), z.dtype)
+    for a in like:
+        t = t + (a * 0).sum().astype(z.dtype)
+    return z + t
+
+
+def _agm_fwd_pass(axis_name, x, w):
+    """out[.., src*Sl:(src+1)*Sl, :] = x_from_src @ w, for every ring rank
+    src — i.e. all_gather(x, rows) @ w with the gather decomposed into
+    n-1 ppermute hops, each overlapped with the previous shard's matmul."""
+    n = axis_size(axis_name)
+    idx = lax.axis_index(axis_name)
+    perm = _ring_perm(n)
+    Sl = x.shape[-2]
+    out_dtype = jnp.promote_types(x.dtype, w.dtype)
+    out0 = _tie(jnp.zeros(x.shape[:-2] + (n * Sl, w.shape[-1]), out_dtype),
+                x, w)
+
+    def body(t, carry):
+        x_t, out = carry
+        src = (idx - t) % n          # whose shard arrived after t hops
+        part = jnp.matmul(x_t, w).astype(out_dtype)
+        out = lax.dynamic_update_slice_in_dim(
+            out, part, src * Sl, axis=out.ndim - 2)
+        return lax.ppermute(x_t, axis_name, perm), out
+
+    # n-1 hops; the final shard's matmul needs no further permute
+    x_t, out = lax.fori_loop(0, n - 1, body, (x, out0))
+    src = (idx - (n - 1)) % n
+    part = jnp.matmul(x_t, w).astype(out_dtype)
+    return lax.dynamic_update_slice_in_dim(out, part, src * Sl,
+                                           axis=out.ndim - 2)
+
+
+def _mrs_fwd_pass(axis_name, x, w):
+    """reduce_scatter(x @ w, rows): the partial-product accumulator for
+    each destination chunk rotates around the ring, every rank adding its
+    local-contraction contribution as it passes through — the add for one
+    chunk overlaps the hop of the next. Partial sums accumulate in f32."""
+    n = axis_size(axis_name)
+    idx = lax.axis_index(axis_name)
+    perm = _ring_perm(n)
+    Sl = x.shape[-2] // n
+    out_dtype = jnp.promote_types(x.dtype, w.dtype)
+    acc0 = _tie(jnp.zeros(x.shape[:-2] + (Sl, w.shape[-1]), jnp.float32),
+                x, w)
+
+    def body(t, carry):
+        acc = carry
+        # the accumulator I hold at step t is bound for rank (idx-1-t);
+        # add my partial for that destination's rows, then pass it on
+        dst = (idx - 1 - t) % n
+        acc = acc + jnp.matmul(_rows(x, dst * Sl, Sl), w,
+                               preferred_element_type=jnp.float32)
+        return lax.ppermute(acc, axis_name, perm)
+
+    acc = lax.fori_loop(0, n - 1, body, acc0)
+    # after n-1 hops the accumulator is home: add my own rows, done
+    acc = acc + jnp.matmul(_rows(x, idx * Sl, Sl), w,
+                           preferred_element_type=jnp.float32)
+    return acc.astype(out_dtype)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(0,))
+def _agm(axis_name, x, w):
+    return _agm_fwd_pass(axis_name, x, w)
+
+
+def _agm_fwd(axis_name, x, w):
+    return _agm_fwd_pass(axis_name, x, w), (x, w)
+
+
+def _agm_bwd(axis_name, res, g):
+    """Mirrored overlap: dx is matmul_reducescatter(g, wᵀ) (the transpose
+    of an all-gather is a reduce-scatter); dw = all_gather(x)ᵀ @ g with x
+    re-rotated around the ring — both rings fused into one loop so the
+    hops of each hide behind the matmuls of the other."""
+    x, w = res
+    n = axis_size(axis_name)
+    idx = lax.axis_index(axis_name)
+    perm = _ring_perm(n)
+    Sl = x.shape[-2]
+    K = x.shape[-1]
+    N = w.shape[-1]
+    wt = w.T
+
+    def dx_part(dst):
+        return jnp.matmul(_rows(g, dst * Sl, Sl), wt,
+                          preferred_element_type=jnp.float32)
+
+    def dw_part(src, x_t):
+        g_chunk = _rows(g, src * Sl, Sl)
+        return jnp.matmul(x_t.reshape(-1, K).T.astype(jnp.float32),
+                          g_chunk.reshape(-1, N).astype(jnp.float32),
+                          preferred_element_type=jnp.float32)
+
+    def body(t, carry):
+        x_t, dacc, dw = carry
+        dacc = dacc + dx_part((idx - 1 - t) % n)
+        dw = dw + dw_part((idx - t) % n, x_t)
+        return (lax.ppermute(x_t, axis_name, perm),
+                lax.ppermute(dacc, axis_name, perm), dw)
+
+    dacc0 = _tie(jnp.zeros(x.shape[:-2] + (Sl, K), jnp.float32), g, w)
+    dw0 = _tie(jnp.zeros((K, N), jnp.float32), x, g)
+    x_t, dacc, dw = lax.fori_loop(0, n - 1, body, (x, dacc0, dw0))
+    dacc = dacc + dx_part(idx)
+    dw = dw + dw_part((idx - (n - 1)) % n, x_t)
+    return dacc.astype(x.dtype), dw.astype(w.dtype)
+
+
+_agm.defvjp(_agm_fwd, _agm_bwd)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(0,))
+def _mrs(axis_name, x, w):
+    return _mrs_fwd_pass(axis_name, x, w)
+
+
+def _mrs_fwd(axis_name, x, w):
+    return _mrs_fwd_pass(axis_name, x, w), (x, w)
+
+
+def _mrs_bwd(axis_name, res, g):
+    """Mirrored overlap: dx is allgather_matmul(g, wᵀ) (the transpose of a
+    reduce-scatter is an all-gather); dw = xᵀ @ all_gather(g) accumulated
+    as g rotates — fused into the same ring loop."""
+    x, w = res
+    n = axis_size(axis_name)
+    idx = lax.axis_index(axis_name)
+    perm = _ring_perm(n)
+    Sl = g.shape[-2]
+    K = x.shape[-1]
+    N = w.shape[-1]
+    wt = w.T
+    dx0 = _tie(jnp.zeros(x.shape, x.dtype), g, w)
+    dw0 = _tie(jnp.zeros((K, N), jnp.float32), x, g)
+
+    def step(src, g_t, dx, dw):
+        part = jnp.matmul(g_t, wt).astype(x.dtype)
+        dx = lax.dynamic_update_slice_in_dim(dx, part, src * Sl,
+                                             axis=dx.ndim - 2)
+        x_chunk = _rows(x, src * Sl, Sl)
+        dw = dw + jnp.matmul(x_chunk.reshape(-1, K).T.astype(jnp.float32),
+                             g_t.reshape(-1, N).astype(jnp.float32),
+                             preferred_element_type=jnp.float32)
+        return dx, dw
+
+    def body(t, carry):
+        g_t, dx, dw = carry
+        dx, dw = step((idx - t) % n, g_t, dx, dw)
+        return lax.ppermute(g_t, axis_name, perm), dx, dw
+
+    g_t, dx, dw = lax.fori_loop(0, n - 1, body, (g, dx0, dw0))
+    dx, dw = step((idx - (n - 1)) % n, g_t, dx, dw)
+    return dx, dw.astype(w.dtype)
+
+
+_mrs.defvjp(_mrs_fwd, _mrs_bwd)
+
+
+def allgather_matmul(x, w, axis_name: str = "tp"):
+    """Overlapped `all_gather(x, rows) @ w` — call INSIDE shard_map over
+    `axis_name`.
+
+    x: [..., S_local, K] — this rank's row shard of the gathered operand.
+    w: [K, N_local]      — this rank's (column) shard of the weight; the
+                           ring never communicates w.
+    Returns [..., n·S_local, N_local]: every rank's rows against the local
+    columns, with each ppermute hop hidden behind the previous shard's
+    matmul. The custom_vjp backward runs the mirrored rings (dx via the
+    reduce-scatter schedule, dw with x re-rotated)."""
+    if x.ndim < 2 or w.ndim != 2:
+        raise ValueError(
+            f"allgather_matmul: x must be rank>=2 and w rank 2; got "
+            f"x{x.shape} w{w.shape}")
+    if x.shape[-1] != w.shape[0]:
+        raise ValueError(
+            f"allgather_matmul: contraction mismatch — x[..., {x.shape[-1]}]"
+            f" @ w[{w.shape[0]}, ...] (x last dim must equal w first dim)")
+    return _agm(axis_name, x, w)
+
+
+def matmul_reducescatter(x, w, axis_name: str = "tp"):
+    """Overlapped `reduce_scatter(x @ w, rows)` — call INSIDE shard_map
+    over `axis_name`.
+
+    x: [..., S, K_local] — rows full, contraction dim locally sharded.
+    w: [K_local, N]      — this rank's (row) shard of the weight.
+    Returns [..., S/n, N]: rank r holds rows [r·S/n, (r+1)·S/n) of the
+    full cross-rank sum. The partial-product accumulator for each
+    destination rotates around the ring (f32 accumulation), each add
+    overlapping the next hop. S must divide the ring size."""
+    if x.ndim < 2 or w.ndim != 2:
+        raise ValueError(
+            f"matmul_reducescatter: x must be rank>=2 and w rank 2; got "
+            f"x{x.shape} w{w.shape}")
+    if x.shape[-1] != w.shape[0]:
+        raise ValueError(
+            f"matmul_reducescatter: contraction mismatch — x[..., "
+            f"{x.shape[-1]}] @ w[{w.shape[0]}, ...] (x last dim must equal "
+            f"w first dim)")
+    n = axis_size(axis_name)
+    if x.shape[-2] % n:
+        raise ValueError(
+            f"matmul_reducescatter: {x.shape[-2]} rows do not divide over "
+            f"the ring size {n} of axis {axis_name!r}; pad the row dim to "
+            f"a multiple of the tp degree or disable tp_overlap")
+    return _mrs(axis_name, x, w)
+
+
+# ---------------------------------------------------------------------------
 # Gradient allreduce over a pytree (the Horovod DistributedOptimizer hook)
 # ---------------------------------------------------------------------------
 
@@ -120,5 +365,6 @@ def sharded_allreduce_fn(mesh: Mesh, axis_names: Tuple[str, ...] = ("dp",)):
 __all__ = [
     "allreduce_mean", "allreduce_sum", "allgather", "broadcast",
     "reduce_scatter", "alltoall", "hierarchical_allreduce_mean",
+    "allgather_matmul", "matmul_reducescatter",
     "allreduce_gradients", "sharded_allreduce_fn",
 ]

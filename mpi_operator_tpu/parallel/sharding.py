@@ -78,7 +78,42 @@ ACTIVATION_RULES: Tuple[Tuple[str, Any], ...] = (
     ("mlp", "tp"),
     ("vocab", "tp"),
     ("cache", None),       # decode KV-cache length axis, replicated
+    # tp-overlap (ring collective-matmul) boundary layout: INSIDE the
+    # overlapped projections (models/transformer.py behind
+    # TransformerConfig.tp_overlap) the sequence dim is sharded over tp —
+    # the all-gather half of the Megatron collective pair is decomposed
+    # into ppermute hops hidden behind the per-shard matmuls
+    # (parallel/collectives.allgather_matmul/matmul_reducescatter), and
+    # the seq-over-tp shards are what rotates. "seq_tp" names that layout
+    # so boundary activations can be pinned with with_logical_constraint
+    # instead of a hand-built PartitionSpec.
+    ("seq_tp", "tp"),
 )
+
+
+def tp_overlap_activation_spec(rank: int = 3) -> "P":
+    """PartitionSpec of an activation at a ring collective-matmul boundary:
+    [batch, seq, ...] with batch over the data axes and SEQ over tp (the
+    "seq_tp" activation rule as a physical spec, for shard_map
+    in/out_specs where logical constraints don't reach)."""
+    return P(("dcn", "dp", "fsdp"), "tp", *([None] * (rank - 2)))
+
+
+def tp_manual_spec(logical_axes: Sequence[Optional[str]],
+                   rules=DEFAULT_RULES) -> "P":
+    """Physical spec of a parameter INSIDE the tp-overlap manual region:
+    dims whose logical rule involves tp stay manual-sharded over it
+    (those are the ring's stationary shards — the weights never move);
+    every other dim enters replicated. An fsdp-sharded storage dim is
+    therefore gathered at region entry — the same per-layer parameter
+    gather FSDP pays on the oracle path."""
+    table = dict(rules)
+    out = []
+    for name in logical_axes:
+        axis = table.get(name) if name is not None else None
+        axis_tuple = axis if isinstance(axis, tuple) else (axis,)
+        out.append("tp" if "tp" in axis_tuple else None)
+    return P(*out)
 
 
 # The mesh made ambient by activation_rules_scope. Model code that needs a
@@ -202,6 +237,7 @@ def shard_init(model: nn.Module, mesh: Mesh, rng, *init_args,
     return variables, out_shardings
 
 
-__all__ = ["DEFAULT_RULES", "activation_rules_scope", "current_mesh",
-           "logical_to_spec", "logical_sharding", "param_shardings",
-           "shard_init", "unbox"]
+__all__ = ["DEFAULT_RULES", "ACTIVATION_RULES", "activation_rules_scope",
+           "current_mesh", "logical_to_spec", "logical_sharding",
+           "param_shardings", "shard_init", "tp_manual_spec",
+           "tp_overlap_activation_spec", "unbox"]

@@ -163,6 +163,117 @@ def fused_lm_loss(h, table, targets, mask=None, num_chunks: int = 8,
     return total / d
 
 
+def tp_overlap_lm_loss(h, table, targets, mask, mesh, num_chunks: int = 8,
+                       denom=None):
+    """fused_lm_loss with the logits matmul VOCAB-PARALLEL and overlapped:
+    one manual region over the whole chunk scan where h enters seq-over-tp
+    sharded and each chunk's logits tile is a ring
+    `allgather_matmul(h_chunk, tableᵀ_local)` — the tp all-gather of the
+    hidden rows hides behind the per-shard vocab matmuls
+    (parallel/collectives.py), and the backward's dh comes out as the
+    mirrored overlapped reduce-scatter via the custom_vjp.
+
+    Each rank only ever holds a [B, C, V/tp] logits tile (the chunking
+    memory win times the vocab-parallel win); the softmax normalizer and
+    the target logit are completed across vocab shards with psums — the
+    Megatron vocab-parallel cross-entropy, in autodiff form. Numerically
+    equals fused_lm_loss / lm_loss to accumulation-order tolerance.
+
+    Requires vocab and seq divisible by the mesh's tp degree (raises with
+    the fix otherwise); trainers gate on TransformerConfig.tp_overlap."""
+    from ..parallel.collectives import allgather_matmul
+    from ..parallel.sharding import (tp_manual_spec,
+                                     tp_overlap_activation_spec)
+    from ..utils.compat import shard_map
+
+    B, S, E = h.shape
+    V = table.shape[0]
+    tp = dict(mesh.shape).get("tp", 1)
+    if V % tp:
+        raise ValueError(
+            f"tp_overlap=True needs vocab_size={V} divisible by tp={tp} "
+            f"(the table's vocab rows are the ring's stationary shards); "
+            f"pad the vocab (model configs pad to a multiple of 128) or "
+            f"disable tp_overlap")
+    if S % tp:
+        raise ValueError(
+            f"tp_overlap=True needs seq_len={S} divisible by tp={tp} (the "
+            f"ring rotates one seq shard per rank); pad the sequence or "
+            f"disable tp_overlap")
+    if mask is None:
+        mask = jnp.ones((B, S), jnp.float32)
+    mask = mask.astype(jnp.float32)
+    Sl = S // tp
+    nc = math.gcd(num_chunks, Sl)
+    Cl = Sl // nc
+    have_denom = denom is not None
+
+    def body(h_l, t_l, m_l, table_l, *d):
+        Bl = h_l.shape[0]
+        idx = lax.axis_index("tp")
+        Vl = table_l.shape[0]
+        offset = idx * Vl
+        wt = table_l.astype(h_l.dtype).T                 # [E, Vl]
+        h_r = jnp.moveaxis(h_l.reshape(Bl, nc, Cl, E), 1, 0)
+        t_r = jnp.moveaxis(t_l.reshape(Bl, nc, Cl), 1, 0)
+        m_r = jnp.moveaxis(m_l.reshape(Bl, nc, Cl), 1, 0)
+
+        def chunk(carry, xs):
+            h_c, t_c, m_c = xs                           # [Bl, Cl, ...]
+            # [Bl, tp·Cl, Vl]: every rank's chunk rows × my vocab columns;
+            # row placement (src·Cl) matches the tiled all_gather below
+            logits = allgather_matmul(h_c, wt, "tp")
+            t_g = lax.all_gather(t_c, "tp", axis=1, tiled=True)
+            # vocab-parallel softmax-xent: max/normalizer/target-pick each
+            # completed across the vocab shards with one collective
+            # (max via a tiny [tp, Bl, tp·Cl] all_gather — lax.pmax has no
+            # autodiff rule on legacy jax, and all_gather does even though
+            # the max's cotangent is stopped anyway)
+            lmax = lax.stop_gradient(
+                lax.all_gather(logits.max(-1), "tp").max(0))
+            ex = jnp.exp(logits.astype(jnp.float32) - lmax[..., None])
+            sumexp = lax.psum(ex.sum(-1), "tp")
+            t_loc = t_g - offset
+            valid = (t_loc >= 0) & (t_loc < Vl)
+            picked = jnp.take_along_axis(
+                logits, jnp.clip(t_loc, 0, Vl - 1)[..., None], axis=-1)[..., 0]
+            tgt = lax.psum(
+                jnp.where(valid, picked.astype(jnp.float32), 0.0), "tp")
+            losses = jnp.log(sumexp) + lmax - tgt        # [Bl, tp·Cl]
+            mine = lax.dynamic_slice_in_dim(losses, idx * Cl, Cl, axis=1)
+            return carry + (mine * m_c).sum()[None], None
+
+        # rank-1 carry: differentiating a scan with a RANK-0 carry inside
+        # legacy shard_map leaves a scalar residual the partial-eval can't
+        # name ({0: axes} on a shapeless aval -> _SpecError)
+        total, _ = lax.scan(jax.checkpoint(chunk),
+                            jnp.zeros((1,), jnp.float32), (h_r, t_r, m_r))
+        # sum the per-rank row contributions; NOT over pp/ep (batch and seq
+        # are replicated there — the value is already complete)
+        total = lax.psum(total, BATCH_AXES + ("tp",))
+        if have_denom:
+            dd = d[0].reshape(1)
+        else:
+            dd = jnp.maximum(lax.psum(m_l.sum(), BATCH_AXES + ("tp",)),
+                             1)[None]
+        # total stays rank-1 throughout: legacy shard_map also can't stitch
+        # rank-0 OUTPUTS under check_rep=False (the value IS mesh-constant
+        # after the psum; the caller drops the singleton)
+        return total / dd
+
+    seq_spec = tp_overlap_activation_spec(3)
+    row_spec = tp_overlap_activation_spec(2)
+    in_specs = (seq_spec, row_spec, row_spec,
+                tp_manual_spec(("vocab", "embed")))
+    args = [h, targets, mask, table]
+    if have_denom:
+        in_specs = in_specs + (P(),)
+        args.append(jnp.asarray(denom, jnp.float32))
+    fn = shard_map(body, mesh=mesh, in_specs=in_specs, out_specs=P(),
+                   check_vma=False)
+    return fn(*args)[0]
+
+
 class LMTrainer:
     """Sharded trainer over a Mesh. Params are created directly in their
     ruled layout (shard_init), the optimizer state inherits it, and the jit
@@ -235,6 +346,15 @@ class LMTrainer:
         return (self.config.fused_xent and mcfg is not None and mcfg.causal
                 and not self.config.masked_lm)
 
+    def _use_overlap_loss(self):
+        """Ring-overlapped vocab-parallel loss: only meaningful when the
+        mesh actually has a tp ring to rotate around and the model opted in
+        (TransformerConfig.tp_overlap). Falls back to fused_lm_loss (the
+        oracle path) otherwise — same loss value either way."""
+        mcfg = getattr(self.model, "config", None)
+        return (mcfg is not None and getattr(mcfg, "tp_overlap", False)
+                and dict(self.mesh.shape).get("tp", 1) > 1)
+
     def _loss_fn(self, params, tokens, targets, mask, denom=None,
                  aux_scale=1.0, include_aux=True):
         """`denom`/`aux_scale` support exact gradient accumulation: with
@@ -246,8 +366,13 @@ class LMTrainer:
             h, interm = self.model.apply(
                 {"params": params}, tokens, with_head=False,
                 mutable=["intermediates"])
-            loss = fused_lm_loss(h, params["wte"]["embedding"], targets,
-                                 mask, denom=denom)
+            if self._use_overlap_loss():
+                loss = tp_overlap_lm_loss(h, params["wte"]["embedding"],
+                                          targets, mask, self.mesh,
+                                          denom=denom)
+            else:
+                loss = fused_lm_loss(h, params["wte"]["embedding"], targets,
+                                     mask, denom=denom)
             logits = None
         else:
             logits, interm = self.model.apply(
@@ -477,4 +602,5 @@ def _opt_shardings(opt_abstract, params, param_sh, replicated):
 
 
 __all__ = ["LMTrainer", "LMTrainerConfig", "LMTrainState", "make_adamw",
-           "make_lr_schedule", "lm_loss", "fused_lm_loss"]
+           "make_lr_schedule", "lm_loss", "fused_lm_loss",
+           "tp_overlap_lm_loss"]

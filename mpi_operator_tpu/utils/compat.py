@@ -99,5 +99,86 @@ def axis_bound(name: str) -> bool:
         return False
 
 
+def _patch_threefry_partitionable() -> None:
+    """Modern jax defaults `jax_threefry_partitionable` to True; 0.4.x
+    ships it False, where a jit with sharded out_shardings can produce
+    DIFFERENT random bits than the same program unsharded. The repo's
+    shard_init contract (parallel/sharding.py) — and every
+    sharded-vs-replicated parity test — assumes the modern semantics:
+    identical values regardless of layout. Flip the flag to the modern
+    default; explicit user overrides (env/flag already set) are kept."""
+    try:
+        if not jax.config.jax_threefry_partitionable:
+            import os
+            if "JAX_THREEFRY_PARTITIONABLE" not in os.environ:
+                jax.config.update("jax_threefry_partitionable", True)
+    except AttributeError:      # flag removed once partitionable-only
+        pass
+
+
+_patch_threefry_partitionable()
+
+
+def cpu_collectives_solo_fallback() -> None:
+    """Make single-process CPU backend init survive a blanket
+    `jax_cpu_collectives_implementation=gloo`.
+
+    Multi-host launch wrappers set the gloo flag before the gang size is
+    known (cross-process CPU collectives need it), but this jaxlib
+    vintage's binding requires a live DistributedRuntimeClient —
+    `make_gloo_tcp_collectives(distributed_client=None)` is a TypeError,
+    so a process that (correctly) skipped jax.distributed.initialize
+    because num_processes == 1 can't even build its CPU backend. Newer
+    jaxlib accepts None. Called from bootstrap.initialize on the
+    single-process path: with no distributed client connected, drop back
+    to the in-process default before the backend first initializes."""
+    try:
+        from jax._src import distributed
+        from jax._src import xla_bridge as _xb
+        if distributed.global_state.client is not None:
+            return                      # real gang: gloo is wanted
+        # a flag, not a config-state attribute — read the holder directly
+        if _xb.CPU_COLLECTIVES_IMPLEMENTATION.value == "gloo":
+            jax.config.update("jax_cpu_collectives_implementation", "none")
+    except (ImportError, AttributeError):
+        pass                            # modern jaxlib: None is accepted
+
+
+def _patch_flax_duplicate_logical_names() -> None:
+    """flax >= 0.8 hard-errors when a parameter's logical axis names repeat
+    (`flax/linen/spmd.py:_logical_to_mesh_axes` raises "Dimensions (...)
+    occur more than once"). The repo's rule table takes the opposite,
+    well-defined stance (parallel/sharding.logical_to_spec): a mesh axis
+    shards at most one dim, so later duplicates REPLICATE — an
+    ("embed", "embed") square kernel (MaskedLM's mlm_dense) shards its
+    first dim and replicates the second. Rewrite duplicates to None before
+    flax's checker sees them; first occurrence keeps its rule, which is
+    exactly the layout logical_to_spec computes for the same names."""
+    try:
+        from flax.linen import spmd as _spmd
+    except ImportError:
+        return
+    orig = getattr(_spmd, "_logical_to_mesh_axes", None)
+    if orig is None or getattr(orig, "_dedup_wrapped", False):
+        return
+
+    def dedup(array_dim_names, rules=None):
+        if array_dim_names is not None:
+            seen = set()
+            fixed = []
+            for name in array_dim_names:
+                fixed.append(None if name in seen else name)
+                if isinstance(name, str):
+                    seen.add(name)
+            array_dim_names = tuple(fixed)
+        return orig(array_dim_names, rules)
+
+    dedup._dedup_wrapped = True
+    _spmd._logical_to_mesh_axes = dedup
+
+
+_patch_flax_duplicate_logical_names()
+
+
 __all__ = ["shard_map", "out_struct", "axis_size", "axis_bound",
            "HAS_VMA"]

@@ -25,3 +25,9 @@ def pytest_configure(config):
         "markers",
         "slow: multi-second compile variants, excluded from the tier-1 "
         "gate (-m 'not slow')")
+    config.addinivalue_line(
+        "markers",
+        "multichip: needs >1 device to be meaningful (tp/ring/pp meshes); "
+        "satisfied here by the 8 virtual CPU devices, but deselect with "
+        "-m 'not multichip' on a single real chip without the virtual "
+        "mesh")

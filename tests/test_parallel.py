@@ -18,6 +18,21 @@ from mpi_operator_tpu.models.transformer import (
 from mpi_operator_tpu.parallel import (
     MeshConfig, MoeMlp, make_mesh, pipeline_apply, ring_attention,
     shard_init, stack_stage_params)
+from mpi_operator_tpu.utils.compat import HAS_VMA
+
+# The pipeline's partial-manual shard_map (pp manual, tp/ep auto) +
+# lax.axis_index lowers to a PartitionId instruction that this jax
+# vintage's SPMD partitioner rejects outright ("UNIMPLEMENTED:
+# PartitionId instruction is not supported for SPMD partitioning") —
+# seed-era failures, triaged in ROADMAP "Open items". The probe is the
+# same one utils/compat.py keys its shims on: the modern (vma-style)
+# shard_map partitions these fine, so a jax upgrade re-enables them
+# automatically instead of leaving a stale skip behind.
+needs_partial_manual_spmd = pytest.mark.skipif(
+    not HAS_VMA,
+    reason="partial-manual shard_map + lax.axis_index lowers to a "
+           "PartitionId instruction this XLA's SPMD partitioner rejects "
+           "(ROADMAP Open items)")
 
 
 # ---------------------------------------------------------------------------
@@ -522,6 +537,7 @@ class TestPipelineLM:
         return (cfg, model, vs, pp_params, tk, tg, M, oracle,
                 pipeline_lm_loss, stack_lm_params)
 
+    @needs_partial_manual_spmd
     @pytest.mark.parametrize("dropless", [False, True])
     def test_pp_moe_matches_microbatched_unpiped(self, dropless):
         """pp×ep MoE (VERDICT r04 next #2): stage bodies scan (dense, MoE)
@@ -561,6 +577,7 @@ class TestPipelineLM:
                 np.asarray(a), np.asarray(b), atol=3e-4,
                 err_msg=jax.tree_util.keystr(path))
 
+    @needs_partial_manual_spmd
     def test_pp_moe_dp_sharded_runs(self):
         """pp×dp×ep MoE: with the microbatch dim manually dp-sharded each
         dp rank routes its own token slice (per-shard capacity budgets —
@@ -578,6 +595,7 @@ class TestPipelineLM:
         assert all(np.all(np.isfinite(np.asarray(x)))
                    for x in jax.tree.leaves(g))
 
+    @needs_partial_manual_spmd
     def test_pp_moe_trainer_end_to_end(self):
         """PipelineLMTrainer with a MoE config: init → train steps →
         loss decreases trend not required, but steps run, the drop rate
@@ -767,9 +785,11 @@ class TestPipelineTrainer:
                 err_msg=jax.tree_util.keystr(path))
         return init_state
 
+    @needs_partial_manual_spmd
     def test_one_step_matches_unpiped_trainer(self):
         self._assert_matches_unpiped(MeshConfig(pp=2, dp=4))
 
+    @needs_partial_manual_spmd
     def test_pp_tp_composes_with_megatron_shardings(self):
         """pp×tp×dp: block params placed with Megatron tp shardings
         (lm_stage_tp_specs) while pipeline_lm_loss runs tp as a GSPMD auto
@@ -1148,3 +1168,128 @@ class TestMoeDropless:
         out2, _ = jax.jit(m.apply)(vs_sharded, xs)
         np.testing.assert_allclose(np.asarray(ref), np.asarray(out2),
                                    atol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# ring collective-matmul (tp_overlap)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.multichip
+class TestRingCollectiveMatmul:
+    """allgather_matmul / matmul_reducescatter against the einsum oracle.
+
+    The ring decomposition (ppermute hops hidden behind per-shard matmuls)
+    must be a pure re-schedule: same values forward AND backward, where the
+    backward runs the mirrored ring via custom_vjp. Cotangents come from a
+    nonlinear loss so each output element gets a distinct pullback."""
+
+    def _mesh(self):
+        return make_mesh(MeshConfig(dp=2, tp=4))
+
+    def test_allgather_matmul_matches_einsum(self):
+        from mpi_operator_tpu.parallel.collectives import allgather_matmul
+        from mpi_operator_tpu.utils.compat import shard_map
+
+        mesh = self._mesh()
+        k0, k1 = jax.random.split(jax.random.PRNGKey(0))
+        x = jax.random.normal(k0, (2, 8, 16), jnp.float32)    # rows over tp
+        w = jax.random.normal(k1, (16, 12), jnp.float32)      # cols over tp
+
+        ring = shard_map(
+            lambda xl, wl: allgather_matmul(xl, wl, "tp"),
+            mesh=mesh,
+            in_specs=(P("dp", "tp", None), P(None, "tp")),
+            out_specs=P("dp", None, "tp"), check_vma=False)
+
+        def loss_ring(x, w):
+            return jnp.sin(ring(x, w)).sum()
+
+        def loss_ref(x, w):
+            return jnp.sin(jnp.einsum("bsk,kn->bsn", x, w)).sum()
+
+        np.testing.assert_allclose(
+            np.asarray(ring(x, w)), np.asarray(x @ w), atol=1e-5)
+        g_ring = jax.jit(jax.grad(loss_ring, argnums=(0, 1)))(x, w)
+        g_ref = jax.jit(jax.grad(loss_ref, argnums=(0, 1)))(x, w)
+        for a, b in zip(g_ring, g_ref):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       atol=1e-5, rtol=1e-5)
+
+    def test_matmul_reducescatter_matches_einsum(self):
+        from mpi_operator_tpu.parallel.collectives import matmul_reducescatter
+        from mpi_operator_tpu.utils.compat import shard_map
+
+        mesh = self._mesh()
+        k0, k1 = jax.random.split(jax.random.PRNGKey(1))
+        x = jax.random.normal(k0, (2, 8, 16), jnp.float32)    # K over tp
+        w = jax.random.normal(k1, (16, 12), jnp.float32)      # rows over tp
+
+        ring = shard_map(
+            lambda xl, wl: matmul_reducescatter(xl, wl, "tp"),
+            mesh=mesh,
+            in_specs=(P("dp", None, "tp"), P("tp", None)),
+            out_specs=P("dp", "tp", None), check_vma=False)
+
+        def loss_ring(x, w):
+            return jnp.sin(ring(x, w)).sum()
+
+        def loss_ref(x, w):
+            return jnp.sin(jnp.einsum("bsk,kn->bsn", x, w)).sum()
+
+        np.testing.assert_allclose(
+            np.asarray(ring(x, w)), np.asarray(x @ w), atol=1e-5)
+        g_ring = jax.jit(jax.grad(loss_ring, argnums=(0, 1)))(x, w)
+        g_ref = jax.jit(jax.grad(loss_ref, argnums=(0, 1)))(x, w)
+        for a, b in zip(g_ring, g_ref):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       atol=1e-5, rtol=1e-5)
+
+    def test_non_divisible_rows_rejected(self):
+        """S=6 cannot reduce-scatter over a 4-ring: a clear ValueError at
+        trace time, not a wrong-shaped output."""
+        from mpi_operator_tpu.parallel.collectives import matmul_reducescatter
+        from mpi_operator_tpu.utils.compat import shard_map
+
+        mesh = self._mesh()
+        x = jnp.ones((6, 16), jnp.float32)
+        w = jnp.ones((16, 12), jnp.float32)
+        f = shard_map(
+            lambda xl, wl: matmul_reducescatter(xl, wl, "tp"),
+            mesh=mesh,
+            in_specs=(P(None, "tp"), P("tp", None)),
+            out_specs=P("tp", None), check_vma=False)
+        with pytest.raises(ValueError, match="do not divide over the ring"):
+            f(x, w)
+
+    def test_contraction_mismatch_rejected(self):
+        from mpi_operator_tpu.parallel.collectives import allgather_matmul
+
+        with pytest.raises(ValueError, match="contraction mismatch"):
+            allgather_matmul(jnp.ones((4, 8)), jnp.ones((16, 4)))
+
+    def test_tp_overlap_train_step_matches_oracle(self):
+        """tp_overlap=True is a latency optimization, never a numerics
+        change: the full train step (qkv/out/ffn rings + the overlapped
+        fused LM loss) must track the einsum path loss-for-loss across an
+        optimizer update."""
+        import optax
+
+        from mpi_operator_tpu.train import LMTrainer, LMTrainerConfig
+
+        toks = jax.random.randint(jax.random.PRNGKey(5), (8, 17), 0, 256)
+        toks, tgts = toks[:, :-1], toks[:, 1:]
+        mesh = make_mesh(MeshConfig(dp=2, fsdp=2, tp=2))
+        outs = {}
+        for overlap in (False, True):
+            cfg = gpt2_config("test", attention="dense", dtype=jnp.float32,
+                              vocab_size=256, max_len=32,
+                              tp_overlap=overlap)
+            t = LMTrainer(CausalLM(cfg), mesh,
+                          LMTrainerConfig(global_batch_size=8, seq_len=16,
+                                          fused_xent=True),
+                          tx=optax.sgd(0.1))
+            s = t.init_state(jax.random.PRNGKey(0))
+            s, m1 = t.train_step(s, toks, tgts)
+            s, m2 = t.train_step(s, toks, tgts)   # after a real update
+            outs[overlap] = (float(m1["loss"]), float(m2["loss"]))
+        np.testing.assert_allclose(outs[True], outs[False], rtol=2e-6)
