@@ -436,6 +436,12 @@ def main() -> None:
             try:
                 decode_fields(line, prefix, **dkw)
             except Exception as exc:  # noqa: BLE001
+                # a preemption drain must keep its retryable exit
+                # semantics — swallowing it here would record a "failed
+                # leg" and exit 0, losing the gang restart
+                from mpi_operator_tpu.train.resilience import Preempted
+                if isinstance(exc, Preempted):
+                    raise
                 print(f"# {prefix} bench leg failed: {exc!r}",
                       file=sys.stderr)
                 line[f"{prefix}_error"] = type(exc).__name__
@@ -592,6 +598,12 @@ def main() -> None:
                 line.update(fields)
                 emit_leg(prefix, fields)
             except Exception as exc:  # noqa: BLE001
+                # a preemption drain must keep its retryable exit
+                # semantics — swallowing it here would record a "failed
+                # leg" and exit 0, losing the gang restart
+                from mpi_operator_tpu.train.resilience import Preempted
+                if isinstance(exc, Preempted):
+                    raise
                 print(f"# {prefix} bench leg failed: {exc!r}",
                       file=sys.stderr)
                 line[f"{prefix}_error"] = type(exc).__name__

@@ -128,6 +128,10 @@ class PodTemplateSpec:
     # wire-format toleration dicts ({key, operator, effect, ...}); used by
     # launcherOnMaster to tolerate the control-plane taint
     tolerations: List[Dict[str, str]] = field(default_factory=list)
+    # SIGTERM→SIGKILL budget: must cover one training step PLUS the
+    # synchronous emergency checkpoint the drain path writes (None =
+    # cluster default, k8s' 30s — usually too short for large states)
+    termination_grace_period_seconds: Optional[int] = None
 
     def main_container(self) -> Container:
         if not self.containers:

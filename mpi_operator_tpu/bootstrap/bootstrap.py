@@ -60,6 +60,14 @@ STATUS_PORT = 8477
 # v1alpha2 exit-code policy (ref common_types.go:150-155)
 LAUNCHER_LOST_EXIT = 213
 
+# Bounded exponential-backoff retry around jax.distributed.initialize:
+# the coordinator pod being seconds late is the COMMON case at gang
+# start (StatefulSet pods come up in any order), and a single un-retried
+# connect would turn that race into a crash-loop.
+ENV_INIT_RETRIES = "TPU_INIT_RETRIES"      # attempts, default 5
+ENV_INIT_BACKOFF = "TPU_INIT_BACKOFF"      # base delay seconds, default 1.0
+_INIT_BACKOFF_CAP = 30.0
+
 _ORDINAL_RE = re.compile(r"-(\d+)$")
 _SLICE_RE = re.compile(r"-s(\d+)-\d+$")   # <job>-worker-s<k>-<i>
 
@@ -262,6 +270,76 @@ def mark_ready(path: Optional[str] = None) -> Optional[str]:
     return path
 
 
+def _retryable_init_error(exc: BaseException) -> bool:
+    """Classify a jax.distributed.initialize failure: coordinator-not-yet-
+    listening (grpc connect/deadline errors) is retryable; an identity
+    mismatch (wrong rank, wrong gang size, double init) is NOT — retrying
+    a misconfiguration just hides the config bug behind a timeout."""
+    if isinstance(exc, ValueError):
+        return False
+    msg = str(exc).lower()
+    fatal = ("process id", "process_id", "num_processes", "mismatch",
+             "already initialized", "duplicate", "invalid")
+    return not any(marker in msg for marker in fatal)
+
+
+def _initialize_distributed(info: ProcessInfo,
+                            env: Mapping[str, str],
+                            log=print,
+                            init_fn=None,
+                            sleep=None) -> None:
+    """jax.distributed.initialize with bounded exponential backoff.
+    TPU_INIT_RETRIES attempts (default 5), TPU_INIT_BACKOFF base delay
+    doubling per attempt (default 1s, capped at 30s). A non-retryable
+    failure (see _retryable_init_error) raises immediately; exhausting
+    the budget raises BootstrapError. `init_fn`/`sleep` are injectable
+    for tests. Honors the delay-coordinator fault (TPU_FAULT_INJECT) so
+    the retry machinery itself is testable end-to-end."""
+    import time as _time
+
+    if init_fn is None:
+        import jax
+
+        def init_fn():
+            jax.distributed.initialize(
+                coordinator_address=info.coordinator_address,
+                num_processes=info.num_processes,
+                process_id=info.process_id,
+            )
+    sleep = sleep if sleep is not None else _time.sleep
+    attempts = max(1, int(env.get(ENV_INIT_RETRIES) or 5))
+    backoff = float(env.get(ENV_INIT_BACKOFF) or 1.0)
+    faults = None
+    if env.get("TPU_FAULT_INJECT"):
+        # deferred import: resilience lives train-side and pulls jax; only
+        # fault-injected runs (tests, drills) pay for it here
+        from ..train.resilience import FaultInjector
+        faults = FaultInjector.from_env(env)
+    last: Optional[BaseException] = None
+    for attempt in range(attempts):
+        try:
+            if faults is not None and faults.fail_init_attempt():
+                raise RuntimeError(
+                    "fault-inject: coordinator not yet listening "
+                    "(delay-coordinator)")
+            init_fn()
+            return
+        except Exception as exc:  # noqa: BLE001 — classified below
+            last = exc
+            if not _retryable_init_error(exc):
+                raise
+            if attempt == attempts - 1:
+                break
+            delay = min(backoff * (2 ** attempt), _INIT_BACKOFF_CAP)
+            log(f"jax.distributed.initialize attempt "
+                f"{attempt + 1}/{attempts} failed ({exc}); retrying in "
+                f"{delay:.1f}s")
+            sleep(delay)
+    raise BootstrapError(
+        f"jax.distributed.initialize failed after {attempts} attempt(s): "
+        f"{last}") from last
+
+
 def initialize(env: Optional[Mapping[str, str]] = None,
                hostname: Optional[str] = None) -> ProcessInfo:
     """Resolve + `jax.distributed.initialize`.
@@ -279,13 +357,7 @@ def initialize(env: Optional[Mapping[str, str]] = None,
     info = process_info(env, hostname)
     resolved_env = dict(os.environ if env is None else env)
     if not info.is_launcher and info.num_processes > 1:
-        import jax
-
-        jax.distributed.initialize(
-            coordinator_address=info.coordinator_address,
-            num_processes=info.num_processes,
-            process_id=info.process_id,
-        )
+        _initialize_distributed(info, resolved_env)
     elif not info.is_launcher:
         # a launch wrapper may have set cpu-collectives=gloo before the
         # gang size was known; with no distributed client this jaxlib
@@ -485,4 +557,5 @@ __all__ = [
     "ENV_SLICE_ID", "ENV_WORKERS_PER_SLICE",
     "StatusServer", "poll_status", "launcher_wait",
     "STATUS_PORT", "LAUNCHER_LOST_EXIT",
+    "ENV_INIT_RETRIES", "ENV_INIT_BACKOFF",
 ]

@@ -233,6 +233,8 @@ def template_to_manifest(t: PodTemplateSpec) -> dict:
             "nodeSelector": dict(t.node_selector),
             "volumes": [_volume_to_manifest(v) for v in t.volumes],
             "tolerations": [dict(tol) for tol in t.tolerations],
+            "terminationGracePeriodSeconds":
+                t.termination_grace_period_seconds,
         }),
     })
 
@@ -251,6 +253,8 @@ def template_from_manifest(m: dict) -> PodTemplateSpec:
         node_selector=dict(spec.get("nodeSelector") or {}),
         volumes=[_volume_from_manifest(v) for v in (spec.get("volumes") or [])],
         tolerations=[dict(t) for t in (spec.get("tolerations") or [])],
+        termination_grace_period_seconds=spec.get(
+            "terminationGracePeriodSeconds"),
     )
 
 

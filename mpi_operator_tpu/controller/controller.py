@@ -88,6 +88,11 @@ ANNOTATION_HEALTH_GATE = "tpu.kubeflow.org/health-gate"
 # recorded ON the StatefulSet so the resize gang-restart is level-triggered
 # and survives operator restarts (see get_or_create_worker_statefulsets)
 ANNOTATION_TEMPLATE_HASH = "tpu.kubeflow.org/template-hash"
+# worker default SIGTERM→SIGKILL budget when the template doesn't set one:
+# covers one training step plus the synchronous emergency checkpoint the
+# preemption drain writes (train/resilience.py) — k8s' 30s is too short
+# once model state reaches tens of GB
+DEFAULT_TERMINATION_GRACE_SECONDS = 60
 
 
 def _template_hash(template) -> str:
@@ -1390,6 +1395,13 @@ class TPUJobController:
                 self._discovery_init_container()
             ]
         template.restart_policy = "Always"    # ref :1021
+        if template.termination_grace_period_seconds is None:
+            # preemption drain budget: k8s' 30s default SIGKILLs mid-step
+            # for big states — the drain needs one step + one SYNCHRONOUS
+            # emergency checkpoint (train/resilience.py). User templates
+            # that set their own value win.
+            template.termination_grace_period_seconds = (
+                DEFAULT_TERMINATION_GRACE_SECONDS)
         if alloc.resource_type == RESOURCE_TPU:
             template.node_selector = {
                 **template.node_selector,

@@ -176,6 +176,16 @@ def main(argv=None) -> int:
                    log=print if info.is_coordinator else (lambda s: None))
         exit_code = 0
         return 0
+    except Exception as exc:
+        # preemption drain exits with its RETRYABLE code (128–255) so the
+        # controller restarts the gang; everything else keeps exit 1
+        from ..train.resilience import Preempted
+        if isinstance(exc, Preempted):
+            print(f"preempted: drained at step {exc.step}, exiting "
+                  f"{exc.exit_code} (retryable)", flush=True)
+            exit_code = exc.exit_code
+            return exit_code
+        raise
     finally:
         if status is not None:
             status.set_done(exit_code)

@@ -61,6 +61,10 @@ def run_lm_benchmark(
     data_dir: Optional[str] = None,
     train_dir: Optional[str] = None,
     ckpt_every: int = 0,
+    ckpt_keep: int = 0,
+    step_deadline: float = 0.0,
+    divergence_k: int = 3,
+    stop_at_step: Optional[int] = None,
     lr_schedule: str = "linear",
     decay_steps: int = 10_000,
     lr: Optional[float] = None,
@@ -69,7 +73,15 @@ def run_lm_benchmark(
     log: Callable[[str], None] = print,
 ) -> Tuple[object, Dict[str, float]]:
     """GPT-2 / llama / BERT token-stream benchmark on a dcn×dp×fsdp×tp
-    mesh."""
+    mesh.
+
+    Preemption contract: the synthetic streams are STEP-KEYED (batch i is
+    a pure function of global step i), so a run killed at step N and
+    restarted resumes with exactly the batches the uninterrupted run
+    would have trained on — resumption is token-identical, and
+    --stop-at-step T makes the restarted run finish at the same global
+    step the first run was aiming for. Real --data-dir shards replay from
+    their own file order instead."""
     import jax
     import jax.numpy as jnp
 
@@ -77,6 +89,7 @@ def run_lm_benchmark(
     from ..models.transformer import create_lm
     from ..parallel import MeshConfig, make_mesh
     from ..train.lm_trainer import LMTrainer, LMTrainerConfig
+    from ..train.resilience import ResilienceConfig, ResilienceContext
 
     n = jax.device_count()
     if ep > 1 and not moe_experts:
@@ -197,28 +210,47 @@ def run_lm_benchmark(
                                        interleave=pp_interleave)
         pp_state = pp_trainer.init_state(jax.random.PRNGKey(0))
         from ..train.checkpoint import maybe_resume, maybe_save
+        pp_resilience = ResilienceContext(
+            ResilienceConfig.from_env(train_dir=train_dir,
+                                      divergence_k=divergence_k,
+                                      step_deadline=step_deadline),
+            log=log)
+        pp_resilience.__enter__()
         # checkpoints live in CANONICAL layer order (schedule-agnostic);
         # the live state may be 1F1B-interleaved — convert around resume
         pp_state = pp_trainer.from_canonical_state(
             maybe_resume(train_dir, pp_trainer.canonical_state(pp_state),
                          log))
+        pp_resumed_step = int(pp_state.step)
+        if stop_at_step is not None:
+            remaining = (stop_at_step - pp_resumed_step
+                         - max(1, warmup_steps))
+            if remaining < 1:
+                log(f"stop_at_step={stop_at_step} already reached at "
+                    f"resumed step {pp_resumed_step}; running 1 step")
+            num_steps = max(1, remaining)
 
         class RawStream:
-            def __init__(self):
-                self._rng = jax.random.PRNGKey(1)
+            """Step-keyed like the unpiped TokenStream: batch i is
+            fold_in(base, i), so resumed runs replay the same batches."""
+
+            def __init__(self, start: int = 0):
+                self._base = jax.random.PRNGKey(1)
+                self._i = start
 
             def __iter__(self):
                 return self
 
             def __next__(self):
-                self._rng, sub = jax.random.split(self._rng)
+                sub, msub = jax.random.split(
+                    jax.random.fold_in(self._base, self._i))
+                self._i += 1
                 toks, tgts = synthetic_token_batch(sub, global_batch,
                                                    seq_len, cfg_vocab)
                 if masked:
                     # same MLM objective as the unpiped stream: targets
                     # are the ORIGINAL tokens, inputs corrupted at the
                     # masked slots with the mask id
-                    self._rng, msub = jax.random.split(self._rng)
                     mask = jax.random.uniform(
                         msub, toks.shape) < MLM_MASK_RATE
                     return (jnp.where(mask, cfg_vocab - 1, toks), toks,
@@ -260,16 +292,17 @@ def run_lm_benchmark(
                                         host_transform=pp_transform,
                                         vocab_size=cfg_vocab)
         else:
-            pp_stream = RawStream()
+            pp_stream = RawStream(start=pp_resumed_step)
         from ..train.checkpoint import periodic_saver
-        saver = periodic_saver(train_dir, ckpt_every, log)
+        saver = periodic_saver(train_dir, ckpt_every, log,
+                               keep_last=ckpt_keep)
         canonical_hook = (None if saver is None else (
             lambda st, step: saver(pp_trainer.canonical_state(st), step)))
         try:
             pp_state, pp_metrics = pp_trainer.benchmark(
                 pp_state, pp_stream, num_steps=num_steps,
                 warmup_steps=warmup_steps, log=log,
-                step_hook=canonical_hook)
+                step_hook=canonical_hook, resilience=pp_resilience)
             if eval_steps:
                 # held-out evaluation continues the stream past the
                 # trained batches (same contract as the unpiped path)
@@ -281,85 +314,120 @@ def run_lm_benchmark(
                     f"({eval_steps} batches)")
         finally:
             pp_stream.close()
+            pp_resilience.__exit__(None, None, None)
         maybe_save(train_dir, pp_trainer.canonical_state(pp_state), log)
         return pp_state, pp_metrics
     trainer = LMTrainer(model, mesh, tcfg)
     state = trainer.init_state(jax.random.PRNGKey(0))
 
     from ..train.checkpoint import maybe_resume, maybe_save
-    state = maybe_resume(train_dir, state, log)
-
-    class TokenStream:
-        def __init__(self):
-            self._rng = jax.random.PRNGKey(1)
-
-        def __iter__(self):
-            return self
-
-        def __next__(self):
-            self._rng, sub = jax.random.split(self._rng)
-            toks, tgts = synthetic_token_batch(sub, global_batch, seq_len,
-                                               cfg_vocab)
-            if masked:
-                # real MLM objective: targets are the ORIGINAL tokens at the
-                # masked positions and the input is corrupted there with the
-                # mask id (last vocab slot) — without the corruption the
-                # 'loss' is a degenerate copy objective
-                self._rng, msub = jax.random.split(self._rng)
-                mask = jax.random.uniform(msub, toks.shape) < MLM_MASK_RATE
-                tgts = toks
-                toks = jnp.where(mask, cfg_vocab - 1, toks)
-                return (jax.device_put(toks, trainer.batch_sharding),
-                        jax.device_put(tgts, trainer.batch_sharding),
-                        jax.device_put(mask.astype(jnp.float32),
-                                       trainer.batch_sharding))
-            toks = jax.device_put(toks, trainer.batch_sharding)
-            tgts = jax.device_put(tgts, trainer.batch_sharding)
-            return toks, tgts
-
-        def close(self):
-            pass
-
-    if data_dir:
-        from ..data.tokenstream import NpyTokenDataset
-        transform = None
-        if masked:
-            # MLM over the real stream: same objective constants as the
-            # synthetic branch above (MLM_MASK_RATE, mask id); numpy on
-            # the FEEDER thread so every output tensor is device_put with
-            # the trainer's sharding (eager jax ops on already-placed
-            # global arrays would break on multi-host)
-            mlm_rng = np.random.RandomState(3)
-
-            def transform(win):
-                toks = win[:, :-1]
-                mask = mlm_rng.random_sample(toks.shape) < MLM_MASK_RATE
-                return (np.where(mask, cfg_vocab - 1, toks).astype(np.int32),
-                        toks, mask.astype(np.float32))
-        stream = NpyTokenDataset(data_dir, global_batch, seq_len,
-                                 sharding=trainer.batch_sharding,
-                                 vocab_size=cfg_vocab,
-                                 host_transform=transform)
-    else:
-        stream = TokenStream()
-    from ..train.checkpoint import periodic_saver
+    resilience = ResilienceContext(
+        ResilienceConfig.from_env(train_dir=train_dir,
+                                  divergence_k=divergence_k,
+                                  step_deadline=step_deadline),
+        log=log)
+    # entering fires the corrupt-latest-checkpoint fault (if injected)
+    # BEFORE the resume below, so the fallback path is what gets tested
+    resilience.__enter__()
     try:
-        state, metrics = trainer.benchmark(
-            state, stream, num_steps=num_steps,
-            warmup_steps=warmup_steps, log=log, profile_dir=profile_dir,
-            step_hook=periodic_saver(train_dir, ckpt_every, log))
-        if eval_steps:
-            # evaluation continues the stream past the trained batches —
-            # fresh batches for synthetic/large-shard runs; point
-            # --data-dir at held-out shards for a true validation set
-            ev = trainer.evaluate(state, stream, num_batches=eval_steps)
-            metrics.update(ev)
-            log(f"val_loss: {ev['val_loss']:.3f}  "
-                f"perplexity: {ev['perplexity']:.1f}  "
-                f"({eval_steps} batches)")
+        state = maybe_resume(train_dir, state, log)
+        resumed_step = int(state.step)
+        if stop_at_step is not None:
+            # finish at the same GLOBAL step the uninterrupted run would
+            # have: warmup batches advance the step counter too
+            remaining = stop_at_step - resumed_step - max(1, warmup_steps)
+            if remaining < 1:
+                log(f"stop_at_step={stop_at_step} already reached at "
+                    f"resumed step {resumed_step}; running 1 step")
+            num_steps = max(1, remaining)
+
+        class TokenStream:
+            """Step-keyed stream: batch i is fold_in(base, i) — a resumed
+            run (start = restored step) consumes exactly the batches the
+            uninterrupted run would have at each global step."""
+
+            def __init__(self, start: int = 0):
+                self._base = jax.random.PRNGKey(1)
+                self._i = start
+
+            def __iter__(self):
+                return self
+
+            def __next__(self):
+                sub, msub = jax.random.split(
+                    jax.random.fold_in(self._base, self._i))
+                self._i += 1
+                toks, tgts = synthetic_token_batch(sub, global_batch,
+                                                   seq_len, cfg_vocab)
+                if masked:
+                    # real MLM objective: targets are the ORIGINAL tokens
+                    # at the masked positions and the input is corrupted
+                    # there with the mask id (last vocab slot) — without
+                    # the corruption the 'loss' is a degenerate copy
+                    # objective
+                    mask = (jax.random.uniform(msub, toks.shape)
+                            < MLM_MASK_RATE)
+                    tgts = toks
+                    toks = jnp.where(mask, cfg_vocab - 1, toks)
+                    return (jax.device_put(toks, trainer.batch_sharding),
+                            jax.device_put(tgts, trainer.batch_sharding),
+                            jax.device_put(mask.astype(jnp.float32),
+                                           trainer.batch_sharding))
+                toks = jax.device_put(toks, trainer.batch_sharding)
+                tgts = jax.device_put(tgts, trainer.batch_sharding)
+                return toks, tgts
+
+            def close(self):
+                pass
+
+        if data_dir:
+            from ..data.tokenstream import NpyTokenDataset
+            transform = None
+            if masked:
+                # MLM over the real stream: same objective constants as
+                # the synthetic branch above (MLM_MASK_RATE, mask id);
+                # numpy on the FEEDER thread so every output tensor is
+                # device_put with the trainer's sharding (eager jax ops on
+                # already-placed global arrays would break on multi-host)
+                mlm_rng = np.random.RandomState(3)
+
+                def transform(win):
+                    toks = win[:, :-1]
+                    mask = mlm_rng.random_sample(toks.shape) < MLM_MASK_RATE
+                    return (np.where(mask, cfg_vocab - 1,
+                                     toks).astype(np.int32),
+                            toks, mask.astype(np.float32))
+            stream = NpyTokenDataset(data_dir, global_batch, seq_len,
+                                     sharding=trainer.batch_sharding,
+                                     vocab_size=cfg_vocab,
+                                     host_transform=transform)
+        else:
+            stream = TokenStream(start=resumed_step)
+        from ..train.checkpoint import periodic_saver
+        try:
+            state, metrics = trainer.benchmark(
+                state, stream, num_steps=num_steps,
+                warmup_steps=warmup_steps, log=log,
+                profile_dir=profile_dir,
+                step_hook=periodic_saver(train_dir, ckpt_every, log,
+                                         keep_last=ckpt_keep),
+                resilience=resilience)
+            if eval_steps:
+                # evaluation continues the stream past the trained
+                # batches — fresh batches for synthetic/large-shard runs;
+                # point --data-dir at held-out shards for a true
+                # validation set
+                ev = trainer.evaluate(state, stream,
+                                      num_batches=eval_steps)
+                metrics.update(ev)
+                log(f"val_loss: {ev['val_loss']:.3f}  "
+                    f"perplexity: {ev['perplexity']:.1f}  "
+                    f"({eval_steps} batches)")
+        finally:
+            stream.close()
+        maybe_save(train_dir, state, log)
     finally:
-        stream.close()
-    maybe_save(train_dir, state, log)
+        resilience.__exit__(None, None, None)
     if moe_experts:
         # observable drop rate (parallel/moe.py sows it into the
         # "diagnostics" collection, which train steps don't carry): one
@@ -507,6 +575,9 @@ def run_vit_benchmark(
     data_dir: Optional[str] = None,
     train_dir: Optional[str] = None,
     ckpt_every: int = 0,
+    ckpt_keep: int = 0,
+    step_deadline: float = 0.0,
+    divergence_k: int = 3,
     log: Callable[[str], None] = print,
 ) -> Tuple[object, Dict[str, float]]:
     """ViT-B/16 image benchmark; --num-slices 2 is the BASELINE multi-slice
@@ -519,6 +590,7 @@ def run_vit_benchmark(
     from ..models.transformer import create_vit
     from ..parallel import MeshConfig, batch_sharding, make_mesh
     from ..train import Trainer, TrainerConfig
+    from ..train.resilience import ResilienceConfig, ResilienceContext
 
     n = jax.device_count()
     mesh = make_mesh(MeshConfig.data_parallel(n, num_slices=num_slices))
@@ -531,25 +603,37 @@ def run_vit_benchmark(
     trainer = Trainer(model, mesh, cfg)
     state = trainer.init_state(jax.random.PRNGKey(0))
     from ..train.checkpoint import maybe_resume, maybe_save
-    state = maybe_resume(train_dir, state, log)
-    if data_dir is not None:
-        from ..data.imagefolder import NpyImageDataset
-        dataset = NpyImageDataset(
-            data_dir, global_batch, image_size=image_size, dtype=dtype,
-            sharding=batch_sharding(mesh))
-    else:
-        dataset = SyntheticImageDataset(
-            global_batch, image_size=image_size, num_classes=1000,
-            dtype=dtype, sharding=batch_sharding(mesh))
-    from ..train.checkpoint import periodic_saver
+    resilience = ResilienceContext(
+        ResilienceConfig.from_env(train_dir=train_dir,
+                                  divergence_k=divergence_k,
+                                  step_deadline=step_deadline),
+        log=log)
+    resilience.__enter__()
     try:
-        state, metrics = trainer.benchmark(
-            state, dataset, num_steps=num_steps, warmup_steps=warmup_steps,
-            log=log, step_hook=periodic_saver(train_dir, ckpt_every, log))
+        state = maybe_resume(train_dir, state, log)
+        if data_dir is not None:
+            from ..data.imagefolder import NpyImageDataset
+            dataset = NpyImageDataset(
+                data_dir, global_batch, image_size=image_size, dtype=dtype,
+                sharding=batch_sharding(mesh))
+        else:
+            dataset = SyntheticImageDataset(
+                global_batch, image_size=image_size, num_classes=1000,
+                dtype=dtype, sharding=batch_sharding(mesh))
+        from ..train.checkpoint import periodic_saver
+        try:
+            state, metrics = trainer.benchmark(
+                state, dataset, num_steps=num_steps,
+                warmup_steps=warmup_steps, log=log,
+                step_hook=periodic_saver(train_dir, ckpt_every, log,
+                                         keep_last=ckpt_keep),
+                resilience=resilience)
+        finally:
+            if hasattr(dataset, "close"):
+                dataset.close()
+        maybe_save(train_dir, state, log)
     finally:
-        if hasattr(dataset, "close"):
-            dataset.close()
-    maybe_save(train_dir, state, log)
+        resilience.__exit__(None, None, None)
     return state, metrics
 
 
@@ -635,6 +719,24 @@ def main(argv=None) -> int:
                         help="async checkpoint every N steps into "
                              "--train-dir (mid-run gang restarts resume "
                              "from the last one; 0 = final only)")
+    parser.add_argument("--ckpt-keep", type=int, default=0,
+                        help="retain only the newest N step_ checkpoints "
+                             "(garbage-collect older ones after each "
+                             "save; 0 = keep everything)")
+    parser.add_argument("--step-deadline", type=float, default=0.0,
+                        help="watchdog: seconds a single post-compile "
+                             "step may take before the process dumps all "
+                             "stacks and aborts with a retryable exit "
+                             "code (0 = off; env TPU_STEP_DEADLINE)")
+    parser.add_argument("--divergence-k", type=int, default=3,
+                        help="consecutive non-finite steps (skipped "
+                             "updates) before rolling back to the newest "
+                             "checkpoint")
+    parser.add_argument("--stop-at-step", type=int, default=None,
+                        help="finish at this GLOBAL step instead of "
+                             "running --num-steps past the resume point "
+                             "— a preempted+restarted run ends at the "
+                             "same step the original was aiming for")
     parser.add_argument("--lr-schedule", default="linear",
                         choices=["linear", "cosine"],
                         help="warmup-linear (constant after warmup) or "
@@ -659,6 +761,8 @@ def main(argv=None) -> int:
     if info.is_launcher:
         return launcher_wait(info)
 
+    from ..train.resilience import Preempted
+
     status = StatusServer() if info.is_coordinator else None
     exit_code = 1
     log = print if info.is_coordinator else (lambda s: None)
@@ -671,6 +775,9 @@ def main(argv=None) -> int:
                 warmup_steps=args.warmup_steps, dtype_name=args.dtype,
                 num_slices=info.num_slices, data_dir=args.data_dir,
                 train_dir=args.train_dir, ckpt_every=args.ckpt_every,
+                ckpt_keep=args.ckpt_keep,
+                step_deadline=args.step_deadline,
+                divergence_k=args.divergence_k,
                 log=log)
             headline = {"metric": "vit_images_per_sec",
                         "value": round(metrics["images_per_sec"], 2),
@@ -699,6 +806,10 @@ def main(argv=None) -> int:
                 data_dir=args.data_dir,
                 train_dir=args.train_dir,
                 ckpt_every=args.ckpt_every,
+                ckpt_keep=args.ckpt_keep,
+                step_deadline=args.step_deadline,
+                divergence_k=args.divergence_k,
+                stop_at_step=args.stop_at_step,
                 lr_schedule=args.lr_schedule,
                 decay_steps=args.decay_steps,
                 lr=args.lr,
@@ -711,6 +822,14 @@ def main(argv=None) -> int:
             print(json.dumps(headline))
         exit_code = 0
         return 0
+    except Preempted as p:
+        # the emergency checkpoint is already committed (the loop saves
+        # before raising); exit in the 128–255 RETRYABLE band so the
+        # controller restarts the gang instead of failing the job
+        log(f"preempted: drained at step {p.step}, exiting "
+            f"{p.exit_code} (retryable)")
+        exit_code = p.exit_code
+        return exit_code
     finally:
         if status is not None:
             status.set_done(exit_code)

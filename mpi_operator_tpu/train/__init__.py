@@ -6,3 +6,8 @@ from .lm_trainer import (  # noqa: F401,E402
     LMTrainer, LMTrainerConfig, LMTrainState, lm_loss, make_adamw,
 )
 from .pp_trainer import PipelineLMTrainer, PPTrainState  # noqa: F401,E402
+from .resilience import (  # noqa: F401,E402
+    DivergenceError, FaultInjector, Preempted, PreemptionListener,
+    ResilienceConfig, ResilienceContext, Watchdog,
+    FAULT_DIE_EXIT, PREEMPTED_EXIT, WATCHDOG_STALL_EXIT, is_retryable_exit,
+)

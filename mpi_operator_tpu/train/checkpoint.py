@@ -11,7 +11,8 @@ with their recorded shardings.
 from __future__ import annotations
 
 import os
-from typing import Any, Optional
+import shutil
+from typing import Any, List, Optional, Tuple
 
 import jax
 import orbax.checkpoint as ocp
@@ -88,21 +89,55 @@ def save_checkpoint(directory: str, state, step: Optional[int] = None,
     return path
 
 
-def latest_checkpoint(directory: str) -> Optional[str]:
+def checkpoint_steps(directory: str) -> List[int]:
+    """Ascending step numbers of the step_N entries under `directory`
+    (committed names only — an in-flight orbax write lives under a tmp
+    name until its atomic rename)."""
+    directory = os.path.abspath(directory)
+    if not os.path.isdir(directory):
+        return []
+    return sorted(int(name[5:]) for name in os.listdir(directory)
+                  if name.startswith("step_") and name[5:].isdigit())
+
+
+def verify_checkpoint(path: str) -> bool:
+    """Cheap integrity check on a step_N candidate: the orbax commit
+    marker (tmp-named dirs are uncommitted writes; is_checkpoint_finalized
+    covers the commit_success variant on object stores) plus the
+    StandardSave metadata files a restore cannot start without. Content
+    corruption inside the array files is caught by the restore itself —
+    restore_with_fallback treats a raising restore the same way."""
+    base = os.path.basename(path)
+    if not (base.startswith("step_") and base[5:].isdigit()):
+        return False
+    if not os.path.isdir(path):
+        return False
+    try:
+        if ocp.utils.is_tmp_checkpoint(path):
+            return False
+        if not ocp.utils.is_checkpoint_finalized(path):
+            return False
+    except Exception:  # noqa: BLE001 — marker helpers vary across versions
+        pass
+    entries = set(os.listdir(path))
+    return "_METADATA" in entries
+
+
+def latest_checkpoint(directory: str, verify: bool = True) -> Optional[str]:
+    """Newest INTACT step_N path (or None). verify=True (default) skips
+    candidates that fail the commit-marker/metadata check, falling back
+    to the previous step — a crash mid-write or a half-deleted directory
+    must not take resume down with it."""
     # join any in-flight async write FIRST: an uncommitted step_N still
     # lives under its orbax tmp name and would be invisible to listdir,
     # silently resolving "latest" to an older checkpoint
     wait_for_checkpoints()
     directory = os.path.abspath(directory)
-    if not os.path.isdir(directory):
-        return None
-    steps = []
-    for name in os.listdir(directory):
-        if name.startswith("step_") and name[5:].isdigit():
-            steps.append(int(name[5:]))
-    if not steps:
-        return None
-    return os.path.join(directory, f"step_{max(steps)}")
+    for step in reversed(checkpoint_steps(directory)):
+        path = os.path.join(directory, f"step_{step}")
+        if not verify or verify_checkpoint(path):
+            return path
+    return None
 
 
 def restore_checkpoint(directory_or_path: str, state):
@@ -126,13 +161,42 @@ def restore_checkpoint(directory_or_path: str, state):
 
 
 __all__ = ["save_checkpoint", "restore_checkpoint", "latest_checkpoint",
+           "checkpoint_steps", "verify_checkpoint", "restore_with_fallback",
+           "gc_checkpoints", "reset_saved_state",
            "wait_for_checkpoints", "periodic_saver"]
 
 
+def restore_with_fallback(train_dir, state, log=print
+                          ) -> Tuple[Any, Optional[str]]:
+    """Newest-first restore with per-candidate fallback: a candidate that
+    fails the integrity check OR raises during the actual restore (bytes
+    scribbled inside a committed directory) logs a warning and falls back
+    to the previous step_N. Returns (state, restored_path) —
+    restored_path is None when nothing restorable exists (state returned
+    unchanged)."""
+    wait_for_checkpoints()
+    directory = os.path.abspath(train_dir)
+    for step in reversed(checkpoint_steps(directory)):
+        path = os.path.join(directory, f"step_{step}")
+        if not verify_checkpoint(path):
+            log(f"WARNING: checkpoint {path} failed the integrity check "
+                f"(uncommitted or torn write); falling back to the "
+                f"previous step")
+            continue
+        try:
+            return restore_checkpoint(path, state), path
+        except Exception as exc:  # noqa: BLE001 — corruption shapes vary
+            log(f"WARNING: checkpoint {path} is corrupt ({exc!r}); "
+                f"falling back to the previous step")
+    return state, None
+
+
 def maybe_resume(train_dir, state, log=print):
-    """Restore the latest checkpoint under train_dir into `state` (no-op
-    when train_dir is falsy or empty). The single resume path every
-    benchmark entrypoint shares.
+    """Restore the newest INTACT checkpoint under train_dir into `state`
+    (no-op when train_dir is falsy or empty). A corrupted newest step_N
+    falls back to the previous one with a logged warning instead of
+    killing the restart (restore_with_fallback). The single resume path
+    every benchmark entrypoint shares.
 
     Multi-host: train_dir MUST be a filesystem every host shares (PVC/
     NFS/GCS — the shipped manifests mount a PVC). Restore is a collective;
@@ -140,11 +204,9 @@ def maybe_resume(train_dir, state, log=print):
     and deadlock the ranks that enter against the ones that skip."""
     if not train_dir:
         return state
-    latest = latest_checkpoint(train_dir)
-    if latest is None:
-        return state
-    state = restore_checkpoint(latest, state)
-    log(f"resumed from {latest} (step {int(state.step)})")
+    state, path = restore_with_fallback(train_dir, state, log)
+    if path is not None:
+        log(f"resumed from {path} (step {int(state.step)})")
     return state
 
 
@@ -169,12 +231,47 @@ def maybe_save(train_dir, state, log=print):
     log(f"checkpoint written to {path}")
 
 
-def periodic_saver(train_dir, every: int, log=print):
+def gc_checkpoints(train_dir, keep_last: int, log=print) -> List[int]:
+    """Delete all but the newest `keep_last` committed step_N directories
+    (long runs checkpointing every N steps would otherwise fill the PVC).
+    Only process 0 deletes — deletion is NOT a collective, and concurrent
+    rmtree of the same shared-filesystem path from every rank races.
+    Returns the deleted step numbers (empty when disabled/nothing due).
+    The in-flight async write is invisible here (tmp-named until commit)
+    and the newest committed steps are by construction never deleted."""
+    if not train_dir or keep_last <= 0:
+        return []
+    if jax.process_index() != 0:
+        return []
+    directory = os.path.abspath(train_dir)
+    doomed = checkpoint_steps(directory)[:-keep_last]
+    for step in doomed:
+        shutil.rmtree(os.path.join(directory, f"step_{step}"),
+                      ignore_errors=True)
+    if doomed:
+        log(f"checkpoint gc: removed steps {doomed} "
+            f"(keep-last {keep_last})")
+    return doomed
+
+
+def reset_saved_state() -> None:
+    """Forget the per-directory last-saved records (and join any in-flight
+    write first, so a forgotten record can't race a background commit).
+    For test fixtures and back-to-back in-process runs against a REUSED
+    train_dir: without the reset, a second run reaching the same step
+    number would skip its legitimately-needed final save."""
+    wait_for_checkpoints()
+    _LAST_SAVED.clear()
+
+
+def periodic_saver(train_dir, every: int, log=print, keep_last: int = 0):
     """A `hook(state, step)` for training loops: every `every` steps it
     fires a NON-blocking async checkpoint (training overlaps the write —
     this is what makes mid-run gang restarts resumable instead of losing
-    the whole run). None when disabled; pair with wait_for_checkpoints()
-    (or the final maybe_save, which joins implicitly) before exit."""
+    the whole run). keep_last > 0 additionally garbage-collects older
+    step_N directories after each save (gc_checkpoints). None when
+    disabled; pair with wait_for_checkpoints() (or the final maybe_save,
+    which joins implicitly) before exit."""
     if not train_dir or every <= 0:
         return None
 
@@ -184,4 +281,6 @@ def periodic_saver(train_dir, every: int, log=print):
             # state.step, a device sync the training loop must not pay
             path = save_checkpoint(train_dir, state, step=step, block=False)
             log(f"async checkpoint -> {path}")
+            if keep_last > 0:
+                gc_checkpoints(train_dir, keep_last, log)
     return hook

@@ -39,6 +39,10 @@ class LMTrainState(struct.PyTreeNode):
     opt_state: Any
     tx: optax.GradientTransformation = struct.field(pytree_node=False)
     apply_fn: Callable = struct.field(pytree_node=False)
+    # consecutive non-finite (skipped) steps, maintained ON DEVICE by the
+    # divergence guard (resilience.guard_nonfinite_update); not persisted
+    # in checkpoints (a restore starts a fresh streak)
+    nonfinite_streak: Any = 0
 
     def apply_gradients(self, grads):
         updates, new_opt = self.tx.update(grads, self.opt_state, self.params)
@@ -77,6 +81,10 @@ class LMTrainerConfig:
     # _loss_fn), with activation memory divided by accum_steps
     accum_steps: int = 1
     log_every: int = 10
+    # divergence guard: a step with non-finite loss/grad-norm applies NO
+    # update (resilience.guard_nonfinite_update); numerically a no-op on
+    # finite steps, the selects fuse into the optimizer update
+    guard_nonfinite: bool = True
 
 
 def make_lr_schedule(cfg: LMTrainerConfig) -> optax.Schedule:
@@ -335,10 +343,12 @@ class LMTrainer:
         opt_state = jax.jit(init_opt, out_shardings=opt_sh)(params)
         state = LMTrainState(step=jnp.zeros((), jnp.int32), params=params,
                              opt_state=opt_state, tx=self.tx,
-                             apply_fn=self.model.apply)
+                             apply_fn=self.model.apply,
+                             nonfinite_streak=jnp.zeros((), jnp.int32))
         self._state_shardings = LMTrainState(
             step=self.replicated, params=param_sh, opt_state=opt_sh,
-            tx=self.tx, apply_fn=self.model.apply)
+            tx=self.tx, apply_fn=self.model.apply,
+            nonfinite_streak=self.replicated)
         return state
 
     def _use_fused(self):
@@ -410,14 +420,17 @@ class LMTrainer:
                 (tokens.reshape(A, B // A, *tokens.shape[1:]),
                  targets.reshape(A, B // A, *targets.shape[1:]),
                  mask.reshape(A, B // A, *mask.shape[1:])))
-            state = state.apply_gradients(grad_sum)
+            state = self._guarded(state, state.apply_gradients(grad_sum),
+                                  loss_sum, grad_sum)
             # accuracy would need the per-microbatch logits kept alive —
             # defeats the memory point of accumulating
             return state, {"loss": loss_sum,
-                           "accuracy": jnp.full((), jnp.nan)}
+                           "accuracy": jnp.full((), jnp.nan),
+                           "nonfinite_streak": state.nonfinite_streak}
         (loss, logits), grads = jax.value_and_grad(
             self._loss_fn, has_aux=True)(state.params, tokens, targets, mask)
-        state = state.apply_gradients(grads)
+        state = self._guarded(state, state.apply_gradients(grads), loss,
+                              grads)
         if logits is None:
             # fused path never materializes logits; accuracy is a
             # diagnostic, not worth a second vocab projection
@@ -425,7 +438,14 @@ class LMTrainer:
         else:
             acc = jnp.sum((jnp.argmax(logits, -1) == targets) * mask) \
                 / jnp.maximum(mask.sum(), 1)
-        return state, {"loss": loss, "accuracy": acc}
+        return state, {"loss": loss, "accuracy": acc,
+                       "nonfinite_streak": state.nonfinite_streak}
+
+    def _guarded(self, old_state, new_state, loss, grads):
+        if not self.config.guard_nonfinite:
+            return new_state
+        from .resilience import guard_nonfinite_update
+        return guard_nonfinite_update(old_state, new_state, loss, grads)
 
     def compile_step(self):
         if self._step is None:
@@ -520,11 +540,17 @@ class LMTrainer:
                   warmup_steps: int = 5, log: Callable[[str], None] = print,
                   profile_dir: Optional[str] = None,
                   step_hook: Optional[Callable] = None,
+                  resilience=None,
                   ) -> Tuple[LMTrainState, Dict[str, float]]:
         """tokens/sec measurement, same windowed protocol as
         train.trainer.Trainer.benchmark (ref README.md:113-131 format).
         step_hook(state, step) fires after every step (periodic async
-        checkpointing — train/checkpoint.periodic_saver)."""
+        checkpointing — train/checkpoint.periodic_saver).
+
+        resilience: an entered train.resilience.ResilienceContext —
+        per-step stop-bit check (emergency checkpoint + Preempted on a
+        gang drain) and divergence rollback at window fetches; see
+        Trainer.benchmark."""
         cfg = self.config
         it = iter(dataset)
         probe = next(it)
@@ -548,6 +574,13 @@ class LMTrainer:
                 state, metrics = self.train_step(state, *batch)
                 if step_hook is not None:
                     step_hook(state, base_step + i)
+                if resilience is not None \
+                        and resilience.on_step(base_step + i):
+                    from .resilience import Preempted
+                    log(f"preemption drain: stopping the gang at step "
+                        f"{base_step + i}")
+                    resilience.emergency_save(state)
+                    raise Preempted(base_step + i)
                 if i % log_every == 0:
                     loss = float(metrics["loss"])
                     t1 = time.perf_counter()       # BEFORE the trace write
@@ -555,6 +588,11 @@ class LMTrainer:
                     tps = tokens_per_step * log_every / (t1 - t0)
                     windows.append(tps)
                     log(f"{i}\ttokens/sec: {tps:.0f}\tloss: {loss:.3f}")
+                    if resilience is not None and int(
+                            metrics.get("nonfinite_streak", 0)
+                    ) >= resilience.config.divergence_k:
+                        state = resilience.rollback(state)
+                        base_step = int(state.step) - i
                     t0 = time.perf_counter()
         finally:
             profiler.stop_if_active()

@@ -230,8 +230,20 @@ class PipelineLMTrainer:
         # identically (blocks' mu/nu live pp-sharded with their layers)
         opt_sh = _opt_shardings(opt_abstract, abstract_p, param_sh,
                                 self.replicated)
-        params, opt_state = jax.jit(
-            init_all, out_shardings=(param_sh, opt_sh))(rng)
+        # Init is jitted WITHOUT out_shardings and the result device_put
+        # into the target layout afterwards. Jitting init_all with a
+        # partially-sharded out_shardings miscompiles on this XLA:
+        # jnp.stack/concatenate of per-layer jax.random draws (what
+        # stack_lm_params builds) under an out_sharding that leaves some
+        # axes replicated emits an unreduced partial-sum — every stacked
+        # kernel comes out inflated by EXACTLY the replication degree
+        # (total_devices / sharded_axis_size; e.g. 4x on an 8-device
+        # pp=2 mesh). A with_sharding_constraint inside doesn't avoid it;
+        # plain jit + device_put matches the eager oracle bit-for-bit and
+        # costs one staging copy at init only.
+        params, opt_state = jax.jit(init_all)(rng)
+        params = jax.device_put(params, param_sh)
+        opt_state = jax.device_put(opt_state, opt_sh)
         self._state_shardings = PPTrainState(
             step=self.replicated, params=param_sh, opt_state=opt_sh,
             tx=self.tx)
@@ -418,11 +430,19 @@ class PipelineLMTrainer:
     def benchmark(self, state, dataset, num_steps: int = 50,
                   warmup_steps: int = 5, log: Callable[[str], None] = print,
                   step_hook: Optional[Callable] = None,
+                  resilience=None,
                   ) -> Tuple[PPTrainState, Dict[str, float]]:
         """The stream may yield flat [B, S] pairs (microbatched and placed
         here) or pre-placed [M, mb, S] streams (real-data pipelines).
         step_hook(state, step) fires after every timed step (periodic
-        async checkpointing, train/checkpoint.periodic_saver)."""
+        async checkpointing, train/checkpoint.periodic_saver).
+
+        resilience: preemption stop-bit only here — the emergency
+        checkpoint is written in CANONICAL layer order (canonical_state,
+        same as every pp checkpoint) so the restarted gang may pick a
+        different schedule/interleave. The in-step divergence guard is a
+        flat-trainer feature (1F1B computes grads in-schedule; there is
+        no single post-step select point)."""
         cfg = self.config
 
         def prepare(batch):
@@ -442,6 +462,13 @@ class PipelineLMTrainer:
             state, metrics = step(state, *prepare(next(it)))
             if step_hook is not None:
                 step_hook(state, base_step + i)
+            if resilience is not None \
+                    and resilience.on_step(base_step + i):
+                from .resilience import Preempted
+                log(f"preemption drain: stopping the gang at step "
+                    f"{base_step + i}")
+                resilience.emergency_save(self.canonical_state(state))
+                raise Preempted(base_step + i)
         final_loss = float(metrics["loss"])         # host read barrier
         dt = time.perf_counter() - t0
         tps = tokens_per_step * num_steps / dt

@@ -1,0 +1,487 @@
+"""Preemption-tolerant training runtime.
+
+The control plane already speaks the reference operator's failure
+language: exit codes in the 128-255 band are retryable and trigger a gang
+restart (controller.py `_should_restart`, ExitCode policy, ref
+common_types.go:150-155; bootstrap.LAUNCHER_LOST_EXIT rides the same
+band). This module gives the DATA plane something worth restarting:
+
+  * PreemptionListener — SIGTERM/SIGUSR1 set a local flag (TPU
+    preemptions deliver SIGTERM with ~30s notice; SIGUSR1 is the manual
+    drain channel). The flag is only a local fact.
+  * gang_should_stop — folds the local flags into one replicated stop
+    bit via an all-gather, so every rank exits at the SAME step boundary
+    and the final checkpoint is a clean collective instead of a torn
+    race between ranks that saw the signal and ranks that didn't.
+  * guard_nonfinite_update — in-step divergence defense: a step whose
+    loss or global grad-norm is non-finite contributes NO update
+    (params/opt state/BN stats revert to their pre-step values) and an
+    on-device skip streak increments; K consecutive skips escalate to a
+    host-side rollback-from-last-checkpoint (ResilienceContext.rollback)
+    instead of silently training on NaNs.
+  * Watchdog — a per-step deadline thread: a hung ICI collective dumps
+    every thread's stack and aborts with WATCHDOG_STALL_EXIT instead of
+    idling until activeDeadlineSeconds kills the job with no diagnosis.
+  * FaultInjector — TPU_FAULT_INJECT=... test knobs (die-at-step,
+    sigterm-at-step, corrupt-latest-checkpoint, delay-coordinator) so
+    tests/test_resilience.py can prove the kill→restart→resume story on
+    a CPU mesh without real preemptions.
+
+ResilienceContext bundles all of it behind the single `on_step` call the
+benchmark loops make per step.
+"""
+from __future__ import annotations
+
+import faulthandler
+import os
+import re
+import signal
+import sys
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+
+#: env var holding the fault-injection spec (see FaultInjector)
+ENV_FAULT_INJECT = "TPU_FAULT_INJECT"
+#: env var default for ResilienceConfig.step_deadline (seconds)
+ENV_STEP_DEADLINE = "TPU_STEP_DEADLINE"
+
+# Exit codes in the reference's 128-255 "retryable" band (ref
+# common_types.go:150-155) — the controller's ExitCode restart policy
+# (controller._should_restart) relaunches the gang on any of these.
+# bootstrap.LAUNCHER_LOST_EXIT (213) is the neighbor.
+PREEMPTED_EXIT = 215        # gang drained after SIGTERM/SIGUSR1
+WATCHDOG_STALL_EXIT = 216   # a step blew its deadline (hung collective)
+FAULT_DIE_EXIT = 217        # injected hard death (die-at-step:N)
+
+
+def is_retryable_exit(code: Optional[int]) -> bool:
+    """The controller's ExitCode-policy predicate, importable by tools:
+    None (signal-killed pod) and 128-255 retry; 1-127 is a workload bug."""
+    return code is None or code >= 128
+
+
+class Preempted(RuntimeError):
+    """The gang agreed to stop; the emergency checkpoint is written.
+    Entrypoints catch this and exit with `exit_code` (retryable band)."""
+
+    def __init__(self, step: int, exit_code: int = PREEMPTED_EXIT):
+        super().__init__(f"preempted at step {step}")
+        self.step = step
+        self.exit_code = exit_code
+
+
+class DivergenceError(RuntimeError):
+    """K consecutive non-finite steps and no checkpoint to roll back to
+    (or the rollback budget is spent) — a workload failure, NOT retryable:
+    restarting would replay the same divergence."""
+
+
+# ---------------------------------------------------------------------------
+# Preemption listener + the gang stop bit
+# ---------------------------------------------------------------------------
+
+class PreemptionListener:
+    """Installs SIGTERM/SIGUSR1 handlers that set a flag; `requested`
+    reads it. Previous handlers are chained (called after ours) and
+    restored on uninstall, so harnesses with their own SIGTERM
+    bookkeeping (bench.py's summary flush) keep working. Signal handlers
+    only install from the main thread — construct this there."""
+
+    SIGNALS = (signal.SIGTERM, signal.SIGUSR1)
+
+    def __init__(self, log: Callable[[str], None] = print):
+        self._requested = False
+        self._log = log
+        self._prev: dict = {}
+
+    @property
+    def requested(self) -> bool:
+        return self._requested
+
+    def _handler(self, signum, frame):
+        if not self._requested:
+            self._log(f"preemption notice ({signal.Signals(signum).name}): "
+                      f"draining at the next step boundary")
+        self._requested = True
+        prev = self._prev.get(signum)
+        if callable(prev):
+            prev(signum, frame)
+
+    def install(self) -> "PreemptionListener":
+        for sig in self.SIGNALS:
+            self._prev[sig] = signal.getsignal(sig)
+            signal.signal(sig, self._handler)
+        return self
+
+    def uninstall(self) -> None:
+        for sig, prev in self._prev.items():
+            try:
+                signal.signal(sig, prev)
+            except (ValueError, TypeError):  # non-main thread / weird prev
+                pass
+        self._prev.clear()
+
+
+def gang_should_stop(local: bool) -> bool:
+    """Replicated stop decision: True iff ANY rank requested a stop.
+
+    Multi-process this is a collective (every rank MUST call it at the
+    same step — ResilienceContext.on_step guarantees that by checking on
+    a fixed step cadence regardless of the local flag). Single-process
+    runs short-circuit to the local flag: no device work on the hot path.
+    """
+    if jax.process_count() == 1:
+        return bool(local)
+    from jax.experimental import multihost_utils
+    flags = multihost_utils.process_allgather(
+        jnp.asarray([1 if local else 0], jnp.int32))
+    return bool(int(jnp.max(flags)))
+
+
+# ---------------------------------------------------------------------------
+# Divergence guard (jitted-step side)
+# ---------------------------------------------------------------------------
+
+def guard_nonfinite_update(old_state, new_state, loss, grads):
+    """Select old vs new state inside the jitted step: when `loss` or the
+    global grad-norm is non-finite, every pytree leaf reverts to its
+    pre-update value (params, optimizer moments, BN stats) and the
+    on-device `nonfinite_streak` increments; a finite step resets it.
+    The step counter always advances so checkpoint naming, LR schedules
+    keyed on opt-state counts notwithstanding, stays monotonic — a
+    skipped step is a no-op update, not a rewind."""
+    import optax
+
+    ok = jnp.isfinite(loss) & jnp.isfinite(optax.global_norm(grads))
+    # select leaf-wise against new_state's treedef, not tree.map over both
+    # trees: the two states can disagree on EMPTY container types (a
+    # BN-free model carries batch_stats=FrozenDict({}) on one side and a
+    # rebuilt plain {} on the other) and strict two-tree matching rejects
+    # that even though there is no leaf underneath
+    new_leaves, treedef = jax.tree.flatten(new_state)
+    old_leaves = jax.tree.leaves(old_state)
+    guarded = treedef.unflatten(
+        [jnp.where(ok, n, o) for n, o in zip(new_leaves, old_leaves)])
+    streak = jnp.where(
+        ok, 0, jnp.asarray(old_state.nonfinite_streak, jnp.int32) + 1)
+    return guarded.replace(step=new_state.step,
+                           nonfinite_streak=streak.astype(jnp.int32))
+
+
+# ---------------------------------------------------------------------------
+# Watchdog
+# ---------------------------------------------------------------------------
+
+class Watchdog:
+    """Per-step deadline: `pet()` after every step; a daemon thread that
+    sees `deadline` seconds without a pet dumps EVERY thread's stack
+    (faulthandler — C-safe, works mid-collective) and aborts the process
+    with WATCHDOG_STALL_EXIT. The point is turning "the job hung until
+    activeDeadlineSeconds" into "rank N stalled in <this collective>,
+    restart me" — the abort code sits in the retryable band so the
+    controller relaunches the gang. `abort` is injectable for tests."""
+
+    def __init__(self, deadline: float,
+                 exit_code: int = WATCHDOG_STALL_EXIT,
+                 log: Callable[[str], None] = print,
+                 abort: Optional[Callable[[int], None]] = None,
+                 poll: Optional[float] = None):
+        self.deadline = float(deadline)
+        self.exit_code = exit_code
+        self._log = log
+        self._abort = abort if abort is not None else self._default_abort
+        self._poll = poll if poll is not None else min(
+            max(self.deadline / 4.0, 0.05), 5.0)
+        self._last = time.monotonic()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    @staticmethod
+    def _default_abort(code: int) -> None:
+        # os._exit, not sys.exit: the main thread is stuck in a
+        # collective and will never run exception handlers
+        os._exit(code)
+
+    def pet(self) -> None:
+        self._last = time.monotonic()
+
+    def start(self) -> "Watchdog":
+        if self._thread is None:
+            self._last = time.monotonic()
+            self._thread = threading.Thread(
+                target=self._run, name="tpu-step-watchdog", daemon=True)
+            self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None
+
+    def _run(self) -> None:
+        while not self._stop.wait(self._poll):
+            stalled = time.monotonic() - self._last
+            if stalled > self.deadline:
+                self._log(f"watchdog: step exceeded {self.deadline:.1f}s "
+                          f"deadline ({stalled:.1f}s since last step); "
+                          f"dumping stacks, aborting with exit code "
+                          f"{self.exit_code}")
+                try:
+                    faulthandler.dump_traceback(file=sys.stderr,
+                                                all_threads=True)
+                except Exception:  # noqa: BLE001 — diagnosis best-effort
+                    pass
+                self._abort(self.exit_code)
+                return
+
+
+# ---------------------------------------------------------------------------
+# Fault injection
+# ---------------------------------------------------------------------------
+
+def corrupt_latest_checkpoint(directory: str) -> Optional[str]:
+    """Scribble garbage over every file of the NEWEST committed step_N —
+    the directory still looks committed (the commit-marker check passes)
+    but restore raises, exercising the read-side fallback to the previous
+    step. Returns the corrupted path, or None when nothing to corrupt."""
+    from .checkpoint import wait_for_checkpoints
+
+    wait_for_checkpoints()
+    directory = os.path.abspath(directory)
+    if not os.path.isdir(directory):
+        return None
+    steps = [int(n[5:]) for n in os.listdir(directory)
+             if n.startswith("step_") and n[5:].isdigit()]
+    if not steps:
+        return None
+    path = os.path.join(directory, f"step_{max(steps)}")
+    for root, _dirs, files in os.walk(path):
+        for name in files:
+            with open(os.path.join(root, name), "wb") as fh:
+                fh.write(b"\x00corrupted-by-fault-injection\x00")
+    return path
+
+
+class FaultInjector:
+    """Parsed TPU_FAULT_INJECT spec — ';'/',' separated directives:
+
+      die-at-step:N             os._exit(FAULT_DIE_EXIT) after step N
+                                (hard death: no emergency checkpoint)
+      sigterm-at-step:N         SIGTERM to self after step N (the
+                                graceful preemption drill)
+      corrupt-latest-checkpoint scribble the newest step_N before resume
+      delay-coordinator:K       first K jax.distributed.initialize
+                                attempts fail (exercises init retry)
+
+    Unknown directives raise at parse time — a typo'd fault spec that
+    silently injects nothing would green a test that proved nothing."""
+
+    def __init__(self, spec: str = ""):
+        self.die_at_step: Optional[int] = None
+        self.sigterm_at_step: Optional[int] = None
+        self.corrupt_latest = False
+        self.delay_coordinator = 0
+        self._injected_init_failures = 0
+        for raw in re.split(r"[;,]", spec or ""):
+            part = raw.strip()
+            if not part:
+                continue
+            name, _, arg = part.partition(":")
+            if name == "die-at-step":
+                self.die_at_step = int(arg)
+            elif name == "sigterm-at-step":
+                self.sigterm_at_step = int(arg)
+            elif name == "corrupt-latest-checkpoint":
+                self.corrupt_latest = True
+            elif name == "delay-coordinator":
+                self.delay_coordinator = int(arg)
+            else:
+                raise ValueError(
+                    f"unknown {ENV_FAULT_INJECT} directive {part!r}; known: "
+                    f"die-at-step:N, sigterm-at-step:N, "
+                    f"corrupt-latest-checkpoint, delay-coordinator:K")
+
+    @classmethod
+    def from_env(cls, env=None) -> Optional["FaultInjector"]:
+        env = os.environ if env is None else env
+        spec = env.get(ENV_FAULT_INJECT, "")
+        return cls(spec) if spec else None
+
+    def check_step(self, step: int) -> bool:
+        """Fire any step-indexed fault; returns True when a graceful stop
+        was injected THIS call (the caller treats it like a delivered
+        preemption signal — the return value makes the drill
+        deterministic instead of racing CPython's signal delivery)."""
+        if self.die_at_step is not None and step >= self.die_at_step:
+            os._exit(FAULT_DIE_EXIT)
+        if self.sigterm_at_step is not None and step >= self.sigterm_at_step:
+            self.sigterm_at_step = None        # one shot
+            os.kill(os.getpid(), signal.SIGTERM)
+            return True
+        return False
+
+    def maybe_corrupt_checkpoint(self, train_dir: Optional[str],
+                                 log: Callable[[str], None] = print
+                                 ) -> Optional[str]:
+        if not (self.corrupt_latest and train_dir):
+            return None
+        self.corrupt_latest = False            # one shot
+        path = corrupt_latest_checkpoint(train_dir)
+        if path:
+            log(f"fault-inject: corrupted {path}")
+        return path
+
+    def fail_init_attempt(self) -> bool:
+        """delay-coordinator budget: consume and report one injected
+        distributed-init failure (bootstrap's retry loop consults this
+        before every real attempt)."""
+        if self._injected_init_failures < self.delay_coordinator:
+            self._injected_init_failures += 1
+            return True
+        return False
+
+
+# ---------------------------------------------------------------------------
+# The per-loop bundle
+# ---------------------------------------------------------------------------
+
+@dataclass
+class ResilienceConfig:
+    train_dir: Optional[str] = None
+    #: consecutive non-finite steps before rollback-from-checkpoint
+    divergence_k: int = 3
+    #: rollbacks allowed before giving up as a genuine divergence
+    max_rollbacks: int = 2
+    #: seconds a single step may take; 0 disables the watchdog
+    step_deadline: float = 0.0
+    #: gang stop-bit cadence (multi-process allgather every N steps;
+    #: single-process checks the local flag every step regardless)
+    stop_check_every: int = 1
+
+    @classmethod
+    def from_env(cls, env=None, **overrides) -> "ResilienceConfig":
+        env = os.environ if env is None else env
+        if "step_deadline" not in overrides and env.get(ENV_STEP_DEADLINE):
+            overrides["step_deadline"] = float(env[ENV_STEP_DEADLINE])
+        return cls(**overrides)
+
+
+class ResilienceContext:
+    """One per training run; use as a context manager around the loop.
+
+    Per step the loop calls `on_step(step)` — fault hooks fire, the
+    watchdog is petted, and the gang stop bit is evaluated; True means
+    "drain now": the loop writes the emergency checkpoint
+    (`emergency_save`) and raises Preempted. At window boundaries the
+    loop reads the on-device skip streak from metrics and calls
+    `rollback` when it reaches divergence_k.
+    """
+
+    def __init__(self, config: Optional[ResilienceConfig] = None,
+                 log: Callable[[str], None] = print,
+                 listener: Optional[PreemptionListener] = None,
+                 faults: Optional[FaultInjector] = None,
+                 watchdog: Optional[Watchdog] = None):
+        self.config = config or ResilienceConfig()
+        self.log = log
+        self.listener = (listener if listener is not None
+                         else PreemptionListener(log))
+        self.faults = faults if faults is not None else FaultInjector.from_env()
+        if watchdog is None and self.config.step_deadline > 0:
+            watchdog = Watchdog(self.config.step_deadline, log=log)
+        self.watchdog = watchdog
+        self._pending_stop = False
+        self._rollbacks = 0
+
+    def __enter__(self) -> "ResilienceContext":
+        self.listener.install()
+        # the watchdog arms on the FIRST on_step call, not here: the step
+        # deadline budgets a steady-state step, and compilation (minutes,
+        # before any on_step) must not trip it
+        if self.faults is not None:
+            self.faults.maybe_corrupt_checkpoint(self.config.train_dir,
+                                                 self.log)
+        return self
+
+    def __exit__(self, *exc) -> None:
+        if self.watchdog is not None:
+            self.watchdog.stop()
+        self.listener.uninstall()
+
+    # -- the hot-path call ---------------------------------------------------
+
+    def on_step(self, step: int) -> bool:
+        local = False
+        if self.faults is not None:
+            local = self.faults.check_step(step)
+        if self.watchdog is not None:
+            self.watchdog.start()       # idempotent; arms on first step
+            self.watchdog.pet()
+        local = local or self.listener.requested
+        if jax.process_count() == 1:
+            return local
+        # multi-process: the allgather is a collective, so it must run at
+        # the SAME steps on every rank — fixed cadence, local flag carried
+        # to the next boundary
+        self._pending_stop = self._pending_stop or local
+        if step % max(1, self.config.stop_check_every) != 0:
+            return False
+        stop = gang_should_stop(self._pending_stop)
+        self._pending_stop = False
+        return stop
+
+    # -- drain / rollback ----------------------------------------------------
+
+    def emergency_save(self, state) -> None:
+        """The final SYNCHRONOUS checkpoint before a preemption exit —
+        blocks until committed (an async write racing SIGKILL is how you
+        lose the run). Collective: every rank calls it at the same step
+        (on_step's replicated stop bit guarantees that)."""
+        from .checkpoint import maybe_save
+
+        maybe_save(self.config.train_dir, state, self.log)
+
+    def rollback(self, state):
+        """Restore the newest intact checkpoint after divergence_k
+        consecutive non-finite steps; resets the on-device streak. Raises
+        DivergenceError when nothing restorable remains or the rollback
+        budget is spent — that's a workload bug (exit code 1, NOT
+        retryable: a restart would replay the same divergence)."""
+        from .checkpoint import restore_with_fallback
+
+        self._rollbacks += 1
+        if self._rollbacks > self.config.max_rollbacks:
+            raise DivergenceError(
+                f"diverged again after {self.config.max_rollbacks} "
+                f"rollback(s) — giving up (lower the LR or inspect the "
+                f"data around step {int(state.step)})")
+        if not self.config.train_dir:
+            raise DivergenceError(
+                f"{self.config.divergence_k} consecutive non-finite steps "
+                f"and no --train-dir to roll back from")
+        restored, path = restore_with_fallback(self.config.train_dir, state,
+                                               self.log)
+        if path is None:
+            raise DivergenceError(
+                f"{self.config.divergence_k} consecutive non-finite steps "
+                f"and no restorable checkpoint under "
+                f"{self.config.train_dir!r}")
+        self.log(f"divergence rollback #{self._rollbacks}: restored {path} "
+                 f"(step {int(restored.step)})")
+        return restored.replace(
+            nonfinite_streak=jnp.zeros_like(jnp.asarray(restored.step)))
+
+
+__all__ = [
+    "PREEMPTED_EXIT", "WATCHDOG_STALL_EXIT", "FAULT_DIE_EXIT",
+    "ENV_FAULT_INJECT", "ENV_STEP_DEADLINE", "is_retryable_exit",
+    "Preempted", "DivergenceError", "PreemptionListener", "gang_should_stop",
+    "guard_nonfinite_update", "Watchdog", "FaultInjector",
+    "corrupt_latest_checkpoint", "ResilienceConfig", "ResilienceContext",
+]

@@ -39,6 +39,11 @@ class TrainState(struct.PyTreeNode):
     opt_state: Any
     tx: optax.GradientTransformation = struct.field(pytree_node=False)
     apply_fn: Callable = struct.field(pytree_node=False)
+    # consecutive non-finite (skipped) steps, maintained ON DEVICE by the
+    # divergence guard (resilience.guard_nonfinite_update) so reading it
+    # costs nothing until a log-window fetch; not persisted in
+    # checkpoints (a restore starts a fresh streak)
+    nonfinite_streak: Any = 0
 
     def apply_gradients(self, grads, batch_stats):
         updates, new_opt_state = self.tx.update(grads, self.opt_state, self.params)
@@ -69,6 +74,10 @@ class TrainerConfig:
     learning_rate: float = 0.1
     momentum: float = 0.9
     log_every: int = 10
+    # divergence guard: a step with non-finite loss/grad-norm applies NO
+    # update (resilience.guard_nonfinite_update); the selects are
+    # numerically a no-op on finite steps and fuse into the update
+    guard_nonfinite: bool = True
 
 
 class Trainer:
@@ -122,6 +131,8 @@ class Trainer:
             opt_state=opt_state,
             tx=self.tx,
             apply_fn=self.model.apply,
+            nonfinite_streak=jax.device_put(jnp.zeros((), jnp.int32),
+                                            self.replicated),
         )
 
     # -- the jitted step ----------------------------------------------------
@@ -140,9 +151,14 @@ class Trainer:
             loss_fn, has_aux=True)(state.params)
         # grads are partial sums per batch shard; with replicated params XLA
         # emits AllReduce(dp axes) here — the Horovod hook, compiler-inserted.
-        state = state.apply_gradients(grads, new_stats)
+        new_state = state.apply_gradients(grads, new_stats)
+        if self.config.guard_nonfinite:
+            from .resilience import guard_nonfinite_update
+            new_state = guard_nonfinite_update(state, new_state, loss, grads)
+        state = new_state
         accuracy = jnp.mean(jnp.argmax(logits, -1) == labels)
-        return state, {"loss": loss, "accuracy": accuracy}
+        return state, {"loss": loss, "accuracy": accuracy,
+                       "nonfinite_streak": state.nonfinite_streak}
 
     def compile_step(self, state: TrainState):
         if self._train_step is None:
@@ -165,10 +181,18 @@ class Trainer:
                   log: Callable[[str], None] = print,
                   profile_dir: Optional[str] = None,
                   step_hook: Optional[Callable] = None,
+                  resilience=None,
                   ) -> Tuple[TrainState, Dict[str, float]]:
         """Windowed throughput measurement, tf_cnn_benchmarks-style.
         Returns (final_state, metrics) — the input state is DONATED by the
         jitted step, so callers must use the returned state afterwards.
+
+        resilience: an entered train.resilience.ResilienceContext. Per
+        step its on_step() folds signals/faults into the replicated stop
+        bit — True writes the emergency checkpoint and raises Preempted
+        (the gang drains at the same boundary). At window fetches the
+        on-device non-finite streak escalates to rollback-from-checkpoint
+        at divergence_k.
 
         Synchronization note: each window is closed by FETCHING the loss
         scalar to the host, not by `block_until_ready` — on remote-relay
@@ -211,6 +235,13 @@ class Trainer:
                     # periodic async checkpointing
                     # (train/checkpoint.periodic_saver)
                     step_hook(state, base_step + i)
+                if resilience is not None \
+                        and resilience.on_step(base_step + i):
+                    from .resilience import Preempted
+                    log(f"preemption drain: stopping the gang at step "
+                        f"{base_step + i}")
+                    resilience.emergency_save(state)
+                    raise Preempted(base_step + i)
                 if i % log_every == 0:
                     loss = float(metrics["loss"])  # sync: closes the window
                     t1 = time.perf_counter()       # BEFORE the trace write
@@ -220,6 +251,11 @@ class Trainer:
                     window_ips.append(ips)
                     # tf_cnn_benchmarks log format (ref README.md:113-125)
                     log(f"{i}\timages/sec: {ips:.1f}\tloss: {loss:.3f}")
+                    if resilience is not None and int(
+                            metrics.get("nonfinite_streak", 0)
+                    ) >= resilience.config.divergence_k:
+                        state = resilience.rollback(state)
+                        base_step = int(state.step) - i
                     t0 = time.perf_counter()       # fetch/log time excluded
         finally:
             profiler.stop_if_active()

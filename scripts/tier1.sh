@@ -15,4 +15,53 @@
 #   - DOTS_PASSED counts progress dots from the captured log so the
 #     driver can read a pass-count even when pytest's summary line is
 #     cut off by the timeout.
+#
+#   ./scripts/tier1.sh --resilience additionally runs the OUT-OF-PROCESS
+#   preemption smoke below (real SIGTERM, real exit codes, real resume —
+#   the in-process pytest e2e can't observe the exit-status contract).
+
+if [ "${1:-}" = "--resilience" ]; then
+  # Preemption smoke: kill the shipped lm_benchmark entrypoint at step 5
+  # via the fault injector, assert the RETRYABLE exit code (215) and the
+  # emergency checkpoint, then rerun clean and assert it resumes and
+  # exits 0 — the controller-eye view of a preempted gang.
+  set -u
+  dir=$(mktemp -d)
+  trap 'rm -rf "$dir"' EXIT
+  run_env=(env JAX_PLATFORMS=cpu
+           TPU_COORDINATOR_ADDRESS=localhost:8476 TPU_NUM_PROCESSES=1)
+  args=(python -m mpi_operator_tpu.examples.lm_benchmark
+        --workload gpt2 --size test --batch-per-device 1 --seq-len 16
+        --dtype float32 --warmup-steps 1 --num-steps 20
+        --train-dir "$dir/ckpt")
+  echo "== resilience smoke: preempt at step 5 =="
+  "${run_env[@]}" TPU_FAULT_INJECT=sigterm-at-step:5 \
+    "${args[@]}" > "$dir/preempt.log" 2>&1
+  rc=$?
+  if [ "$rc" -ne 215 ]; then
+    echo "FAIL: preempted run exited $rc (want 215, the retryable band)"
+    tail -20 "$dir/preempt.log"; exit 1
+  fi
+  if [ ! -d "$dir/ckpt/step_5" ]; then
+    echo "FAIL: no emergency checkpoint at step_5"; ls "$dir/ckpt"; exit 1
+  fi
+  echo "== resilience smoke: resume to step 8 =="
+  "${run_env[@]}" "${args[@]}" --num-steps 20 --stop-at-step 8 \
+    > "$dir/resume.log" 2>&1
+  rc=$?
+  if [ "$rc" -ne 0 ]; then
+    echo "FAIL: resumed run exited $rc"; tail -20 "$dir/resume.log"; exit 1
+  fi
+  if ! grep -q "resumed from .*step_5" "$dir/resume.log"; then
+    echo "FAIL: resumed run did not restore the emergency checkpoint"
+    tail -20 "$dir/resume.log"; exit 1
+  fi
+  if [ ! -d "$dir/ckpt/step_8" ]; then
+    echo "FAIL: resumed run did not reach global step 8"
+    ls "$dir/ckpt"; exit 1
+  fi
+  echo "resilience smoke: OK (exit 215 -> emergency step_5 -> resume -> step_8)"
+  exit 0
+fi
+
 set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 1140 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=${PIPESTATUS[0]}; echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c); exit $rc

@@ -20,6 +20,22 @@ from mpi_operator_tpu.utils.hostplatform import force_host_platform  # noqa: E40
 force_host_platform(8)
 
 
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _reset_checkpoint_saved_state():
+    """Clear checkpoint.py's per-directory last-saved records between
+    tests: tmp_path reuse across back-to-back in-process runs would
+    otherwise make maybe_save skip a save the second test legitimately
+    needs. sys.modules.get, not an import — tests that never touch
+    checkpoints must not pay the jax/orbax import."""
+    yield
+    mod = sys.modules.get("mpi_operator_tpu.train.checkpoint")
+    if mod is not None:
+        mod.reset_saved_state()
+
+
 def pytest_configure(config):
     config.addinivalue_line(
         "markers",

@@ -364,7 +364,7 @@ def test_resize_and_resume_e2e(tmp_path):
     CLI and checkpoints into a shared dir; the user resizes the spec
     (tpus 8→4); the controller gang-restarts onto the new template; the
     new 1-process gang boots from the NEW env and RESUMES from the
-    checkpoint — loss continuity, not a from-scratch restart."""
+    checkpoint — global-step continuity, not a from-scratch restart."""
     import os
     import re
     import socket
@@ -458,10 +458,16 @@ def test_resize_and_resume_e2e(tmp_path):
     assert int(m.group(1)) == 13       # probe + warmup(1) + 12 steps
     losses2 = [float(x) for x in re.findall(r"loss: ([0-9.]+)", out2)]
     assert losses2, out2
-    # continuity: the resumed gang carries phase-1's learning — its first
-    # logged loss sits below phase-1's STARTING loss (a from-scratch
-    # restart would be back at ~ln(vocab))
-    assert losses2[0] < losses1[0] - 0.1, (losses1, losses2)
+    # continuity: the resumed gang restored step 13 (above) and its step
+    # counter carries on — 13 + probe + 4 steps lands the final
+    # checkpoint at GLOBAL step 18, where a from-scratch run would be at
+    # 5. (Streams are step-keyed for token-identical resume, so phase 2
+    # sees FRESH batches; the old memorization signal — resumed loss
+    # below phase-1's start — no longer exists on uniform random tokens,
+    # where every fresh-data loss sits at ~ln(vocab). Bitwise resume
+    # identity is pinned in test_resilience.py.)
+    assert "step_18" in os.listdir(train_dir), sorted(os.listdir(train_dir))
+    assert losses2[0] < 11.0, (losses1, losses2)   # sane, not diverged
 
 
 def test_elastic_shrink_and_resume_e2e(tmp_path):
@@ -472,7 +478,7 @@ def test_elastic_shrink_and_resume_e2e(tmp_path):
     window (no spec edit — capacity loss); the controller SHRINKS via
     status.elasticTpus to the next valid size; the 1-process degraded
     gang boots from the NEW env and resumes from the checkpoint with
-    loss continuity. Restore stays controller-tested
+    global-step continuity. Restore stays controller-tested
     (tests/test_controller.py::test_elastic_restores_after_recovery_window)."""
     import os
     import re
@@ -586,13 +592,17 @@ def test_elastic_shrink_and_resume_e2e(tmp_path):
     env_1proc = dict(sts.spec.template.main_container().env)
     assert env_1proc["TPU_NUM_PROCESSES"] == "1"
 
-    # the degraded gang resumes from the checkpoint — loss continuity
+    # the degraded gang resumes from the checkpoint — step continuity
+    # (see the resize e2e above for why the old memorization-based loss
+    # assertion can't survive step-keyed, token-identical streams)
     out2 = run_gang(env_1proc, nprocs=1, num_steps=4)
     m = re.search(r"resumed from \S*step_(\d+)", out2)
     assert m, f"no resume line in:\n{out2}"
+    assert int(m.group(1)) == 13
     losses2 = [float(x) for x in re.findall(r"loss: ([0-9.]+)", out2)]
     assert losses2, out2
-    assert losses2[0] < losses1[0] - 0.1, (losses1, losses2)
+    assert "step_18" in os.listdir(train_dir), sorted(os.listdir(train_dir))
+    assert losses2[0] < 11.0, (losses1, losses2)   # sane, not diverged
 
 
 # ---------------------------------------------------------------------------
@@ -839,3 +849,160 @@ def test_multislice_cross_slice_rendezvous_e2e(tmp_path):
         [[sys.executable, str(script), env_files[k],
           f"mse2e-worker-s{k}-0", str(port), repo] for k in (0, 1)],
         [f"slice {k} rank {k} psum ok" for k in (0, 1)])
+
+
+# ---------------------------------------------------------------------------
+# Distributed-init retry (bootstrap._initialize_distributed)
+# ---------------------------------------------------------------------------
+
+def _init_info():
+    from mpi_operator_tpu.bootstrap.bootstrap import ProcessInfo
+    return ProcessInfo(coordinator_address="job-worker-0:8476",
+                       num_processes=2, process_id=1)
+
+
+def test_init_retry_backoff_then_success():
+    from mpi_operator_tpu.bootstrap.bootstrap import _initialize_distributed
+
+    calls, sleeps = [], []
+
+    def init_fn():
+        calls.append(1)
+        if len(calls) < 3:
+            raise RuntimeError("failed to connect to coordinator")
+
+    _initialize_distributed(_init_info(), {}, log=lambda s: None,
+                            init_fn=init_fn, sleep=sleeps.append)
+    assert len(calls) == 3
+    assert sleeps == [1.0, 2.0]        # exponential from the 1s default
+
+
+def test_init_retry_non_retryable_raises_immediately():
+    from mpi_operator_tpu.bootstrap.bootstrap import _initialize_distributed
+
+    calls, sleeps = [], []
+
+    def bad_rank():
+        calls.append(1)
+        raise RuntimeError("process id 3 does not match num_processes 2")
+
+    with pytest.raises(RuntimeError, match="process id"):
+        _initialize_distributed(_init_info(), {}, log=lambda s: None,
+                                init_fn=bad_rank, sleep=sleeps.append)
+    assert len(calls) == 1 and sleeps == []    # no retry on config bugs
+
+    def bad_value():
+        raise ValueError("coordinator_address must be host:port")
+
+    with pytest.raises(ValueError):
+        _initialize_distributed(_init_info(), {}, log=lambda s: None,
+                                init_fn=bad_value, sleep=sleeps.append)
+    assert sleeps == []
+
+
+def test_init_retry_exhaustion_raises_bootstrap_error():
+    from mpi_operator_tpu.bootstrap.bootstrap import (
+        ENV_INIT_RETRIES, _initialize_distributed)
+
+    calls, sleeps = [], []
+
+    def always_down():
+        calls.append(1)
+        raise RuntimeError("DEADLINE_EXCEEDED: coordinator unreachable")
+
+    with pytest.raises(BootstrapError, match="after 3 attempt"):
+        _initialize_distributed(_init_info(), {ENV_INIT_RETRIES: "3"},
+                                log=lambda s: None,
+                                init_fn=always_down, sleep=sleeps.append)
+    assert len(calls) == 3
+    assert sleeps == [1.0, 2.0]        # no sleep after the final attempt
+
+
+def test_init_retry_delay_coordinator_fault():
+    """TPU_FAULT_INJECT=delay-coordinator:K makes the first K attempts
+    fail before init_fn even runs — the injectable drill for coordinator-
+    late startup."""
+    from mpi_operator_tpu.bootstrap.bootstrap import _initialize_distributed
+
+    calls, sleeps = [], []
+    env = {"TPU_FAULT_INJECT": "delay-coordinator:2"}
+    _initialize_distributed(_init_info(), env, log=lambda s: None,
+                            init_fn=lambda: calls.append(1),
+                            sleep=sleeps.append)
+    assert len(calls) == 1             # attempts 1-2 injected, 3rd real
+    assert sleeps == [1.0, 2.0]
+
+
+# ---------------------------------------------------------------------------
+# launcher_wait window-reset proofs (fake clock: LOST -> RESTARTING ->
+# contact must FULLY reset both windows)
+# ---------------------------------------------------------------------------
+
+class _FakeClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def monotonic(self):
+        return self.t
+
+    def sleep(self, seconds):
+        self.t += seconds
+
+
+def _run_launcher_wait(monkeypatch, responses, default=None, **kw):
+    """Drive launcher_wait against a scripted poll_status sequence on a
+    fake clock; each poll consumes one response (then `default` forever).
+    Returns (exit_code_or_exception, clock, contact_times)."""
+    from mpi_operator_tpu.bootstrap import bootstrap as bs
+
+    clock = _FakeClock()
+    monkeypatch.setattr(time, "monotonic", clock.monotonic)
+    monkeypatch.setattr(time, "sleep", clock.sleep)
+    script = list(responses)
+    contacts = []
+
+    def fake_poll(host, port, timeout=2.0, token=None):
+        status = script.pop(0) if script else default
+        if status is not None:
+            contacts.append(clock.t)
+        return status
+
+    monkeypatch.setattr(bs, "poll_status", fake_poll)
+    info = _init_info()
+    kw.setdefault("poll_interval", 1.0)
+    try:
+        return bs.launcher_wait(info, **kw), clock, contacts
+    except BootstrapError as exc:
+        return exc, clock, contacts
+
+
+def test_launcher_wait_transient_outages_never_accumulate(monkeypatch):
+    """Outages each SHORTER than lost_timeout, repeated well past it in
+    total, must never reach RESTARTING/give-up: any contact fully resets
+    the loss window."""
+    responses = []
+    for _ in range(10):                 # 10 x 9s outages = 90s total loss
+        responses += ["running"] + [None] * 9
+    responses += ["done 0"]
+    code, clock, _ = _run_launcher_wait(
+        monkeypatch, responses, lost_timeout=10.0, startup_timeout=50.0)
+    assert code == 0                    # survived 9x the lost budget
+
+
+def test_launcher_wait_restarting_contact_resets_windows(monkeypatch):
+    """Contact during RESTARTING returns to RUNNING with BOTH windows
+    reset: a second total outage must again take the full
+    lost_timeout + startup_timeout before the give-up exit."""
+    from mpi_operator_tpu.bootstrap.bootstrap import LAUNCHER_LOST_EXIT
+
+    # contact -> outage long enough to reach RESTARTING -> recovery
+    # contact -> permanent outage
+    responses = ["running"] + [None] * 15 + ["running"]
+    code, clock, contacts = _run_launcher_wait(
+        monkeypatch, responses, default=None,
+        lost_timeout=10.0, startup_timeout=30.0)
+    assert code == LAUNCHER_LOST_EXIT
+    recovery_t = contacts[-1]
+    # after the recovery the launcher owed a FULL fresh budget: 10s to
+    # re-enter RESTARTING plus 30s of restart window
+    assert clock.t - recovery_t >= 10.0 + 30.0

@@ -1,0 +1,396 @@
+"""Preemption-tolerance tests (train/resilience.py + checkpoint fallback).
+
+The fault-injection harness (TPU_FAULT_INJECT) lets a CPU mesh prove the
+kill→restart→resume story end to end: the e2e test below SIGTERMs a run
+mid-training, asserts the emergency checkpoint, resumes, and checks the
+restarted run reaches the SAME final step with bitwise-identical params
+(the streams are step-keyed, so resumption is token-identical).
+"""
+import os
+import signal
+import time
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from flax import struct
+
+from mpi_operator_tpu.train.resilience import (
+    FAULT_DIE_EXIT, PREEMPTED_EXIT, WATCHDOG_STALL_EXIT,
+    DivergenceError, FaultInjector, Preempted, PreemptionListener,
+    ResilienceConfig, ResilienceContext, Watchdog, corrupt_latest_checkpoint,
+    guard_nonfinite_update, is_retryable_exit,
+)
+from mpi_operator_tpu.train.checkpoint import (
+    checkpoint_steps, gc_checkpoints, latest_checkpoint, maybe_resume,
+    maybe_save, periodic_saver, reset_saved_state, restore_with_fallback,
+    save_checkpoint, verify_checkpoint, wait_for_checkpoints,
+)
+
+
+# ---------------------------------------------------------------------------
+# Minimal checkpointable state: checkpoint.py persists step/params/opt_state
+# and rollback resets nonfinite_streak — no model/trainer needed to test
+# the storage layer.
+# ---------------------------------------------------------------------------
+
+class _CkptState(struct.PyTreeNode):
+    step: Any
+    params: Any
+    opt_state: Any
+    nonfinite_streak: Any = 0
+
+
+def _ckpt_state(step: int, value: float) -> _CkptState:
+    return _CkptState(step=jnp.asarray(step, jnp.int32),
+                      params={"w": jnp.full((4,), value, jnp.float32)},
+                      opt_state={"m": jnp.zeros((4,), jnp.float32)})
+
+
+# ---------------------------------------------------------------------------
+# Exit codes / fault-spec parsing
+# ---------------------------------------------------------------------------
+
+def test_exit_codes_sit_in_retryable_band():
+    from mpi_operator_tpu.bootstrap.bootstrap import LAUNCHER_LOST_EXIT
+    codes = {PREEMPTED_EXIT, WATCHDOG_STALL_EXIT, FAULT_DIE_EXIT,
+             LAUNCHER_LOST_EXIT}
+    assert len(codes) == 4                  # all distinct (diagnosable)
+    for code in codes:
+        assert 128 <= code <= 255 and is_retryable_exit(code)
+    assert is_retryable_exit(None)          # signal-killed pod
+    assert not is_retryable_exit(0) and not is_retryable_exit(1)
+
+
+def test_fault_spec_parsing():
+    f = FaultInjector("die-at-step:7; sigterm-at-step:3,"
+                      "corrupt-latest-checkpoint;delay-coordinator:2")
+    assert f.die_at_step == 7 and f.sigterm_at_step == 3
+    assert f.corrupt_latest and f.delay_coordinator == 2
+    # the init-failure budget is consumed exactly delay_coordinator times
+    assert f.fail_init_attempt() and f.fail_init_attempt()
+    assert not f.fail_init_attempt()
+    assert FaultInjector.from_env({}) is None
+    got = FaultInjector.from_env({"TPU_FAULT_INJECT": "die-at-step:9"})
+    assert got is not None and got.die_at_step == 9
+    with pytest.raises(ValueError, match="unknown"):
+        FaultInjector("die-at-step:7;tpyo-directive:1")
+
+
+def test_preempted_carries_retryable_exit_code():
+    p = Preempted(41)
+    assert p.step == 41 and p.exit_code == PREEMPTED_EXIT
+    assert is_retryable_exit(p.exit_code)
+
+
+# ---------------------------------------------------------------------------
+# Signal listener
+# ---------------------------------------------------------------------------
+
+def test_preemption_listener_flags_and_chains():
+    chained = []
+    prev = lambda signum, frame: chained.append(signum)  # noqa: E731
+    old = signal.signal(signal.SIGUSR1, prev)
+    try:
+        listener = PreemptionListener(log=lambda s: None).install()
+        try:
+            assert not listener.requested
+            os.kill(os.getpid(), signal.SIGUSR1)
+            deadline = time.monotonic() + 5.0
+            while not listener.requested and time.monotonic() < deadline:
+                time.sleep(0.01)
+            assert listener.requested
+            assert chained == [signal.SIGUSR1]     # prev handler chained
+        finally:
+            listener.uninstall()
+        # uninstall restored the pre-existing handler
+        assert signal.getsignal(signal.SIGUSR1) is prev
+    finally:
+        signal.signal(signal.SIGUSR1, old)
+
+
+# ---------------------------------------------------------------------------
+# Watchdog
+# ---------------------------------------------------------------------------
+
+def test_watchdog_fires_on_stall():
+    fired = []
+    dog = Watchdog(deadline=0.2, log=lambda s: None,
+                   abort=fired.append, poll=0.05)
+    dog.start()
+    try:
+        deadline = time.monotonic() + 5.0
+        while not fired and time.monotonic() < deadline:
+            time.sleep(0.05)
+    finally:
+        dog.stop()
+    assert fired == [WATCHDOG_STALL_EXIT]
+
+
+def test_watchdog_stays_quiet_while_petted():
+    fired = []
+    dog = Watchdog(deadline=0.3, log=lambda s: None,
+                   abort=fired.append, poll=0.05)
+    dog.start()
+    try:
+        for _ in range(12):                # 0.6s of healthy steps
+            dog.pet()
+            time.sleep(0.05)
+    finally:
+        dog.stop()
+    assert fired == []
+
+
+# ---------------------------------------------------------------------------
+# Divergence guard (pure pytree semantics — no model needed)
+# ---------------------------------------------------------------------------
+
+class _GuardState(struct.PyTreeNode):
+    step: Any
+    params: Any
+    nonfinite_streak: Any = 0
+
+
+def test_guard_nonfinite_update_semantics():
+    old = _GuardState(step=jnp.asarray(5, jnp.int32),
+                      params={"w": jnp.ones((3,))},
+                      nonfinite_streak=jnp.asarray(0, jnp.int32))
+    new = old.replace(step=old.step + 1,
+                      params={"w": jnp.full((3,), 2.0)})
+    grads = {"w": jnp.ones((3,))}
+
+    ok = guard_nonfinite_update(old, new, jnp.asarray(1.25), grads)
+    np.testing.assert_array_equal(np.asarray(ok.params["w"]), 2.0)
+    assert int(ok.step) == 6 and int(ok.nonfinite_streak) == 0
+
+    bad_loss = guard_nonfinite_update(old, new, jnp.asarray(jnp.nan), grads)
+    np.testing.assert_array_equal(np.asarray(bad_loss.params["w"]), 1.0)
+    # the step STILL advances: a skipped step is a no-op update, not a
+    # rewind (checkpoint naming stays monotonic)
+    assert int(bad_loss.step) == 6 and int(bad_loss.nonfinite_streak) == 1
+
+    bad_grad = guard_nonfinite_update(
+        old, new, jnp.asarray(0.5), {"w": jnp.array([1.0, jnp.inf, 0.0])})
+    np.testing.assert_array_equal(np.asarray(bad_grad.params["w"]), 1.0)
+    assert int(bad_grad.nonfinite_streak) == 1
+
+    streaky = old.replace(nonfinite_streak=jnp.asarray(2, jnp.int32))
+    worse = guard_nonfinite_update(streaky, new, jnp.asarray(jnp.nan), grads)
+    assert int(worse.nonfinite_streak) == 3
+    reset = guard_nonfinite_update(streaky, new, jnp.asarray(0.5), grads)
+    assert int(reset.nonfinite_streak) == 0
+
+
+def test_trainer_skips_nonfinite_step():
+    """Integration: a NaN batch through the real jitted step applies NO
+    update (params/opt state/BN stats identical) and increments the
+    streak; the next clean batch resets it and trains normally."""
+    from mpi_operator_tpu.data import synthetic_image_batch
+    from mpi_operator_tpu.models.resnet import create_model
+    from mpi_operator_tpu.parallel import MeshConfig, make_mesh
+    from mpi_operator_tpu.train import Trainer, TrainerConfig
+
+    mesh = make_mesh(MeshConfig.data_parallel(8))
+    trainer = Trainer(create_model("resnet18", num_classes=10,
+                                   dtype=jnp.float32), mesh,
+                      TrainerConfig(global_batch_size=16, image_size=32,
+                                    num_classes=10))
+    state = trainer.init_state(jax.random.PRNGKey(0))
+    imgs, labels = synthetic_image_batch(
+        jax.random.PRNGKey(1), 16, image_size=32, num_classes=10,
+        dtype=jnp.float32)
+    imgs = jax.device_put(imgs, trainer.batch_sharding)
+    labels = jax.device_put(labels, trainer.batch_sharding)
+    bad = jax.device_put(jnp.full_like(imgs, jnp.nan),
+                         trainer.batch_sharding)
+
+    before = jax.tree.map(jnp.copy, state.params)
+    state, m = trainer.train_step(state, bad, labels)
+    assert not np.isfinite(float(m["loss"]))
+    assert int(m["nonfinite_streak"]) == 1
+    assert int(state.step) == 1                # monotonic step counter
+    for a, b in zip(jax.tree.leaves(before), jax.tree.leaves(state.params)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    state, m = trainer.train_step(state, imgs, labels)
+    assert np.isfinite(float(m["loss"]))
+    assert int(m["nonfinite_streak"]) == 0     # clean step resets
+    changed = any(
+        not np.array_equal(np.asarray(a), np.asarray(b))
+        for a, b in zip(jax.tree.leaves(before),
+                        jax.tree.leaves(state.params)))
+    assert changed                             # the clean step DID train
+
+
+# ---------------------------------------------------------------------------
+# Checkpoint integrity, fallback, retention
+# ---------------------------------------------------------------------------
+
+def test_verify_rejects_missing_metadata(tmp_path):
+    save_checkpoint(tmp_path, _ckpt_state(1, 1.0), step=1)
+    path2 = save_checkpoint(tmp_path, _ckpt_state(2, 2.0), step=2)
+    assert verify_checkpoint(path2)
+    os.remove(os.path.join(path2, "_METADATA"))       # torn write
+    assert not verify_checkpoint(path2)
+    # latest skips the torn candidate and falls back a step
+    assert latest_checkpoint(str(tmp_path)).endswith("step_1")
+
+
+def test_corrupted_newest_falls_back_with_warning(tmp_path):
+    save_checkpoint(tmp_path, _ckpt_state(1, 1.0), step=1)
+    save_checkpoint(tmp_path, _ckpt_state(2, 2.0), step=2)
+    corrupted = corrupt_latest_checkpoint(str(tmp_path))
+    assert corrupted.endswith("step_2")
+    # the scribbled directory still LOOKS committed — only the restore
+    # itself can catch it
+    logs = []
+    restored, path = restore_with_fallback(str(tmp_path),
+                                           _ckpt_state(0, 0.0), logs.append)
+    assert path.endswith("step_1") and int(restored.step) == 1
+    np.testing.assert_array_equal(np.asarray(restored.params["w"]), 1.0)
+    assert any("WARNING" in l and "step_2" in l for l in logs)
+
+    logs2 = []
+    resumed = maybe_resume(str(tmp_path), _ckpt_state(0, 0.0), logs2.append)
+    assert int(resumed.step) == 1
+    assert any("resumed from" in l for l in logs2)
+
+
+def test_gc_checkpoints_keep_last(tmp_path):
+    for n in range(1, 6):
+        save_checkpoint(tmp_path, _ckpt_state(n, float(n)), step=n)
+    assert gc_checkpoints(str(tmp_path), keep_last=0) == []   # disabled
+    logs = []
+    assert gc_checkpoints(str(tmp_path), 2, logs.append) == [1, 2, 3]
+    assert checkpoint_steps(str(tmp_path)) == [4, 5]
+    assert any("checkpoint gc" in l for l in logs)
+    assert gc_checkpoints(str(tmp_path), 2) == []             # idempotent
+
+
+def test_periodic_saver_gc_bounds_retention(tmp_path):
+    logs = []
+    hook = periodic_saver(str(tmp_path), every=1, log=logs.append,
+                          keep_last=2)
+    for n in range(1, 5):
+        hook(_ckpt_state(n, float(n)), n)
+    wait_for_checkpoints()
+    steps = checkpoint_steps(str(tmp_path))
+    assert 1 not in steps                       # oldest collected
+    assert steps[-2:] == [3, 4]                 # newest retained
+    assert len(steps) <= 3                      # keep_last + in-flight
+
+
+def test_maybe_save_skip_and_reset(tmp_path):
+    logs = []
+    maybe_save(str(tmp_path), _ckpt_state(3, 1.0), logs.append)
+    assert any("written to" in l for l in logs)
+    logs.clear()
+    maybe_save(str(tmp_path), _ckpt_state(3, 1.0), logs.append)
+    assert any("already written" in l for l in logs)   # skip, no rewrite
+    reset_saved_state()
+    logs.clear()
+    maybe_save(str(tmp_path), _ckpt_state(3, 1.0), logs.append)
+    assert any("written to" in l for l in logs)        # record forgotten
+
+
+# ---------------------------------------------------------------------------
+# ResilienceContext: stop bit, emergency save, rollback budget
+# ---------------------------------------------------------------------------
+
+def test_context_sigterm_fault_drains_deterministically(tmp_path):
+    cfg = ResilienceConfig(train_dir=str(tmp_path))
+    with ResilienceContext(cfg, log=lambda s: None,
+                           faults=FaultInjector("sigterm-at-step:3")) as ctx:
+        assert not ctx.on_step(1) and not ctx.on_step(2)
+        assert ctx.on_step(3)       # injected preemption, deterministic
+        ctx.emergency_save(_ckpt_state(3, 3.0))
+    assert latest_checkpoint(str(tmp_path)).endswith("step_3")
+
+
+def test_context_rollback_restores_and_budgets(tmp_path):
+    save_checkpoint(tmp_path, _ckpt_state(2, 2.0), step=2)
+    logs = []
+    ctx = ResilienceContext(
+        ResilienceConfig(train_dir=str(tmp_path), max_rollbacks=2),
+        log=logs.append)
+    diverged = _ckpt_state(5, 999.0).replace(
+        nonfinite_streak=jnp.asarray(3, jnp.int32))
+    rolled = ctx.rollback(diverged)
+    assert int(rolled.step) == 2 and int(rolled.nonfinite_streak) == 0
+    np.testing.assert_array_equal(np.asarray(rolled.params["w"]), 2.0)
+    assert any("divergence rollback #1" in l for l in logs)
+    ctx.rollback(diverged)                      # budget: second is fine
+    with pytest.raises(DivergenceError, match="giving up"):
+        ctx.rollback(diverged)                  # third exceeds max_rollbacks
+
+
+def test_context_rollback_without_checkpoints_raises(tmp_path):
+    ctx = ResilienceContext(ResilienceConfig(train_dir=str(tmp_path)),
+                            log=lambda s: None)
+    with pytest.raises(DivergenceError, match="no restorable checkpoint"):
+        ctx.rollback(_ckpt_state(5, 1.0))
+    ctx2 = ResilienceContext(ResilienceConfig(train_dir=None),
+                             log=lambda s: None)
+    with pytest.raises(DivergenceError, match="no --train-dir"):
+        ctx2.rollback(_ckpt_state(5, 1.0))
+
+
+def test_context_enter_fires_corrupt_fault(tmp_path):
+    save_checkpoint(tmp_path, _ckpt_state(1, 1.0), step=1)
+    save_checkpoint(tmp_path, _ckpt_state(2, 2.0), step=2)
+    logs = []
+    with ResilienceContext(
+            ResilienceConfig(train_dir=str(tmp_path)), log=logs.append,
+            faults=FaultInjector("corrupt-latest-checkpoint")):
+        # __enter__ scribbled step_2 BEFORE any resume would run
+        assert any("fault-inject: corrupted" in l for l in logs)
+        restored, path = restore_with_fallback(
+            str(tmp_path), _ckpt_state(0, 0.0), logs.append)
+        assert path.endswith("step_1")
+
+
+# ---------------------------------------------------------------------------
+# The acceptance e2e: SIGTERM mid-run → emergency checkpoint → resume →
+# token-identical final state at the same global step.
+# ---------------------------------------------------------------------------
+
+def _tiny_lm(train_dir, log, **kw):
+    from mpi_operator_tpu.examples.lm_benchmark import run_lm_benchmark
+    return run_lm_benchmark(
+        workload="gpt2", size="test", batch_per_device=1, seq_len=16,
+        dtype_name="float32", warmup_steps=1, train_dir=train_dir,
+        log=log, **kw)
+
+
+def test_e2e_sigterm_resume_token_identical(tmp_path, monkeypatch):
+    monkeypatch.delenv("TPU_FAULT_INJECT", raising=False)
+    # A: uninterrupted — 1 warmup + 7 timed steps → global step 8
+    state_a, _ = _tiny_lm(str(tmp_path / "a"), lambda s: None, num_steps=7)
+    assert int(state_a.step) == 8
+
+    # B1: preempted at step 4 — the gang drains, writes the emergency
+    # checkpoint, raises Preempted (entrypoints turn it into exit 215)
+    logs = []
+    monkeypatch.setenv("TPU_FAULT_INJECT", "sigterm-at-step:4")
+    with pytest.raises(Preempted) as exc:
+        _tiny_lm(str(tmp_path / "b"), logs.append, num_steps=7)
+    assert exc.value.step == 4 and exc.value.exit_code == PREEMPTED_EXIT
+    assert any("preemption drain" in l for l in logs)
+    assert latest_checkpoint(str(tmp_path / "b")).endswith("step_4")
+
+    # B2: restart — resumes from step_4 and stops at the SAME global step
+    monkeypatch.delenv("TPU_FAULT_INJECT")
+    reset_saved_state()
+    logs2 = []
+    state_b, _ = _tiny_lm(str(tmp_path / "b"), logs2.append, num_steps=7,
+                          stop_at_step=8)
+    assert any("resumed from" in l for l in logs2)
+    assert int(state_b.step) == 8
+
+    # token-identical: the step-keyed stream replayed exactly the batches
+    # the uninterrupted run consumed, so params agree BITWISE
+    for a, b in zip(jax.tree.leaves(state_a.params),
+                    jax.tree.leaves(state_b.params)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
