@@ -167,14 +167,17 @@ def main() -> None:
     parser = argparse.ArgumentParser()
     parser.add_argument("--workload", default="all",
                         choices=["all", "resnet", "gpt2", "bert", "vit",
-                                 "llama", "moe", "allreduce", "generate"],
+                                 "llama", "moe", "allreduce", "generate",
+                                 "serving"],
                         help="all = the FULL BASELINE ladder in one line "
                              "(the driver default): resnet headline + "
-                             "gpt2/bert/llama/vit/moe/long-seq/decode "
-                             "legs; individual names run one leg; "
+                             "gpt2/bert/llama/vit/moe/long-seq/decode/"
+                             "serving legs; individual names run one leg; "
                              "allreduce = the scaling-efficiency "
                              "microbenchmark (BASELINE ≥90% 4→32); "
-                             "generate = KV-cache decode throughput")
+                             "generate = KV-cache decode throughput; "
+                             "serving = continuous batching vs sequential "
+                             "generate() over a mixed-length trace")
     parser.add_argument("--model", default="resnet101")
     # resnet default 256/device is the single-chip throughput sweet spot on
     # v5e (measured: 64→1377, 128→1408, 256→1612, 512→1442 img/s); the
@@ -209,11 +212,13 @@ def main() -> None:
                         choices=["bfloat16", "float32"])
     parser.add_argument("--smoke", action="store_true",
                         help="tiny CPU config for CI/verification")
-    # default 2400 (was 3000): the budget only gates leg STARTS, so a leg
-    # launched at t=2990 could overshoot a 3600s external timeout by
-    # minutes (exactly r05's rc=124). 2400 + the SIGALRM backstop below
-    # leaves finish() room to run even when the last leg runs long.
-    parser.add_argument("--budget-seconds", type=int, default=2400,
+    # default 1800 (was 2400, before that 3000): the budget only gates
+    # leg STARTS, so a leg launched near the budget edge still runs to
+    # completion — r06 hit rc=124 with 2400 because the trailing legs it
+    # admitted overshot the 3600s external timeout. 1800 + the shorter
+    # per-leg step counts below leave the worst-case ladder tail
+    # (one long leg + finish()) inside the timeout with real headroom.
+    parser.add_argument("--budget-seconds", type=int, default=1800,
                         help="wall-clock budget for the --workload all "
                              "ladder: once exceeded, remaining legs are "
                              "marked *_skipped instead of running, so "
@@ -230,7 +235,7 @@ def main() -> None:
     # any external timeout.
     signal.signal(signal.SIGTERM, _flush_on_signal)
     signal.signal(signal.SIGALRM, _flush_on_signal)
-    signal.alarm(args.budget_seconds + 420)
+    signal.alarm(args.budget_seconds + 300)
 
     _legs_written = [0]
 
@@ -448,6 +453,38 @@ def main() -> None:
                 emit_leg(prefix,
                          {f"{prefix}_error": type(exc).__name__})
 
+    def serving_metrics():
+        # continuous-batching engine vs trace-sequential generate(): the
+        # serving numbers a decode-throughput leg can't show (TTFT/TPOT
+        # percentiles under mixed-length arrivals + the no-recompile
+        # contract). Smoke shrinks the trace and model, not the shape of
+        # the measurement.
+        from mpi_operator_tpu.examples.serve_benchmark import (
+            run_serving_benchmark)
+        return retry_infra_once(lambda: run_serving_benchmark(
+            size="test" if args.smoke else None,
+            slots=4 if args.smoke else 8,
+            num_requests=8 if args.smoke else 32,
+            prompt_grid=(8, 16, 24) if args.smoke else (32, 64, 128),
+            new_grid=(4, 8) if args.smoke else (32, 64),
+            chunk_buckets=(8, 16) if args.smoke else (32, 128),
+            dtype_name=args.dtype,
+            log=lambda s: print(s, file=sys.stderr)))
+
+    if args.workload == "serving":
+        line = {
+            "metric": "serving_tokens_per_sec",
+            "value": None,
+            "unit": "tokens/sec",
+            "vs_baseline": 0.0,     # reference has no serving path
+        }
+        _SUMMARY_STATE["line"] = line
+        m = serving_metrics()
+        line.update(m)
+        line["value"] = m["serving_tokens_per_sec"]
+        emit_leg("serving", m)
+        finish(line)
+        return
     if args.workload == "generate":
         line = {
             "metric": "gpt2_decode_tokens_per_sec",
@@ -610,7 +647,11 @@ def main() -> None:
                 emit_leg(prefix,
                          {f"{prefix}_error": type(exc).__name__})
 
-        steps = min(args.steps, 20)
+        # per-leg step caps sized so the full ladder (now incl. the
+        # serving leg) lands inside --budget-seconds with margin: 15
+        # steady-state steps bound the throughput estimate as tightly as
+        # 20 did (spread < the run-to-run jitter already reported)
+        steps = min(args.steps, 15)
         warm = min(args.warmup, 3)
         # BASELINE configs[2-4] ladder: GPT-2, BERT-large-class, llama
         lm_leg("gpt2", workload="gpt2", steps=steps, warmup=warm)
@@ -641,7 +682,7 @@ def main() -> None:
         # configs — no remat, the kernel's 1024-tile auto policy
         lm_leg("gpt2_seq2048", workload="gpt2", steps=steps,
                warmup=warm, batch=4, seq=2048)
-        lm_leg("gpt2_seq4096", workload="gpt2", steps=min(args.steps, 15),
+        lm_leg("gpt2_seq4096", workload="gpt2", steps=min(args.steps, 10),
                warmup=warm, batch=2, seq=4096)
         # the SAME decode suite as --workload generate — the driver
         # records only this default run, so a leg measured in one mode
@@ -650,6 +691,24 @@ def main() -> None:
         # lesson: they budget-starved vit).
         clear_residue()
         run_decode_legs(line, skip_check=over_budget, legs=DECODE_LEGS)
+        # continuous-batching serving vs sequential generate() — rides
+        # right behind the decode legs it builds on (same fast path,
+        # ragged traffic); p50/p99 TTFT/TPOT land in the JSONL record
+        if not over_budget("serving"):
+            try:
+                clear_residue()
+                sm = serving_metrics()
+                line.update(sm)
+                emit_leg("serving", sm)
+            except Exception as exc:  # noqa: BLE001
+                from mpi_operator_tpu.train.resilience import Preempted
+                if isinstance(exc, Preempted):
+                    raise
+                print(f"# serving bench leg failed: {exc!r}",
+                      file=sys.stderr)
+                line["serving_error"] = type(exc).__name__
+                emit_leg("serving",
+                         {"serving_error": type(exc).__name__})
         # ViT-B/16 (BASELINE configs[5] single-chip point; the multi-slice
         # variant is the dryrun's dcn leg)
         if not over_budget("vit"):

@@ -15,10 +15,17 @@ from queue import Full, Queue
 from typing import Iterator
 
 
+#: end-of-stream marker the feeder enqueues when `_produce()` returns;
+#: `__next__` re-enqueues it so exhaustion is sticky (every subsequent
+#: next() raises StopIteration instead of blocking on an empty queue)
+_DONE = object()
+
+
 class PrefetchDataset:
-    """Infinite iterator with N-batch device prefetch. Subclasses must set
-    up all state their `_produce()` needs BEFORE calling
-    `_start_feeder()` (the thread starts immediately)."""
+    """Iterator with N-batch device prefetch. Subclasses must set up all
+    state their `_produce()` needs BEFORE calling `_start_feeder()` (the
+    thread starts immediately). The iterator ends (StopIteration) when
+    `_produce()` returns; the shipped pipelines produce forever."""
 
     def _start_feeder(self, prefetch: int = 2) -> None:
         self._queue: Queue = Queue(maxsize=prefetch)
@@ -47,6 +54,7 @@ class PrefetchDataset:
                     return
                 if not self._put(batch):
                     return
+            self._put(_DONE)                # finite producer: end cleanly
         except BaseException as e:          # surface in __next__, don't hang
             self._put(e)
 
@@ -55,6 +63,10 @@ class PrefetchDataset:
 
     def __next__(self):
         item = self._queue.get()
+        if item is _DONE:
+            # just freed a queue slot, so this put never blocks
+            self._queue.put(_DONE)
+            raise StopIteration
         if isinstance(item, BaseException):
             raise RuntimeError("data feeder thread failed") from item
         return item

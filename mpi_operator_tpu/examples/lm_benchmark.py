@@ -64,6 +64,7 @@ def run_lm_benchmark(
     ckpt_keep: int = 0,
     step_deadline: float = 0.0,
     divergence_k: int = 3,
+    stop_check_every: Optional[int] = None,
     stop_at_step: Optional[int] = None,
     lr_schedule: str = "linear",
     decay_steps: int = 10_000,
@@ -213,7 +214,8 @@ def run_lm_benchmark(
         pp_resilience = ResilienceContext(
             ResilienceConfig.from_env(train_dir=train_dir,
                                       divergence_k=divergence_k,
-                                      step_deadline=step_deadline),
+                                      step_deadline=step_deadline,
+                                      stop_check_every=stop_check_every),
             log=log)
         pp_resilience.__enter__()
         # checkpoints live in CANONICAL layer order (schedule-agnostic);
@@ -324,7 +326,8 @@ def run_lm_benchmark(
     resilience = ResilienceContext(
         ResilienceConfig.from_env(train_dir=train_dir,
                                   divergence_k=divergence_k,
-                                  step_deadline=step_deadline),
+                                  step_deadline=step_deadline,
+                                  stop_check_every=stop_check_every),
         log=log)
     # entering fires the corrupt-latest-checkpoint fault (if injected)
     # BEFORE the resume below, so the fallback path is what gets tested
@@ -578,6 +581,7 @@ def run_vit_benchmark(
     ckpt_keep: int = 0,
     step_deadline: float = 0.0,
     divergence_k: int = 3,
+    stop_check_every: Optional[int] = None,
     log: Callable[[str], None] = print,
 ) -> Tuple[object, Dict[str, float]]:
     """ViT-B/16 image benchmark; --num-slices 2 is the BASELINE multi-slice
@@ -606,7 +610,8 @@ def run_vit_benchmark(
     resilience = ResilienceContext(
         ResilienceConfig.from_env(train_dir=train_dir,
                                   divergence_k=divergence_k,
-                                  step_deadline=step_deadline),
+                                  step_deadline=step_deadline,
+                                  stop_check_every=stop_check_every),
         log=log)
     resilience.__enter__()
     try:
@@ -732,6 +737,12 @@ def main(argv=None) -> int:
                         help="consecutive non-finite steps (skipped "
                              "updates) before rolling back to the newest "
                              "checkpoint")
+    parser.add_argument("--stop-check-every", type=int, default=None,
+                        help="gang stop-bit allgather cadence in steps "
+                             "(multi-process only; default 8, env "
+                             "TPU_STOP_CHECK_EVERY) — every step costs a "
+                             "host round-trip per step, larger values "
+                             "trade drain latency for step time")
     parser.add_argument("--stop-at-step", type=int, default=None,
                         help="finish at this GLOBAL step instead of "
                              "running --num-steps past the resume point "
@@ -778,6 +789,7 @@ def main(argv=None) -> int:
                 ckpt_keep=args.ckpt_keep,
                 step_deadline=args.step_deadline,
                 divergence_k=args.divergence_k,
+                stop_check_every=args.stop_check_every,
                 log=log)
             headline = {"metric": "vit_images_per_sec",
                         "value": round(metrics["images_per_sec"], 2),
@@ -809,6 +821,7 @@ def main(argv=None) -> int:
                 ckpt_keep=args.ckpt_keep,
                 step_deadline=args.step_deadline,
                 divergence_k=args.divergence_k,
+                stop_check_every=args.stop_check_every,
                 stop_at_step=args.stop_at_step,
                 lr_schedule=args.lr_schedule,
                 decay_steps=args.decay_steps,

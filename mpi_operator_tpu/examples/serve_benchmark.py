@@ -1,0 +1,218 @@
+"""Serving benchmark: continuous batching vs sequential generate().
+
+Replays a seeded mixed-length request trace through the serving engine
+(serve/engine.py) and reports what a serving frontend cares about:
+
+- aggregate NEW-tokens/sec across the whole trace,
+- time-to-first-token (TTFT) p50/p99 — arrival → first sampled token,
+  queueing delay included (a burst trace IS a loaded server),
+- time-per-output-token (TPOT) p50/p99 — inter-token gaps per request,
+- the no-recompile contract: compile counts of the engine's programs
+  after the measured trace (step ≤ the 3 sample_slots modes, prefill
+  ≤ the bucket count).
+
+The baseline is the fixed-batch `generate()` oracle run TRACE-
+SEQUENTIALLY (batch 1, each request to completion before the next
+starts) — the naive way to serve ragged traffic with a lockstep
+decoder, and the number continuous batching has to beat. The prompt and
+new-token lengths are drawn from small grids so the baseline compiles
+one program per (P, N) pair, all warmed before timing; the engine is
+shape-oblivious by construction.
+"""
+from __future__ import annotations
+
+from typing import Callable, Dict, Optional, Sequence, Tuple
+
+
+def _percentiles(xs, ps=(50, 99)):
+    import numpy as np
+    if not xs:
+        return {p: None for p in ps}
+    return {p: float(np.percentile(np.asarray(xs), p)) for p in ps}
+
+
+def run_serving_benchmark(
+    size: Optional[str] = None,
+    family: str = "gpt2",
+    slots: int = 8,
+    num_requests: int = 32,
+    prompt_grid: Sequence[int] = (32, 64, 128),
+    new_grid: Sequence[int] = (32, 64),
+    chunk_buckets: Tuple[int, ...] = (32, 128),
+    dtype_name: str = "bfloat16",
+    temperature: float = 0.0,
+    kv_cache_dtype: Optional[str] = None,
+    decode_kernel: Optional[bool] = None,
+    baseline: bool = True,
+    seed: int = 0,
+    log: Callable[[str], None] = print,
+) -> Dict[str, object]:
+    """Returns a flat dict of serving metrics (see module docstring).
+    `temperature` > 0 makes every other request sample at that
+    temperature with top_k=40 (the rest stay greedy) — per-request
+    sampling params exercising ONE compiled step; the sequential
+    baseline runs each request at its own matching params."""
+    import time
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from ..models import create_lm, generate
+    from ..parallel import MeshConfig, make_mesh
+    from ..parallel.sharding import shard_init
+    from ..serve import EngineConfig, Request, ServingEngine
+
+    dtype = jnp.bfloat16 if dtype_name == "bfloat16" else jnp.float32
+    if decode_kernel is None:
+        # same auto policy as run_generate_benchmark: Pallas fast path on
+        # TPU, dense oracle elsewhere (interpret-mode pallas inside the
+        # step would simulate, not measure)
+        decode_kernel = jax.default_backend() == "tpu"
+    # cache length: fits the longest request, rounded up so the decode
+    # kernel's k-tile divides it (decode_block_k caps at max_len, so any
+    # multiple of 128 — or anything <= 128 that the tile equals — works)
+    need = max(prompt_grid) + max(new_grid)
+    max_len = need if need <= 128 else -(-need // 128) * 128
+    name = f"{family}-{size}" if size else family
+    model = create_lm(name, dtype=dtype, kv_cache_dtype=kv_cache_dtype,
+                      decode_kernel=decode_kernel, max_len=max_len)
+    mesh = make_mesh(MeshConfig(dp=jax.device_count()))
+    variables, _ = shard_init(
+        model, mesh, jax.random.PRNGKey(0),
+        jnp.zeros((1, min(prompt_grid)), jnp.int32))
+    params = variables["params"]
+
+    vocab = model.config.vocab_size
+    rs = np.random.RandomState(seed)
+
+    def make_request(i, p, n):
+        temp = (temperature if temperature > 0 and i % 2 == 1 else 0.0)
+        return Request(
+            id=i, prompt=rs.randint(0, vocab, (p,)).tolist(),
+            max_new_tokens=n, temperature=temp,
+            top_k=40 if temp > 0 else 0)
+
+    trace = [make_request(i, int(rs.choice(prompt_grid)),
+                          int(rs.choice(new_grid)))
+             for i in range(num_requests)]
+
+    engine = ServingEngine(model, params, EngineConfig(
+        slots=slots, chunk_buckets=tuple(chunk_buckets),
+        decode_kernel=decode_kernel, rng_seed=seed))
+
+    # warmup: one request per distinct prompt length (covers every
+    # prefill bucket the trace can hit) + the step program; then reset —
+    # the measured trace must be all steady-state
+    warm = [make_request(10_000 + j, p, 2)
+            for j, p in enumerate(sorted(set(int(r) for r in prompt_grid)))]
+    engine.run(warm)
+    engine.reset()
+
+    t0 = time.perf_counter()
+    results = engine.run(trace)
+    wall = time.perf_counter() - t0
+    total_new = sum(len(r.tokens) for r in results.values())
+    tps = total_new / wall
+    ttft = _percentiles([r.ttft for r in results.values()])
+    tpot = _percentiles([dt for r in results.values()
+                         for dt in np.diff(r.token_times)])
+    counts = engine.compile_counts()
+    # step has at most 3 variants (the sample_slots modes), prefill one
+    # program per bucket; anything beyond that is a recompile leak
+    no_recompile = (counts["step"] <= 3
+                    and counts["prefill"] <= len(chunk_buckets))
+
+    out: Dict[str, object] = {
+        "serving_tokens_per_sec": round(tps, 1),
+        "serving_requests": num_requests,
+        "serving_slots": slots,
+        "serving_total_new_tokens": total_new,
+        "serving_wall_seconds": round(wall, 3),
+        "serving_ttft_p50_ms": round(ttft[50] * 1e3, 2),
+        "serving_ttft_p99_ms": round(ttft[99] * 1e3, 2),
+        "serving_tpot_p50_ms": (round(tpot[50] * 1e3, 3)
+                                if tpot[50] is not None else None),
+        "serving_tpot_p99_ms": (round(tpot[99] * 1e3, 3)
+                                if tpot[99] is not None else None),
+        "serving_step_compiles": counts["step"],
+        "serving_prefill_compiles": counts["prefill"],
+        "serving_no_recompile": bool(no_recompile),
+        "serving_decode_kernel": bool(decode_kernel),
+    }
+    log(f"serving {name}: {num_requests} reqs over {slots} slots: "
+        f"{tps:.0f} new tokens/sec, TTFT p50/p99 "
+        f"{out['serving_ttft_p50_ms']}/{out['serving_ttft_p99_ms']} ms, "
+        f"TPOT p50/p99 {out['serving_tpot_p50_ms']}/"
+        f"{out['serving_tpot_p99_ms']} ms, recompile-free="
+        f"{no_recompile}")
+
+    if baseline:
+        # trace-sequential generate(): warm one compile per (P, N, temp)
+        # shape class, then replay the identical trace one request at a
+        # time. Same params, same sampling config per request.
+        def run_one(req):
+            return generate(
+                model, params, jnp.asarray([list(req.prompt)]),
+                req.max_new_tokens, temperature=req.temperature,
+                top_k=req.top_k or None,
+                rng=(jax.random.PRNGKey(req.id)
+                     if req.temperature > 0 else None))
+
+        shapes = {}
+        for r in trace:
+            shapes[(len(r.prompt), r.max_new_tokens,
+                    r.temperature > 0)] = r
+        for r in shapes.values():
+            int(run_one(r).tokens[0, -1])       # compile + true barrier
+        t0 = time.perf_counter()
+        for r in trace:
+            o = run_one(r)
+        int(o.tokens[0, -1])                    # host read = barrier
+        base_wall = time.perf_counter() - t0
+        base_total = sum(r.max_new_tokens for r in trace)
+        base_tps = base_total / base_wall
+        speedup = tps / base_tps if base_tps else None
+        out.update({
+            "sequential_tokens_per_sec": round(base_tps, 1),
+            "sequential_wall_seconds": round(base_wall, 3),
+            "serving_vs_sequential": (round(speedup, 2)
+                                      if speedup else None),
+        })
+        log(f"sequential generate() baseline: {base_tps:.0f} new "
+            f"tokens/sec -> continuous batching {speedup:.2f}x")
+    return out
+
+
+def main(argv=None) -> int:
+    import argparse
+    import json
+
+    parser = argparse.ArgumentParser(prog="tpu-serving-benchmark")
+    parser.add_argument("--size", default=None)
+    parser.add_argument("--family", default="gpt2",
+                        choices=["gpt2", "llama"])
+    parser.add_argument("--slots", type=int, default=8)
+    parser.add_argument("--num-requests", type=int, default=32)
+    parser.add_argument("--dtype", default="bfloat16",
+                        choices=["bfloat16", "float32"])
+    parser.add_argument("--temperature", type=float, default=0.0)
+    parser.add_argument("--kv-cache-dtype", default=None,
+                        choices=[None, "int8"])
+    parser.add_argument("--no-baseline", action="store_true")
+    parser.add_argument("--seed", type=int, default=0)
+    args = parser.parse_args(argv)
+    metrics = run_serving_benchmark(
+        size=args.size, family=args.family, slots=args.slots,
+        num_requests=args.num_requests, dtype_name=args.dtype,
+        temperature=args.temperature, kv_cache_dtype=args.kv_cache_dtype,
+        baseline=not args.no_baseline, seed=args.seed)
+    print(json.dumps({"metric": "serving_tokens_per_sec",
+                      "value": metrics["serving_tokens_per_sec"],
+                      "unit": "tokens/sec", **metrics}))
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+    sys.exit(main())

@@ -5,4 +5,6 @@ from .transformer import (  # noqa: F401,E402
     CausalLM, MaskedLM, TransformerConfig, ViT, bert_config, create_lm,
     create_vit, gpt2_config, vit_config,
 )
-from .generate import GenerateResult, generate  # noqa: F401,E402
+from .generate import (  # noqa: F401,E402
+    GenerateResult, cast_params, decode_model, generate,
+)

@@ -37,6 +37,38 @@ class GenerateResult(NamedTuple):
     logprobs: jax.Array        # [B, max_new_tokens] logprob of each choice
 
 
+def decode_model(model, decode_kernel: Optional[bool] = None,
+                 slots: bool = False):
+    """The decode-mode twin of a trained CausalLM: same params (decode
+    adds none, so checkpoints load directly), dense attention (the cache
+    path does its own masking), no remat. `decode_kernel` None inherits
+    the model config. `slots=True` additionally flips `decode_slots` —
+    the per-row-cursor cache mode the serving engine drives
+    (serve/engine.py); generate() keeps the lockstep twin."""
+    cfg = model.config
+    return type(model)(dataclasses.replace(
+        cfg, decode=True, attention="dense", remat=False,
+        decode_slots=slots,
+        decode_kernel=(cfg.decode_kernel if decode_kernel is None
+                       else decode_kernel)))
+
+
+def cast_params(params, dtype):
+    """Cast f32 master params to the decode compute dtype, fenced behind
+    an optimization_barrier. Decode is HBM-bound — every step re-reads
+    the whole parameter set — and without the barrier XLA sinks the
+    convert INTO the decode while-loop (rematerializing it per step as
+    sliced chunks), so every step re-reads the 2x-bigger f32 masters:
+    measured on v5e via the op trace, 76k slice/convert ops inside the
+    loop, 45% MBU. Call INSIDE the jitted program that loops (generate),
+    or once up front in a dedicated jit whose output stays device-resident
+    across many step calls (the serving engine)."""
+    params = jax.tree.map(
+        lambda x: x.astype(dtype) if jnp.issubdtype(x.dtype, jnp.floating)
+        else x, params)
+    return jax.lax.optimization_barrier(params)
+
+
 def _sample(logits, greedy, temperature, rng, top_k, use_top_p, top_p):
     """[B, V] logits → ([B] token, [B] logprob of the chosen token).
     `greedy`/`top_k`/`use_top_p` are static (they change the program);
@@ -75,20 +107,11 @@ def _generate_jit(dmodel, params, prompt, max_new_tokens, temperature,
     from .transformer import _head_matmul
 
     B, P = prompt.shape
-    # Decode is HBM-bound: every step re-reads the whole parameter set,
-    # so cast the f32 master params to the compute dtype once up front.
-    # The optimization_barrier is load-bearing: without it XLA sinks the
-    # convert INTO the decode while-loop (rematerializing it per step as
-    # sliced chunks), so every step re-reads the 2x-bigger f32 masters —
-    # measured on v5e via the op trace: 76k slice/convert ops inside the
-    # loop, 45% MBU. (Casting OUTSIDE the jit is no answer either: on a
-    # tunneled backend the inter-jit handoff re-transfers the params,
-    # 5x slower end to end.)
-    dt = dmodel.config.dtype
-    params = jax.tree.map(
-        lambda x: x.astype(dt) if jnp.issubdtype(x.dtype, jnp.floating)
-        else x, params)
-    params = jax.lax.optimization_barrier(params)
+    # cast the f32 masters to the compute dtype once up front — see
+    # cast_params for why the barrier is load-bearing. (Casting OUTSIDE
+    # the jit is no answer here: on a tunneled backend the inter-jit
+    # handoff re-transfers the params, 5x slower end to end.)
+    params = cast_params(params, dmodel.config.dtype)
     table = params["wte"]["embedding"]
 
     # prefill: one multi-token call fills the cache; only the LAST
@@ -170,10 +193,7 @@ def generate(model, params, prompt, max_new_tokens: int,
                          f"{cfg.vocab_size}]")
     if top_p is not None and not 0.0 < top_p <= 1.0:
         raise ValueError(f"top_p={top_p} must be in (0, 1]")
-    dmodel = type(model)(dataclasses.replace(
-        cfg, decode=True, attention="dense", remat=False,
-        decode_kernel=(cfg.decode_kernel if decode_kernel is None
-                       else decode_kernel)))
+    dmodel = decode_model(model, decode_kernel)
     return _generate_jit(dmodel, params, prompt, int(max_new_tokens),
                          jnp.float32(temperature),
                          rng if rng is not None else jax.random.PRNGKey(0),
@@ -182,4 +202,4 @@ def generate(model, params, prompt, max_new_tokens: int,
                          jnp.float32(top_p if top_p is not None else 1.0))
 
 
-__all__ = ["generate", "GenerateResult"]
+__all__ = ["generate", "GenerateResult", "decode_model", "cast_params"]

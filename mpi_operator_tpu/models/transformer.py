@@ -89,6 +89,14 @@ class TransformerConfig:
     decode_kernel: bool = False
     # decode-kernel k-tile (None = ops.attention.decode_block_k default)
     decode_block_k: Optional[int] = None
+    # slot-cursor decode (serve/): every cache row is an independent
+    # request SLOT at its own generation depth. `positions` ([B, S])
+    # carries each row's absolute write/attend offsets, K/V writes
+    # scatter per-row, attention masks per-row, and the scalar
+    # `cache_index` variable is NOT created — the serving engine owns
+    # per-slot cursors host-side, so admitting/retiring requests never
+    # touches compiled code. Requires decode=True and explicit positions.
+    decode_slots: bool = False
     # latency-hiding tensor parallelism: run the tp-sharded projections
     # (Attention qkv/out, Mlp in/out, and the fused-LM-loss logits matmul)
     # as explicit ring collective-matmuls
@@ -277,7 +285,7 @@ class Attention(nn.Module):
             q = rope(q, pos)
             k = rope(k, pos)
         if cfg.decode:
-            out = self._decode_attend(q, k, v)
+            out = self._decode_attend(q, k, v, positions=positions)
         else:
             if KV != H:
                 # repeat K/V across query groups for the shared kernels
@@ -376,7 +384,7 @@ class Attention(nn.Module):
             check_vma=False)
         return fn(a, wo, bo)
 
-    def _decode_attend(self, q, k, v):
+    def _decode_attend(self, q, k, v, positions=None):
         """KV-cache attention for autoregressive decoding: append this
         call's K/V at the cache cursor, attend q against everything
         written so far (positions > cursor+S masked). Handles both the
@@ -384,6 +392,13 @@ class Attention(nn.Module):
         the cursor (`cache_index`) advances by the call's length. RoPE is
         applied HERE (cursor-offset absolute positions) so cached keys
         are pre-rotated.
+
+        With cfg.decode_slots the rows decouple: `positions` [B, S] gives
+        each row its OWN absolute offsets (row b writes its K/V at
+        positions[b] and attends cache <= positions[b]), the writes
+        become per-row scatters, and no cache_index variable exists —
+        the serving engine drives the cursors from the host, one
+        compiled step for any mix of request depths.
 
         Cache layout is kv-head-MAJOR [B, KV, L, D] (scales [B, KV, L]) —
         the tiled form the Pallas decode kernel streams directly, and the
@@ -399,10 +414,41 @@ class Attention(nn.Module):
         B, S, H, D = q.shape
         KV = k.shape[2]
         L = cfg.max_len
-        ci = self.variable("cache", "cache_index",
-                           lambda: jnp.zeros((), jnp.int32))
-        cur = ci.value
-        pos = cur + jnp.arange(S)                     # query positions
+        if cfg.decode_slots:
+            if positions is None:
+                raise ValueError(
+                    "decode_slots=True needs explicit positions ([B, S] "
+                    "absolute per-slot offsets from the serving engine)")
+            pos = jnp.broadcast_to(
+                jnp.asarray(positions, jnp.int32), (B, S))  # [B, S]
+            cur = pos[:, 0]                       # [B] per-slot cursors
+
+            def upd4(c, u):   # [B, KV, L, D] ← [B, KV, S, D] at row cursors
+                return jax.vmap(
+                    lambda cb, ub, s: jax.lax.dynamic_update_slice(
+                        cb, ub, (0, s, 0)))(c, u, cur)
+
+            def upd3(c, u):   # [B, KV, L] ← [B, KV, S] (int8 scales)
+                return jax.vmap(
+                    lambda cb, ub, s: jax.lax.dynamic_update_slice(
+                        cb, ub, (0, s)))(c, u, cur)
+
+            def bump():
+                pass          # the engine owns the cursors host-side
+        else:
+            ci = self.variable("cache", "cache_index",
+                               lambda: jnp.zeros((), jnp.int32))
+            cur = ci.value
+            pos = cur + jnp.arange(S)                 # query positions
+
+            def upd4(c, u):
+                return jax.lax.dynamic_update_slice(c, u, (0, 0, cur, 0))
+
+            def upd3(c, u):
+                return jax.lax.dynamic_update_slice(c, u, (0, 0, cur))
+
+            def bump():
+                ci.value = cur + S
         if cfg.pos_embedding == "rope":
             q = rope(q, pos)
             k = rope(k, pos)
@@ -434,26 +480,20 @@ class Attention(nn.Module):
                                (B, KV, L), jnp.float32)
             k8, k_sc = quant(k_t)
             v8, v_sc = quant(v_t)
-            ck.value = _constrain_cache(jax.lax.dynamic_update_slice(
-                ck.value, k8, (0, 0, cur, 0)))
-            cv.value = _constrain_cache(jax.lax.dynamic_update_slice(
-                cv.value, v8, (0, 0, cur, 0)))
-            ks.value = jax.lax.dynamic_update_slice(
-                ks.value, k_sc, (0, 0, cur))
-            vs.value = jax.lax.dynamic_update_slice(
-                vs.value, v_sc, (0, 0, cur))
-            ci.value = cur + S
+            ck.value = _constrain_cache(upd4(ck.value, k8))
+            cv.value = _constrain_cache(upd4(cv.value, v8))
+            ks.value = upd3(ks.value, k_sc)
+            vs.value = upd3(vs.value, v_sc)
+            bump()
             k_scale, v_scale = ks.value, vs.value
         else:
             ck = self.variable("cache", "cached_key", jnp.zeros,
                                (B, KV, L, D), k.dtype)
             cv = self.variable("cache", "cached_value", jnp.zeros,
                                (B, KV, L, D), v.dtype)
-            ck.value = _constrain_cache(jax.lax.dynamic_update_slice(
-                ck.value, k_t, (0, 0, cur, 0)))
-            cv.value = _constrain_cache(jax.lax.dynamic_update_slice(
-                cv.value, v_t, (0, 0, cur, 0)))
-            ci.value = cur + S
+            ck.value = _constrain_cache(upd4(ck.value, k_t))
+            cv.value = _constrain_cache(upd4(cv.value, v_t))
+            bump()
 
         if cfg.decode_kernel and S == 1:
             from ..ops.attention import decode_attention, decode_block_k
@@ -476,8 +516,11 @@ class Attention(nn.Module):
             values = jnp.repeat(values, H // KV, axis=1)
         logits = jnp.einsum("bqhd,bhkd->bhqk", q, keys)
         logits = logits.astype(jnp.float32) / jnp.sqrt(D)
-        visible = jnp.arange(L)[None, :] <= pos[:, None]       # [S, L]
-        logits = jnp.where(visible[None, None], logits, -1e30)
+        # per-row visibility: [B, S, L] (pos broadcasts from [S] in
+        # lockstep mode, is genuinely per-row in slot mode)
+        visible = (jnp.arange(L)[None, None, :]
+                   <= jnp.broadcast_to(pos, (B, S))[:, :, None])
+        logits = jnp.where(visible[:, None], logits, -1e30)
         probs = jax.nn.softmax(logits, axis=-1).astype(cfg.dtype)
         return jnp.einsum("bhqk,bhkd->bqhd", probs, values)
 

@@ -465,10 +465,13 @@ def _decode_kernel(cur_ref, q_ref, k_ref, v_ref, ks_ref, vs_ref, o_ref,
     block instead of streaming dead cache), and the boundary block masks
     columns beyond the cursor. int8 caches dequantize BLOCKWISE in VMEM
     (ks/vs are the per-position scales) — the bf16 cache transient the
-    dense path materializes in HBM never exists here."""
+    dense path materializes in HBM never exists here. The cursor vector
+    is per-row ([B]): row b attends positions <= cur_ref[b], which is
+    what lets the serving engine pack independent requests at unrelated
+    generation depths into one compiled step."""
     ki = pl.program_id(2)
     nk = pl.num_programs(2)
-    cur = cur_ref[0]
+    cur = cur_ref[pl.program_id(0)]
 
     @pl.when(ki == 0)
     def _init():
@@ -532,9 +535,12 @@ def decode_attention(q, k_cache, v_cache, cache_index,
     q            [B, H, D]      this step's queries (RoPE already applied)
     k_cache/v_cache [B, KV, L, D]  the kv-head-major cache; bf16/f32, or
                  int8 when k_scale/v_scale are given
-    cache_index  scalar int32   absolute position of this step's token;
-                 the kernel attends to cache positions <= cache_index and
-                 never streams the unfilled suffix
+    cache_index  scalar int32, or int32 [B] of per-row cursors: absolute
+                 position of this step's token; row b attends cache
+                 positions <= cursor(b) and never streams the unfilled
+                 suffix. The scalar form is the lockstep `generate()`
+                 path; the vector form is the serving engine's slot
+                 cursors, where every row sits at its own depth
     k_scale/v_scale [B, KV, L] f32  int8 per-(position, head) scales
 
     Returns [B, H, D]. GQA (H > KV) is native: each kv head serves its
@@ -556,9 +562,15 @@ def decode_attention(q, k_cache, v_cache, cache_index,
         interpret = jax.default_backend() != "tpu"
     nk = L // bk
     quantized = k_scale is not None
+    cur = jnp.asarray(cache_index, jnp.int32)
+    if cur.ndim == 0:
+        cur = jnp.broadcast_to(cur[None], (B,))
+    elif cur.shape != (B,):
+        raise ValueError(f"cache_index must be scalar or [B]={B}, "
+                         f"got shape {cur.shape}")
 
-    def last_blk(cur_ref):
-        return jnp.minimum(cur_ref[0] // bk, nk - 1)
+    def last_blk(cur_ref, b):
+        return jnp.minimum(cur_ref[b] // bk, nk - 1)
 
     q4 = q.reshape(B, KV, G, D)       # query head h ↔ kv head h // G,
     #                                   matching jnp.repeat(kv, G, axis)
@@ -566,11 +578,13 @@ def decode_attention(q, k_cache, v_cache, cache_index,
         pl.BlockSpec((1, 1, G, D), lambda b, h, ki, cur: (b, h, 0, 0)),
         pl.BlockSpec((1, 1, bk, D),
                      lambda b, h, ki, cur: (b, h,
-                                            jnp.minimum(ki, last_blk(cur)),
+                                            jnp.minimum(ki,
+                                                        last_blk(cur, b)),
                                             0)),
         pl.BlockSpec((1, 1, bk, D),
                      lambda b, h, ki, cur: (b, h,
-                                            jnp.minimum(ki, last_blk(cur)),
+                                            jnp.minimum(ki,
+                                                        last_blk(cur, b)),
                                             0)),
     ]
     args = [q4, k_cache, v_cache]
@@ -581,7 +595,8 @@ def decode_attention(q, k_cache, v_cache, cache_index,
         # scale block Mosaic-legal (last dim equal to the array dim)
         scale_spec = pl.BlockSpec(
             (1, 1, bk, 1),
-            lambda b, h, ki, cur: (b, h, jnp.minimum(ki, last_blk(cur)), 0))
+            lambda b, h, ki, cur: (b, h,
+                                   jnp.minimum(ki, last_blk(cur, b)), 0))
         in_specs += [scale_spec, scale_spec]
         args += [k_scale[..., None], v_scale[..., None]]
     else:
@@ -608,7 +623,7 @@ def decode_attention(q, k_cache, v_cache, cache_index,
         grid_spec=grid_spec,
         out_shape=_out_struct((B, KV, G, D), q.dtype, q, k_cache, v_cache),
         interpret=interpret,
-    )(jnp.asarray(cache_index, jnp.int32).reshape(1), *args)
+    )(cur, *args)
     return out.reshape(B, H, D)
 
 

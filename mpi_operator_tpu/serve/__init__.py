@@ -1,0 +1,22 @@
+"""Continuous-batching serving: slot-scheduled decode over the fast path.
+
+    from mpi_operator_tpu.serve import Request, EngineConfig, ServingEngine
+    engine = ServingEngine(model, params, EngineConfig(slots=8))
+    results = engine.run([Request(0, prompt_ids, max_new_tokens=64)])
+
+See engine.py for the architecture notes; generate() remains the
+fixed-batch oracle the engine is parity-tested against.
+"""
+from .engine import (  # noqa: F401
+    EngineConfig, RequestResult, ServingEngine, sample_slots,
+)
+from .scheduler import (  # noqa: F401
+    Request, RequestState, Scheduler, plan_chunks,
+)
+from .slots import SlotManager  # noqa: F401
+
+__all__ = [
+    "EngineConfig", "Request", "RequestResult", "RequestState",
+    "Scheduler", "ServingEngine", "SlotManager", "plan_chunks",
+    "sample_slots",
+]

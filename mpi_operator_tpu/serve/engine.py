@@ -1,0 +1,342 @@
+"""Continuous-batching serving engine over the decode fast path.
+
+`generate()` (models/generate.py) is the fixed-batch oracle: equal-length
+prompts, lockstep to max_new_tokens, EOS rows burning full decode compute,
+no admission until the whole batch drains. This engine serves the same
+model the way a frontend needs it served:
+
+- **Slots.** The KV cache is ONE fixed [SLOTS, KV, L, D] buffer per layer
+  (transformer.py `decode_slots`); each row is an independent request at
+  its own depth, driven by per-row cursors the host owns. Finishing a
+  request frees its row immediately; the next queued request moves in.
+  Nothing about admission/retirement touches compiled code.
+- **One compiled decode step.** Every step advances ALL slots one token —
+  cursors, input tokens, and per-slot sampling params (temperature /
+  top-k / top-p, the traced-per-row generalization of generate's
+  `_sample`) are plain array operands. Compiled once, reused for the
+  lifetime of the engine (asserted via `compile_counts` in tests).
+- **Chunked prefill.** Prompts prefill in fixed windows bucketed to ≤3
+  compiled shapes (scheduler.plan_chunks), one chunk per engine loop
+  iteration, interleaved with decode steps — a long prompt cannot stall
+  in-flight decodes, and ragged prompt lengths stop forcing per-shape
+  recompiles.
+
+Parity: at temperature 0 a single request produces token-for-token the
+same output as `generate()` — tests/test_serve.py pins this across the
+dense and Pallas decode-kernel paths.
+"""
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from ..models.generate import cast_params, decode_model
+from .scheduler import Request, RequestState, Scheduler
+from .slots import SlotManager
+
+
+@dataclasses.dataclass
+class EngineConfig:
+    """Serving knobs. `slots` is the decode batch (rows in the cache);
+    `chunk_buckets` are the ≤3 compiled prefill widths — cover your
+    common prompt lengths with the fewest windows (a prompt of length P
+    prefills ceil((P-1)/largest) windows, ragged tail right-aligned).
+    `decode_kernel` None inherits the model config."""
+    slots: int = 8
+    chunk_buckets: Tuple[int, ...] = (32, 128, 512)
+    decode_kernel: Optional[bool] = None
+    rng_seed: int = 0
+
+
+@dataclasses.dataclass
+class RequestResult:
+    id: int
+    tokens: List[int]                 # new tokens only (no prompt)
+    logprobs: List[float]
+    finish_reason: str                # "eos" | "length"
+    ttft: float                       # arrival → first new token, seconds
+    token_times: List[float]          # absolute (run-relative) per token
+
+
+#: bounded-mode candidate pool: exact for any request with an active
+#: top_k <= this (the nucleus then lives inside the kept top-k set, so
+#: the tail beyond the pool carries zero probability mass by
+#: construction) — and a lax.top_k of 128 is far cheaper per step than
+#: the full-vocab sort the unbounded filters need
+SAMPLE_POOL = 128
+
+
+def sample_slots(logits, rng, temperature, top_k, top_p,
+                 mode: str = "full"):
+    """[B, V] logits + per-row [B] sampling params (ALL traced) →
+    ([B] token, [B] logprob of the choice, from the UNfiltered
+    distribution — same reporting convention as generate._sample).
+
+    generate's `_sample` makes greedy/top_k/use_top_p STATIC — right for
+    a lockstep batch sharing one config, wrong here where every slot
+    carries its own params and the step must stay one compiled program.
+    So: temperature==0 rows select argmax via a where; top_k becomes a
+    traced threshold (k-th largest off a descending-sorted candidate
+    pool); top_p==1 rows keep the whole nucleus. The filter arithmetic
+    mirrors _sample, so a slot at (t, k, p) samples from the same
+    distribution a generate() batch at static (t, k, p) would.
+
+    `mode` is the one STATIC knob — three compiled variants, chosen by
+    the host which knows the active rows exactly:
+      "greedy"  — every active row is temperature 0: pure argmax, no
+                  filter work at all (the common serving case);
+      "bounded" — every sampling row has 1 <= top_k <= SAMPLE_POOL: the
+                  candidate pool is lax.top_k(SAMPLE_POOL), EXACT for
+                  both filters (post-top-k, all probability mass lives
+                  in the pool) at a fraction of the full sort;
+      "full"    — anything else (top_k disabled or huge): the pool is
+                  the whole vocab, one full descending sort."""
+    logits = logits.astype(jnp.float32)
+    V = logits.shape[-1]
+    logp = jax.nn.log_softmax(logits)
+    greedy_tok = jnp.argmax(logits, axis=-1)
+    if mode == "greedy":
+        return greedy_tok, jnp.take_along_axis(
+            logp, greedy_tok[:, None], axis=-1)[:, 0]
+    W = V if mode == "full" else min(SAMPLE_POOL, V)
+    scaled = logp / jnp.maximum(temperature, 1e-6)[:, None]
+    # ONE top-k/sort serves both filters: the top-k threshold reads
+    # straight off the pool, and because softmax is permutation-
+    # equivariant, masking in the SORTED domain gives the nucleus its
+    # sorted post-top-k probabilities without a second sort.
+    pool = jax.lax.top_k(scaled, W)[0]            # [B, W] descending
+    # top-k: mask below the k-th largest; k<=0 disables (keeps the pool)
+    k = jnp.where(top_k <= 0, W, jnp.clip(top_k, 1, W))
+    kth = jnp.take_along_axis(pool, (k - 1)[:, None], axis=-1)
+    scaled = jnp.where(scaled < kth, -jnp.inf, scaled)
+    cols = jnp.arange(W)[None, :]
+    pool_masked = jnp.where(cols < k[:, None], pool, -jnp.inf)
+    sorted_p = jax.nn.softmax(pool_masked)
+    # nucleus: smallest prefix of the sorted distribution with cumulative
+    # probability >= top_p (kept set always includes the argmax). The
+    # threshold is applied in the LOGIT domain — pool entries are bitwise
+    # copies of `scaled` entries, so the comparison is exact, whereas a
+    # probability-domain cutoff recomputes a softmax whose 1-ulp
+    # normalizer drift can strand the boundary token (softmax is
+    # monotone, so the kept set is identical)
+    cum = jnp.cumsum(sorted_p, axis=-1)
+    cutoff_idx = jnp.minimum(jnp.sum(cum < top_p[:, None], axis=-1), W - 1)
+    cutoff = jnp.take_along_axis(pool_masked, cutoff_idx[:, None], axis=-1)
+    scaled = jnp.where(scaled < cutoff, -jnp.inf, scaled)
+    sampled = jax.random.categorical(rng, scaled)
+    tok = jnp.where(temperature <= 0.0, greedy_tok, sampled)
+    return tok, jnp.take_along_axis(logp, tok[:, None], axis=-1)[:, 0]
+
+
+class ServingEngine:
+    """Continuous-batching inference over a trained CausalLM.
+
+    Usage:
+        engine = ServingEngine(model, params, EngineConfig(slots=8))
+        results = engine.run([Request(0, prompt_ids, max_new_tokens=64)])
+        results[0].tokens       # streamed order; or pass on_token=
+
+    The engine is single-threaded and synchronous: `run` drives the
+    admit → prefill-chunk → decode-step loop to completion and returns
+    per-request results. Submit-with-future-`arrival` replays a trace.
+    """
+
+    def __init__(self, model, params, config: Optional[EngineConfig] = None):
+        cfg = config or EngineConfig()
+        mcfg = model.config
+        if not mcfg.causal:
+            raise ValueError("serving needs a causal LM")
+        for b in cfg.chunk_buckets:
+            if b > mcfg.max_len:
+                raise ValueError(f"chunk bucket {b} exceeds "
+                                 f"max_len={mcfg.max_len}")
+        self.config = cfg
+        self.model_config = mcfg
+        self.dmodel = decode_model(model, cfg.decode_kernel, slots=True)
+        self._base_rng = jax.random.PRNGKey(cfg.rng_seed)
+        self._steps_dispatched = 0
+
+        dmodel = self.dmodel
+        dt = dmodel.config.dtype
+        S = cfg.slots
+
+        # params cast once, device-resident across every step (decode is
+        # HBM-bound; see generate.cast_params for the barrier story)
+        self._cast = jax.jit(lambda p: cast_params(p, dt))
+        self.params = self._cast(params)
+
+        def init_cache(params):
+            # a zero-token step apply materializes the cache collection
+            # at its serving shape; the hidden-state output is discarded
+            z = jnp.zeros((S, 1), jnp.int32)
+            _, vars_ = dmodel.apply({"params": params}, z, positions=z,
+                                    with_head=False, mutable=["cache"])
+            return vars_["cache"]
+
+        def prefill(params, cache, slot, tokens, start):
+            # one chunk for one slot: slice the row out, run the
+            # backbone headless over [1, C] tokens at absolute
+            # positions start..start+C, splice the row back. `slot` and
+            # `start` are traced operands — one compile per bucket C.
+            row = jax.tree.map(
+                lambda x: lax.dynamic_slice_in_dim(x, slot, 1, 0), cache)
+            positions = (start + jnp.arange(tokens.shape[0]))[None]
+            _, vars_ = dmodel.apply(
+                {"params": params, "cache": row}, tokens[None],
+                positions=positions, with_head=False, mutable=["cache"])
+            return jax.tree.map(
+                lambda full, r: lax.dynamic_update_slice_in_dim(
+                    full, r, slot, 0),
+                cache, vars_["cache"])
+
+        def step(params, cache, tokens, positions, rng,
+                 temperature, top_k, top_p, mode):
+            # ONE token for ALL slots: [S] tokens at [S] cursors
+            from ..models.transformer import _head_matmul
+            h, vars_ = dmodel.apply(
+                {"params": params, "cache": cache}, tokens[:, None],
+                positions=positions[:, None], with_head=False,
+                mutable=["cache"])
+            logits = _head_matmul(h[:, 0], params["wte"]["embedding"])
+            tok, logp = sample_slots(logits, rng, temperature, top_k,
+                                     top_p, mode=mode)
+            return vars_["cache"], tok, logp
+
+        # cache buffers are donated — the engine holds the only live
+        # reference, and [SLOTS, KV, L, D] per layer is the biggest
+        # allocation here; donation keeps it single-buffered. (CPU has
+        # no donation support and would warn per program.)
+        donate = (1,) if jax.default_backend() in ("tpu", "gpu") else ()
+        self._init_cache = jax.jit(init_cache)
+        self._prefill = jax.jit(prefill, donate_argnums=donate)
+        self._step = jax.jit(step, donate_argnums=donate,
+                             static_argnums=(8,))
+
+        self.scheduler = Scheduler(cfg.chunk_buckets, mcfg.max_len)
+        self.slots = SlotManager(S)
+        self.cache = self._init_cache(self.params)
+
+    # -- bookkeeping ------------------------------------------------------
+
+    def reset(self) -> None:
+        """Clear all serving state (queue, slots, cache contents) but
+        keep every compiled program — what the bench calls between the
+        warmup trace and the measured trace."""
+        self.scheduler = Scheduler(self.config.chunk_buckets,
+                                   self.model_config.max_len)
+        self.slots = SlotManager(self.config.slots)
+        self.cache = self._init_cache(self.params)
+        # the per-step rng folds in this counter — rewind it so a reset
+        # engine replays a trace with identical draws
+        self._steps_dispatched = 0
+
+    def compile_counts(self) -> Dict[str, int]:
+        """Executable-cache sizes of the engine's jitted programs —
+        the no-recompile contract is `step <= 3` (at most one program
+        per sample_slots mode; a pure-greedy trace compiles 1) and
+        `prefill <= len(chunk_buckets)` no matter what trace ran."""
+        return {
+            "step": self._step._cache_size(),
+            "prefill": self._prefill._cache_size(),
+            "init_cache": self._init_cache._cache_size(),
+            "cast": self._cast._cache_size(),
+        }
+
+    # -- the loop ---------------------------------------------------------
+
+    def _run_prefill_chunk(self, st: RequestState) -> None:
+        w, size = st.chunks.pop(0)
+        p1 = len(st.req.prompt) - 1
+        window = list(st.req.prompt[w:min(w + size, p1)])
+        window += [0] * (size - len(window))     # right-pad short prompts
+        self.cache = self._prefill(
+            self.params, self.cache, jnp.int32(st.slot),
+            jnp.asarray(window, jnp.int32), jnp.int32(w))
+        st.pos = min(p1, w + size)
+
+    def _run_decode_step(self, now_fn, on_token=None) \
+            -> List[RequestState]:
+        toks, pos, temps, top_ks, top_ps, consumers = \
+            self.slots.step_arrays()
+        # pick the cheapest step variant the active rows allow (the host
+        # knows the sampling params exactly; see sample_slots)
+        sampling = [st.req for st in consumers if st.req.temperature > 0.0]
+        if not sampling:
+            mode = "greedy"
+        elif all(1 <= r.top_k <= SAMPLE_POOL for r in sampling):
+            mode = "bounded"
+        else:
+            mode = "full"
+        rng = jax.random.fold_in(self._base_rng, self._steps_dispatched)
+        self._steps_dispatched += 1
+        self.cache, out_tok, out_logp = self._step(
+            self.params, self.cache, jnp.asarray(toks), jnp.asarray(pos),
+            rng, jnp.asarray(temps), jnp.asarray(top_ks),
+            jnp.asarray(top_ps), mode)
+        out_tok = np.asarray(out_tok)            # host sync: stream point
+        out_logp = np.asarray(out_logp)
+        now = now_fn()
+        finished = []
+        for st in consumers:
+            t = int(out_tok[st.slot])
+            st.pos += 1                          # the step wrote at pos
+            st.next_input = t
+            st.generated.append(t)
+            st.logprobs.append(float(out_logp[st.slot]))
+            st.token_times.append(now)
+            if on_token is not None:
+                on_token(st.req, t)
+            if st.req.eos_id is not None and t == st.req.eos_id:
+                st.finish_reason = "eos"
+            elif len(st.generated) >= st.req.max_new_tokens:
+                st.finish_reason = "length"
+            if st.done:
+                finished.append(st)
+        return finished
+
+    def run(self, requests: Sequence[Request] = (),
+            on_token: Optional[Callable[[Request, int], None]] = None,
+            ) -> Dict[int, RequestResult]:
+        """Drive the engine until every submitted request completes.
+        `on_token(request, token)` streams tokens as they are fetched.
+        Returns {request.id: RequestResult}."""
+        for r in requests:
+            self.scheduler.submit(r)
+        t0 = time.perf_counter()
+        now_fn = lambda: time.perf_counter() - t0   # noqa: E731
+        results: Dict[int, RequestResult] = {}
+        while not self.scheduler.idle:
+            now = now_fn()
+            for st in self.scheduler.admit(self.slots.free, now):
+                self.slots.bind(st)
+            # nothing resident yet and the next arrival is in the
+            # future: sleep up to it instead of spinning
+            if self.slots.occupied == 0:
+                nxt = self.scheduler.next_arrival()
+                if nxt is not None and nxt > now_fn():
+                    time.sleep(min(nxt - now_fn(), 0.05))
+                continue
+            st = self.scheduler.next_prefill()
+            if st is not None:
+                self._run_prefill_chunk(st)
+            if self.scheduler.decoding():
+                for st in self._run_decode_step(now_fn, on_token):
+                    self.scheduler.retire(st)
+                    self.slots.release(st)
+                    results[st.req.id] = RequestResult(
+                        id=st.req.id, tokens=list(st.generated),
+                        logprobs=list(st.logprobs),
+                        finish_reason=st.finish_reason,
+                        ttft=st.token_times[0] - st.req.arrival,
+                        token_times=list(st.token_times))
+        return results
+
+
+__all__ = ["SAMPLE_POOL", "EngineConfig", "RequestResult",
+           "ServingEngine", "sample_slots"]

@@ -1,0 +1,172 @@
+"""Host-side request scheduling for the continuous-batching engine.
+
+Everything here is plain Python over plain numbers — no jax — so the
+policy (FCFS admission, chunk planning, retirement) is unit-testable
+without tracing anything, and the engine's device code stays a fixed
+set of compiled programs that this module merely feeds.
+
+The prefill trick worth knowing: a request's prompt of length P is
+prefilled as prompt[:P-1] only. The LAST prompt token becomes the first
+decode-step input (the "bonus token"), so the first NEW token comes out
+of the same compiled decode step as every later one — no separate
+"prefill tail + sample" program, and time-to-first-token is exactly one
+decode step after the last chunk lands.
+"""
+from __future__ import annotations
+
+import dataclasses
+from collections import deque
+from typing import List, Optional, Sequence, Tuple
+
+
+@dataclasses.dataclass
+class Request:
+    """One generation request. `arrival` is seconds relative to the
+    engine run's t0 (0.0 = already waiting when the run starts) — the
+    bench replays traces by submitting requests with future arrivals.
+    Sampling params mirror generate(): temperature 0 = greedy argmax
+    (top_k/top_p ignored), top_k 0 = disabled, top_p 1.0 = disabled."""
+    id: int
+    prompt: Sequence[int]
+    max_new_tokens: int
+    temperature: float = 0.0
+    top_k: int = 0
+    top_p: float = 1.0
+    eos_id: Optional[int] = None
+    arrival: float = 0.0
+
+
+@dataclasses.dataclass
+class RequestState:
+    """A request's life inside a slot. `pos` counts cache positions
+    WRITTEN so far — it is both the slot's decode cursor and the next
+    write offset. `chunks` are the pending prefill windows (start,
+    size); once drained, `next_input` (initially the bonus token) flows
+    through the shared decode step."""
+    req: Request
+    slot: int
+    pos: int = 0
+    chunks: List[Tuple[int, int]] = dataclasses.field(default_factory=list)
+    next_input: int = 0
+    generated: List[int] = dataclasses.field(default_factory=list)
+    logprobs: List[float] = dataclasses.field(default_factory=list)
+    token_times: List[float] = dataclasses.field(default_factory=list)
+    admitted_at: float = 0.0
+    finish_reason: Optional[str] = None   # "eos" | "length" once done
+
+    @property
+    def prefilling(self) -> bool:
+        return bool(self.chunks)
+
+    @property
+    def done(self) -> bool:
+        return self.finish_reason is not None
+
+
+def plan_chunks(n: int, buckets: Sequence[int]) -> List[Tuple[int, int]]:
+    """Windows (start, size) covering prompt positions [0, n), sizes
+    drawn from the ≤3 compiled `buckets` (ascending). Full largest-bucket
+    windows walk left→right; the ragged tail takes the smallest bucket
+    that fits, RIGHT-ALIGNED (start = n - size) so no window writes past
+    n — the overlap recomputes a suffix of already-written positions,
+    which writes back identical values (same params, tokens, positions)
+    instead of writing junk into the decode region. Only a prompt
+    shorter than every bucket pads (one window at 0; the engine
+    right-pads the tokens, and those pad writes land past the prompt
+    where the decode cursor overwrites them before they are ever
+    attended)."""
+    if n < 0:
+        raise ValueError(f"negative prefill length {n}")
+    out: List[Tuple[int, int]] = []
+    done = 0
+    big = buckets[-1]
+    while n - done >= big:
+        out.append((done, big))
+        done += big
+    if done < n:
+        size = next(b for b in buckets if b >= n - done)
+        out.append((max(0, n - size), size))
+    return out
+
+
+class Scheduler:
+    """FCFS arrival queue + admission. The engine asks it two questions
+    per loop: who newly fits into a free slot (`admit`), and which
+    admitted request should run its next prefill chunk
+    (`next_prefill`, oldest-admitted first so a burst of long prompts
+    drains in arrival order while decode steps interleave)."""
+
+    def __init__(self, chunk_buckets: Sequence[int], max_len: int):
+        buckets = tuple(chunk_buckets)
+        if not 1 <= len(buckets) <= 3:
+            raise ValueError(f"chunk_buckets must have 1-3 entries "
+                             f"(compiled prefill shapes), got {buckets}")
+        if list(buckets) != sorted(set(buckets)):
+            raise ValueError(f"chunk_buckets must be strictly ascending, "
+                             f"got {buckets}")
+        if buckets[-1] > max_len:
+            raise ValueError(f"largest chunk bucket {buckets[-1]} exceeds "
+                             f"max_len={max_len}")
+        self.chunk_buckets = buckets
+        self.max_len = max_len
+        self.queue: deque[Request] = deque()
+        self.active: List[RequestState] = []
+
+    def submit(self, req: Request) -> None:
+        p = len(req.prompt)
+        if p < 1:
+            raise ValueError(f"request {req.id}: empty prompt")
+        if req.max_new_tokens < 1:
+            raise ValueError(f"request {req.id}: max_new_tokens must be "
+                             f">= 1")
+        if p + req.max_new_tokens > self.max_len:
+            raise ValueError(
+                f"request {req.id}: prompt ({p}) + max_new_tokens "
+                f"({req.max_new_tokens}) exceeds max_len={self.max_len} "
+                f"(the per-slot KV budget)")
+        # keep the queue sorted by arrival (traces submit in order; the
+        # insort tolerates out-of-order submission)
+        if self.queue and req.arrival < self.queue[-1].arrival:
+            items = sorted([*self.queue, req], key=lambda r: r.arrival)
+            self.queue = deque(items)
+        else:
+            self.queue.append(req)
+
+    def next_arrival(self) -> Optional[float]:
+        return self.queue[0].arrival if self.queue else None
+
+    def admit(self, free_slots: List[int], now: float) \
+            -> List[RequestState]:
+        """Move arrived requests into free slots, FCFS. Returns the new
+        RequestStates (also tracked in self.active)."""
+        out = []
+        while free_slots and self.queue and self.queue[0].arrival <= now:
+            req = self.queue.popleft()
+            slot = free_slots.pop(0)
+            p1 = len(req.prompt) - 1          # bonus token excluded
+            st = RequestState(
+                req=req, slot=slot, pos=0,
+                chunks=plan_chunks(p1, self.chunk_buckets),
+                next_input=int(req.prompt[-1]), admitted_at=now)
+            self.active.append(st)
+            out.append(st)
+        return out
+
+    def next_prefill(self) -> Optional[RequestState]:
+        for st in self.active:            # admission order = FCFS
+            if st.prefilling:
+                return st
+        return None
+
+    def decoding(self) -> List[RequestState]:
+        return [st for st in self.active if not st.prefilling]
+
+    def retire(self, st: RequestState) -> None:
+        self.active.remove(st)
+
+    @property
+    def idle(self) -> bool:
+        return not self.queue and not self.active
+
+
+__all__ = ["Request", "RequestState", "Scheduler", "plan_chunks"]

@@ -1,0 +1,77 @@
+"""Slot bookkeeping for the fixed-shape serving cache.
+
+The device cache is [SLOTS, KV, L, D] per layer (transformer.py
+decode_slots mode) and NEVER changes shape: requests come and go by
+host-side bookkeeping only — a freed slot is just a row whose cursor
+resets, and the stale K/V it leaves behind is unreachable (every row
+attends only positions <= its own cursor, and a new occupant rewrites
+[0, len) before its cursor gets there). That is the whole trick that
+makes admission/retirement free of recompiles.
+
+This module owns which row belongs to which request and builds the
+per-step cursor/token/sampling arrays the compiled decode step consumes.
+"""
+from __future__ import annotations
+
+from typing import List, Optional
+
+import numpy as np
+
+from .scheduler import RequestState
+
+
+class SlotManager:
+    """Fixed pool of `n` slots. Rows are handed out lowest-first (keeps
+    small active sets contiguous — friendlier to batch-sharded caches)
+    and returned on retirement."""
+
+    def __init__(self, n: int):
+        if n < 1:
+            raise ValueError(f"need at least one slot, got {n}")
+        self.n = n
+        self.free: List[int] = list(range(n))
+        self.states: List[Optional[RequestState]] = [None] * n
+
+    def bind(self, st: RequestState) -> None:
+        if self.states[st.slot] is not None:
+            raise RuntimeError(f"slot {st.slot} is already occupied")
+        self.states[st.slot] = st
+
+    def release(self, st: RequestState) -> None:
+        self.states[st.slot] = None
+        self.free.append(st.slot)
+        self.free.sort()
+
+    @property
+    def occupied(self) -> int:
+        return self.n - len(self.free)
+
+    def step_arrays(self):
+        """The decode step's host-built inputs: tokens, cursors, and
+        per-slot sampling params, plus which states actually consume
+        this step's samples. Slots mid-prefill or free still get a row
+        (the step is fixed-shape): their position is their own next
+        write offset, so the one junk K/V they write lands exactly
+        where the next real write (chunk or cursor) overwrites it, and
+        their sampled token is simply discarded."""
+        toks = np.zeros((self.n,), np.int32)
+        pos = np.zeros((self.n,), np.int32)
+        temps = np.zeros((self.n,), np.float32)
+        top_ks = np.zeros((self.n,), np.int32)
+        top_ps = np.ones((self.n,), np.float32)
+        consumers: List[RequestState] = []
+        for st in self.states:
+            if st is None:
+                continue
+            pos[st.slot] = st.pos
+            if st.prefilling:
+                continue
+            toks[st.slot] = st.next_input
+            temps[st.slot] = st.req.temperature
+            top_ks[st.slot] = st.req.top_k
+            top_ps[st.slot] = st.req.top_p
+            consumers.append(st)
+        return toks, pos, temps, top_ks, top_ps, consumers
+
+
+__all__ = ["SlotManager"]

@@ -47,3 +47,7 @@ def pytest_configure(config):
         "satisfied here by the 8 virtual CPU devices, but deselect with "
         "-m 'not multichip' on a single real chip without the virtual "
         "mesh")
+    config.addinivalue_line(
+        "markers",
+        "serving: continuous-batching engine tests (serve/); select with "
+        "-m serving to gate the serving surface alone")

@@ -91,6 +91,57 @@ def test_decode_kernel_cursor_positions(cur):
     np.testing.assert_allclose(np.asarray(ref), np.asarray(out), atol=2e-5)
 
 
+@pytest.mark.parametrize("quantized", [False, True])
+def test_decode_kernel_per_row_cursors(quantized):
+    """[B] cursor vector (the serving engine's slot mode): each row reads
+    exactly its own filled prefix — per-row poison past each cursor makes
+    any cross-row or beyond-cursor read loud."""
+    B, H, KV, L, D = 4, 4, 2, 64, 16
+    curs = np.array([0, 17, 31, 63], np.int32)
+    keys = jax.random.split(jax.random.PRNGKey(3), 3)
+    q = jax.random.normal(keys[0], (B, H, D), jnp.float32)
+    k = jax.random.normal(keys[1], (B, KV, L, D), jnp.float32)
+    v = jax.random.normal(keys[2], (B, KV, L, D), jnp.float32)
+    dead = jnp.arange(L)[None, None, :, None] > curs[:, None, None, None]
+    ks = vs = None
+    if quantized:
+        ks = jnp.maximum(jnp.max(jnp.abs(k), -1) / 127.0, 1e-8)
+        vs = jnp.maximum(jnp.max(jnp.abs(v), -1) / 127.0, 1e-8)
+        k = jnp.clip(jnp.round(k / ks[..., None]), -127, 127)
+        v = jnp.clip(jnp.round(v / vs[..., None]), -127, 127)
+        k = jnp.where(dead, 127, k).astype(jnp.int8)
+        v = jnp.where(dead, 127, v).astype(jnp.int8)
+        dead3 = dead[..., 0]
+        ks = jnp.where(dead3, POISON, ks)
+        vs = jnp.where(dead3, POISON, vs)
+    else:
+        k = jnp.where(dead, POISON, k)
+        v = jnp.where(dead, POISON, v)
+    ref = jnp.concatenate([
+        _dense_ref(q[b:b + 1], k[b:b + 1], v[b:b + 1], int(curs[b]),
+                   None if ks is None else ks[b:b + 1],
+                   None if vs is None else vs[b:b + 1])
+        for b in range(B)])
+    out = decode_attention(q, k, v, jnp.asarray(curs), k_scale=ks,
+                           v_scale=vs, block_k=16, interpret=True)
+    np.testing.assert_allclose(np.asarray(ref), np.asarray(out), atol=2e-5)
+
+
+def test_decode_kernel_vector_cursor_matches_broadcast_scalar():
+    """A uniform [B] cursor vector must agree exactly with the scalar
+    cursor path (same program semantics, different operand rank), and a
+    wrong-shaped cursor is rejected."""
+    B, H, KV, L, D, cur = 2, 4, 2, 64, 16, 29
+    q, k, v, _, _ = _cache(B, H, KV, L, D, cur, seed=9)
+    scalar = decode_attention(q, k, v, cur, block_k=16, interpret=True)
+    vector = decode_attention(q, k, v, jnp.full((B,), cur, jnp.int32),
+                              block_k=16, interpret=True)
+    np.testing.assert_array_equal(np.asarray(scalar), np.asarray(vector))
+    with pytest.raises(ValueError, match="cache_index"):
+        decode_attention(q, k, v, jnp.zeros((B + 1,), jnp.int32),
+                         block_k=16, interpret=True)
+
+
 def test_decode_kernel_rejects_bad_shapes():
     q, k, v, _, _ = _cache(1, 4, 2, 64, 16, 10)
     with pytest.raises(ValueError, match="multiple of KV"):

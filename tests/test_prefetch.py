@@ -1,0 +1,99 @@
+"""PrefetchDataset double-buffering contract (data/prefetch.py): FIFO
+ordering through the feeder thread, bounded read-ahead depth, clean
+StopIteration on producer exhaustion (sticky — no deadlock on the next
+next()), and feeder errors surfacing in the consumer."""
+import threading
+import time
+
+import pytest
+
+from mpi_operator_tpu.data.prefetch import PrefetchDataset
+
+
+class _ListDataset(PrefetchDataset):
+    """Finite producer over `items`; optionally gates each yield on an
+    event so tests can control how far ahead the feeder runs."""
+
+    def __init__(self, items, prefetch=2, gate=None, fail_at=None):
+        self.items = list(items)
+        self.gate = gate
+        self.fail_at = fail_at
+        self.produced = 0
+        self._start_feeder(prefetch)
+
+    def _produce(self):
+        for it in self.items:
+            if self.gate is not None:
+                self.gate.wait()
+            if self.fail_at is not None and self.produced == self.fail_at:
+                raise ValueError("injected producer failure")
+            self.produced += 1
+            yield it
+
+
+def test_prefetch_preserves_order():
+    ds = _ListDataset(range(50), prefetch=3)
+    try:
+        assert list(ds) == list(range(50))
+    finally:
+        ds.close()
+
+
+def test_prefetch_depth_is_bounded():
+    # an unconsumed iterator may run at most `prefetch` items ahead into
+    # the queue plus one more blocked in put() — never the whole stream
+    ds = _ListDataset(range(100), prefetch=2)
+    try:
+        deadline = time.monotonic() + 5.0
+        while ds.produced < 3 and time.monotonic() < deadline:
+            time.sleep(0.01)
+        time.sleep(0.2)                      # would overrun here if unbounded
+        assert ds.produced <= 3              # prefetch + 1 in-flight
+        assert next(ds) == 0                 # consuming frees one slot
+        deadline = time.monotonic() + 5.0
+        while ds.produced < 4 and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert 4 <= ds.produced <= 4
+    finally:
+        ds.close()
+
+
+def test_prefetch_exhaustion_raises_stopiteration_repeatably():
+    ds = _ListDataset([1, 2], prefetch=2)
+    try:
+        assert next(ds) == 1
+        assert next(ds) == 2
+        with pytest.raises(StopIteration):
+            next(ds)
+        # sticky: a second next() must raise again, not block forever
+        with pytest.raises(StopIteration):
+            next(ds)
+        # and a plain for-loop over a fresh instance terminates
+        ds2 = _ListDataset("ab", prefetch=1)
+        assert list(ds2) == ["a", "b"]
+        ds2.close()
+    finally:
+        ds.close()
+
+
+def test_prefetch_feeder_error_surfaces_in_consumer():
+    ds = _ListDataset(range(10), prefetch=2, fail_at=1)
+    try:
+        assert next(ds) == 0
+        with pytest.raises(RuntimeError, match="feeder thread failed"):
+            # drain until the wrapped producer exception arrives
+            for _ in range(10):
+                next(ds)
+    finally:
+        ds.close()
+
+
+def test_prefetch_close_unblocks_feeder():
+    gate = threading.Event()
+    gate.set()
+    ds = _ListDataset(range(10_000), prefetch=1, gate=gate)
+    try:
+        assert next(ds) == 0
+    finally:
+        ds.close()
+    assert not ds._thread.is_alive()
