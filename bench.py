@@ -319,6 +319,15 @@ def main() -> None:
         if metrics.get("tflops_per_sec_per_device") is not None:
             out["tflops_per_sec_per_device"] = round(
                 metrics["tflops_per_sec_per_device"], 2)
+        # step-time tail from the telemetry histograms (trainers return
+        # these since the telemetry PR) — every ladder leg carries its
+        # p50/p99 so a throughput regression can be told apart from a
+        # tail-latency one without rerunning
+        for k in ("step_time_p50_ms", "step_time_p99_ms"):
+            if metrics.get(k) is not None:
+                out[k] = round(metrics[k], 3)
+        if metrics.get("goodput") is not None:
+            out["goodput"] = round(metrics["goodput"], 4)
         return out
 
     if args.workload in ("gpt2", "bert", "llama", "moe"):

@@ -287,14 +287,18 @@ def _initialize_distributed(info: ProcessInfo,
                             env: Mapping[str, str],
                             log=print,
                             init_fn=None,
-                            sleep=None) -> None:
+                            sleep=None,
+                            events=None) -> None:
     """jax.distributed.initialize with bounded exponential backoff.
     TPU_INIT_RETRIES attempts (default 5), TPU_INIT_BACKOFF base delay
     doubling per attempt (default 1s, capped at 30s). A non-retryable
     failure (see _retryable_init_error) raises immediately; exhausting
     the budget raises BootstrapError. `init_fn`/`sleep` are injectable
     for tests. Honors the delay-coordinator fault (TPU_FAULT_INJECT) so
-    the retry machinery itself is testable end-to-end."""
+    the retry machinery itself is testable end-to-end. `events` (a
+    telemetry EventLog) records one init_retry record per failed
+    attempt — each is fsync'd before the backoff sleep, so the log shows
+    a flapping coordinator even when a later attempt succeeds."""
     import time as _time
 
     if init_fn is None:
@@ -334,6 +338,12 @@ def _initialize_distributed(info: ProcessInfo,
             log(f"jax.distributed.initialize attempt "
                 f"{attempt + 1}/{attempts} failed ({exc}); retrying in "
                 f"{delay:.1f}s")
+            if events is not None:
+                from ..telemetry import events as ev
+                events.emit(ev.INIT_RETRY, attempt=attempt + 1,
+                            attempts=attempts, error=str(exc),
+                            backoff_seconds=delay,
+                            process_id=info.process_id)
             sleep(delay)
     raise BootstrapError(
         f"jax.distributed.initialize failed after {attempts} attempt(s): "
@@ -341,8 +351,14 @@ def _initialize_distributed(info: ProcessInfo,
 
 
 def initialize(env: Optional[Mapping[str, str]] = None,
-               hostname: Optional[str] = None) -> ProcessInfo:
+               hostname: Optional[str] = None,
+               events=None) -> ProcessInfo:
     """Resolve + `jax.distributed.initialize`.
+
+    `events` (an optional telemetry EventLog) captures init_retry records
+    from the distributed-init backoff loop — open it BEFORE calling so
+    gang-start flapping is durable even if the process never gets past
+    bootstrap.
 
     The LAUNCHER never joins the process group: it has no TPUs and rank 0
     lives on worker-0 (whose hostname the coordinator address points at).
@@ -357,7 +373,7 @@ def initialize(env: Optional[Mapping[str, str]] = None,
     info = process_info(env, hostname)
     resolved_env = dict(os.environ if env is None else env)
     if not info.is_launcher and info.num_processes > 1:
-        _initialize_distributed(info, resolved_env)
+        _initialize_distributed(info, resolved_env, events=events)
     elif not info.is_launcher:
         # a launch wrapper may have set cpu-collectives=gloo before the
         # gang size was known; with no distributed client this jaxlib

@@ -428,6 +428,7 @@ class TPUJobController:
         key = self.queue.get(timeout=timeout)
         if key is None:
             return False
+        t0 = time.monotonic()
         try:
             self.sync_handler(key)
             self.queue.forget(key)          # ref :399-404
@@ -435,8 +436,12 @@ class TPUJobController:
         except Exception:                   # noqa: BLE001
             logger.exception("error syncing %s; requeuing", key)
             self.queue.add_rate_limited(key)
+            self.sync_counters.record_retry()
             self.sync_counters.record(ok=False)
         finally:
+            # failure durations observed too: the slow FAILING sync is the
+            # one an operator most needs the histogram to show
+            self.sync_counters.observe_sync(time.monotonic() - t0)
             self.queue.done(key)
         return True
 

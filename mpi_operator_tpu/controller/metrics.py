@@ -9,6 +9,11 @@ as a liveness probe.
 Exported series (all prefixed ``tpu_operator_``):
   syncs_total            counter — sync_handler completions
   sync_errors_total      counter — sync_handler raises (requeued with backoff)
+  sync_duration_seconds  histogram — sync_handler wall time (success and
+                                   failure alike; a slow failing sync is
+                                   the one you most want to see)
+  workqueue_retries_total counter — keys re-enqueued through the rate
+                                   limiter (add_rate_limited calls)
   workqueue_depth        gauge   — keys queued + rate-limit-delayed
   jobs{phase=...}        gauge   — TPUJobs by condition-derived phase,
                                    computed from the informer cache at
@@ -17,6 +22,10 @@ Exported series (all prefixed ``tpu_operator_``):
   job_restarts           gauge   — sum of status.restart_count over
                                    currently-cached jobs (drops when a job
                                    is deleted — hence gauge, no _total)
+
+The histogram machinery and text-format helpers come from the worker-side
+telemetry package (telemetry/) — one implementation of buckets, label
+escaping, and cumulative-bucket rendering for both planes.
 
 /healthz returns 200 while every worker thread is alive, 503 otherwise —
 wire it to the Deployment's livenessProbe so a wedged reconciler gets
@@ -29,6 +38,8 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 
 from ..api import types as api
+from ..telemetry.core import Histogram
+from ..telemetry.prometheus import escape_label_value, histogram_lines
 
 #: phase precedence: terminal beats transitional beats initial
 _PHASES = (api.COND_SUCCEEDED, api.COND_FAILED, api.COND_RESTARTING,
@@ -36,18 +47,33 @@ _PHASES = (api.COND_SUCCEEDED, api.COND_FAILED, api.COND_RESTARTING,
 
 
 class SyncCounters:
-    """Thread-safe sync outcome counters (incremented by the run loop)."""
+    """Thread-safe sync outcome counters + the sync-duration histogram
+    (all fed by the run loop's process_next_work_item)."""
 
     def __init__(self):
         self._lock = threading.Lock()
         self.syncs_total = 0
         self.sync_errors_total = 0
+        self.workqueue_retries_total = 0
+        # syncs are API-server round trips: µs buckets are dead weight,
+        # but a wedged informer can stretch one past a minute
+        self.sync_duration = Histogram(
+            "tpu_operator_sync_duration_seconds",
+            "sync_handler wall time (success and failure)",
+            lo=1e-4, hi=1e2)
 
     def record(self, ok: bool) -> None:
         with self._lock:
             self.syncs_total += 1
             if not ok:
                 self.sync_errors_total += 1
+
+    def record_retry(self) -> None:
+        with self._lock:
+            self.workqueue_retries_total += 1
+
+    def observe_sync(self, seconds: float) -> None:
+        self.sync_duration.observe(seconds)
 
     def snapshot(self):
         with self._lock:
@@ -82,6 +108,14 @@ def render_metrics(controller) -> str:
         "# HELP tpu_operator_sync_errors_total sync_handler errors (requeued)",
         "# TYPE tpu_operator_sync_errors_total counter",
         f"tpu_operator_sync_errors_total {errors}",
+        "# HELP tpu_operator_workqueue_retries_total keys re-enqueued "
+        "through the rate limiter",
+        "# TYPE tpu_operator_workqueue_retries_total counter",
+        f"tpu_operator_workqueue_retries_total "
+        f"{controller.sync_counters.workqueue_retries_total}",
+    ]
+    lines += histogram_lines(controller.sync_counters.sync_duration)
+    lines += [
         "# HELP tpu_operator_workqueue_depth queued + rate-limit-delayed keys",
         "# TYPE tpu_operator_workqueue_depth gauge",
         f"tpu_operator_workqueue_depth {len(controller.queue)}",
@@ -89,9 +123,11 @@ def render_metrics(controller) -> str:
         "# TYPE tpu_operator_jobs gauge",
     ]
     # every phase is emitted, zero included — a vanishing series reads as
-    # "no data" in Prometheus, not as 0
+    # "no data" in Prometheus, not as 0. Phases are fixed strings today,
+    # but escape anyway: a condition type with a quote in it must corrupt
+    # one label, not the whole scrape.
     for phase in (*_PHASES, "Pending"):
-        lines.append(f'tpu_operator_jobs{{phase="{phase}"}} '
+        lines.append(f'tpu_operator_jobs{{phase="{escape_label_value(phase)}"}} '
                      f"{by_phase.get(phase, 0)}")
     lines += [
         # gauge over currently-cached jobs (drops when a job is deleted),

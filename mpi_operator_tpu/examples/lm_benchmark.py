@@ -31,6 +31,27 @@ import numpy as np
 MLM_MASK_RATE = 0.15
 
 
+def _worker_telemetry(metrics_port, event_log, train_dir, events, log):
+    """The run's WorkerTelemetry: a /metrics server when --metrics-port
+    is given (0 = ephemeral, for tests), an event log at --event-log or
+    defaulting to <train_dir>/events.jsonl when a train dir exists (so
+    resilience runs record their drains with zero extra flags). `events`
+    borrows an already-open log — ownership stays with the caller.
+    Returns (telemetry, owns_events)."""
+    from ..telemetry import EventLog, WorkerTelemetry
+
+    owns = events is None
+    if events is None:
+        path = event_log or (os.path.join(train_dir, "events.jsonl")
+                             if train_dir else None)
+        events = EventLog(path) if path else None
+    wtel = WorkerTelemetry(events=events)
+    if metrics_port is not None:
+        log(f"worker /metrics listening on port "
+            f"{wtel.serve(port=metrics_port).port}")
+    return wtel, owns and events is not None
+
+
 def run_lm_benchmark(
     workload: str = "gpt2",
     size: Optional[str] = None,
@@ -71,6 +92,9 @@ def run_lm_benchmark(
     lr: Optional[float] = None,
     lr_warmup_steps: Optional[int] = None,
     profile_dir: Optional[str] = None,
+    metrics_port: Optional[int] = None,
+    event_log: Optional[str] = None,
+    events=None,
     log: Callable[[str], None] = print,
 ) -> Tuple[object, Dict[str, float]]:
     """GPT-2 / llama / BERT token-stream benchmark on a dcn×dp×fsdp×tp
@@ -172,6 +196,8 @@ def run_lm_benchmark(
                            accum_steps=accum_steps,
                            lr_schedule=lr_schedule, decay_steps=decay_steps,
                            **opt_overrides)
+    wtel, owns_events = _worker_telemetry(metrics_port, event_log,
+                                          train_dir, events, log)
     if pp > 1:
         # GPipe over the pp axis: stage-sliced CausalLM — or MaskedLM
         # (bert): the mask stream rides the relays and the last stage
@@ -216,7 +242,7 @@ def run_lm_benchmark(
                                       divergence_k=divergence_k,
                                       step_deadline=step_deadline,
                                       stop_check_every=stop_check_every),
-            log=log)
+            log=log, events=wtel.events, telemetry=wtel.train)
         pp_resilience.__enter__()
         # checkpoints live in CANONICAL layer order (schedule-agnostic);
         # the live state may be 1F1B-interleaved — convert around resume
@@ -304,7 +330,8 @@ def run_lm_benchmark(
             pp_state, pp_metrics = pp_trainer.benchmark(
                 pp_state, pp_stream, num_steps=num_steps,
                 warmup_steps=warmup_steps, log=log,
-                step_hook=canonical_hook, resilience=pp_resilience)
+                step_hook=canonical_hook, resilience=pp_resilience,
+                telemetry=wtel.train)
             if eval_steps:
                 # held-out evaluation continues the stream past the
                 # trained batches (same contract as the unpiped path)
@@ -317,6 +344,7 @@ def run_lm_benchmark(
         finally:
             pp_stream.close()
             pp_resilience.__exit__(None, None, None)
+            wtel.close(close_events=owns_events)
         maybe_save(train_dir, pp_trainer.canonical_state(pp_state), log)
         return pp_state, pp_metrics
     trainer = LMTrainer(model, mesh, tcfg)
@@ -328,7 +356,7 @@ def run_lm_benchmark(
                                   divergence_k=divergence_k,
                                   step_deadline=step_deadline,
                                   stop_check_every=stop_check_every),
-        log=log)
+        log=log, events=wtel.events, telemetry=wtel.train)
     # entering fires the corrupt-latest-checkpoint fault (if injected)
     # BEFORE the resume below, so the fallback path is what gets tested
     resilience.__enter__()
@@ -414,7 +442,7 @@ def run_lm_benchmark(
                 profile_dir=profile_dir,
                 step_hook=periodic_saver(train_dir, ckpt_every, log,
                                          keep_last=ckpt_keep),
-                resilience=resilience)
+                resilience=resilience, telemetry=wtel.train)
             if eval_steps:
                 # evaluation continues the stream past the trained
                 # batches — fresh batches for synthetic/large-shard runs;
@@ -431,6 +459,7 @@ def run_lm_benchmark(
         maybe_save(train_dir, state, log)
     finally:
         resilience.__exit__(None, None, None)
+        wtel.close(close_events=owns_events)
     if moe_experts:
         # observable drop rate (parallel/moe.py sows it into the
         # "diagnostics" collection, which train steps don't carry): one
@@ -582,6 +611,9 @@ def run_vit_benchmark(
     step_deadline: float = 0.0,
     divergence_k: int = 3,
     stop_check_every: Optional[int] = None,
+    metrics_port: Optional[int] = None,
+    event_log: Optional[str] = None,
+    events=None,
     log: Callable[[str], None] = print,
 ) -> Tuple[object, Dict[str, float]]:
     """ViT-B/16 image benchmark; --num-slices 2 is the BASELINE multi-slice
@@ -607,12 +639,14 @@ def run_vit_benchmark(
     trainer = Trainer(model, mesh, cfg)
     state = trainer.init_state(jax.random.PRNGKey(0))
     from ..train.checkpoint import maybe_resume, maybe_save
+    wtel, owns_events = _worker_telemetry(metrics_port, event_log,
+                                          train_dir, events, log)
     resilience = ResilienceContext(
         ResilienceConfig.from_env(train_dir=train_dir,
                                   divergence_k=divergence_k,
                                   step_deadline=step_deadline,
                                   stop_check_every=stop_check_every),
-        log=log)
+        log=log, events=wtel.events, telemetry=wtel.train)
     resilience.__enter__()
     try:
         state = maybe_resume(train_dir, state, log)
@@ -632,13 +666,14 @@ def run_vit_benchmark(
                 warmup_steps=warmup_steps, log=log,
                 step_hook=periodic_saver(train_dir, ckpt_every, log,
                                          keep_last=ckpt_keep),
-                resilience=resilience)
+                resilience=resilience, telemetry=wtel.train)
         finally:
             if hasattr(dataset, "close"):
                 dataset.close()
         maybe_save(train_dir, state, log)
     finally:
         resilience.__exit__(None, None, None)
+        wtel.close(close_events=owns_events)
     return state, metrics
 
 
@@ -763,13 +798,33 @@ def main(argv=None) -> int:
     parser.add_argument("--profile-dir", default=None,
                         help="write a jax.profiler trace of the first "
                              "measurement window here (XProf format)")
+    parser.add_argument("--metrics-port", type=int, default=None,
+                        help="serve worker /metrics (Prometheus text) + "
+                             "/healthz on this port (0 = pick a free "
+                             "port; omit to disable)")
+    parser.add_argument("--event-log", default=None,
+                        help="fsync'd JSONL event log path (preemption "
+                             "drain, emergency checkpoint, rollback, init "
+                             "retry); defaults to <train-dir>/events.jsonl "
+                             "when --train-dir is set")
     args = parser.parse_args(argv)
 
     from ..bootstrap import initialize
     from ..bootstrap.bootstrap import StatusServer, launcher_wait
+    from ..telemetry import EventLog
 
-    info = initialize()
+    # the event log opens BEFORE distributed init so bootstrap's retry
+    # loop can record init_retry events (the earliest failure mode there
+    # is); the benchmark borrows this instance rather than reopening
+    ev_path = args.event_log or (
+        os.path.join(args.train_dir, "events.jsonl")
+        if args.train_dir else None)
+    events = EventLog(ev_path) if ev_path else None
+
+    info = initialize(events=events)
     if info.is_launcher:
+        if events is not None:
+            events.close()
         return launcher_wait(info)
 
     from ..train.resilience import Preempted
@@ -790,6 +845,7 @@ def main(argv=None) -> int:
                 step_deadline=args.step_deadline,
                 divergence_k=args.divergence_k,
                 stop_check_every=args.stop_check_every,
+                metrics_port=args.metrics_port, events=events,
                 log=log)
             headline = {"metric": "vit_images_per_sec",
                         "value": round(metrics["images_per_sec"], 2),
@@ -827,7 +883,9 @@ def main(argv=None) -> int:
                 decay_steps=args.decay_steps,
                 lr=args.lr,
                 lr_warmup_steps=args.lr_warmup_steps,
-                profile_dir=args.profile_dir, log=log)
+                profile_dir=args.profile_dir,
+                metrics_port=args.metrics_port, events=events,
+                log=log)
             headline = {"metric": f"{args.workload}_tokens_per_sec",
                         "value": round(metrics["tokens_per_sec"], 0),
                         "unit": "tokens/sec"}
@@ -844,6 +902,12 @@ def main(argv=None) -> int:
         exit_code = p.exit_code
         return exit_code
     finally:
+        # event log closes (flush + fsync) BEFORE the status channel so a
+        # preemption exit never reports done with its drain record still
+        # buffered — the shutdown-ordering contract the resilience smoke
+        # greps for
+        if events is not None:
+            events.close()
         if status is not None:
             status.set_done(exit_code)
             status.close()

@@ -45,14 +45,26 @@ def run_serving_benchmark(
     decode_kernel: Optional[bool] = None,
     baseline: bool = True,
     seed: int = 0,
+    profile_dir: Optional[str] = None,
+    metrics_port: Optional[int] = None,
     log: Callable[[str], None] = print,
 ) -> Dict[str, object]:
     """Returns a flat dict of serving metrics (see module docstring).
     `temperature` > 0 makes every other request sample at that
     temperature with top_k=40 (the rest stay greedy) — per-request
     sampling params exercising ONE compiled step; the sequential
-    baseline runs each request at its own matching params."""
+    baseline runs each request at its own matching params.
+
+    `profile_dir` captures an XProf trace of the MEASURED trace only
+    (warmup excluded, trace serialization after the closing timestamp —
+    same discipline as the train benchmarks' WindowProfiler).
+    `metrics_port` starts a worker /metrics endpoint over the engine's
+    live telemetry (0 = any free port) so the TTFT/TPOT/occupancy series
+    are scrapeable while the trace replays."""
     import time
+
+    from ..telemetry import WorkerTelemetry
+    from ..utils.profiling import WindowProfiler
 
     import jax
     import jax.numpy as jnp
@@ -97,9 +109,14 @@ def run_serving_benchmark(
                           int(rs.choice(new_grid)))
              for i in range(num_requests)]
 
+    wtel = WorkerTelemetry()
     engine = ServingEngine(model, params, EngineConfig(
         slots=slots, chunk_buckets=tuple(chunk_buckets),
-        decode_kernel=decode_kernel, rng_seed=seed))
+        decode_kernel=decode_kernel, rng_seed=seed),
+        telemetry=wtel.serving)
+    if metrics_port is not None:
+        log(f"worker /metrics listening on port "
+            f"{wtel.serve(port=metrics_port).port}")
 
     # warmup: one request per distinct prompt length (covers every
     # prefill bucket the trace can hit) + the step program; then reset —
@@ -109,9 +126,17 @@ def run_serving_benchmark(
     engine.run(warm)
     engine.reset()
 
-    t0 = time.perf_counter()
-    results = engine.run(trace)
-    wall = time.perf_counter() - t0
+    profiler = WindowProfiler(profile_dir, log)
+    profiler.start()
+    try:
+        t0 = time.perf_counter()
+        results = engine.run(trace)
+        wall = time.perf_counter() - t0
+    finally:
+        # stop AFTER the closing timestamp: xplane serialization is real
+        # I/O and must never be charged to serving throughput
+        profiler.stop_if_active()
+        wtel.close()
     total_new = sum(len(r.tokens) for r in results.values())
     tps = total_new / wall
     ttft = _percentiles([r.ttft for r in results.values()])
@@ -201,12 +226,19 @@ def main(argv=None) -> int:
                         choices=[None, "int8"])
     parser.add_argument("--no-baseline", action="store_true")
     parser.add_argument("--seed", type=int, default=0)
+    parser.add_argument("--profile-dir", default=None,
+                        help="write an XProf trace of the measured trace "
+                             "(warmup excluded) under this directory")
+    parser.add_argument("--metrics-port", type=int, default=None,
+                        help="serve live engine telemetry at "
+                             "/metrics on this port (0 = any free port)")
     args = parser.parse_args(argv)
     metrics = run_serving_benchmark(
         size=args.size, family=args.family, slots=args.slots,
         num_requests=args.num_requests, dtype_name=args.dtype,
         temperature=args.temperature, kv_cache_dtype=args.kv_cache_dtype,
-        baseline=not args.no_baseline, seed=args.seed)
+        baseline=not args.no_baseline, seed=args.seed,
+        profile_dir=args.profile_dir, metrics_port=args.metrics_port)
     print(json.dumps({"metric": "serving_tokens_per_sec",
                       "value": metrics["serving_tokens_per_sec"],
                       "unit": "tokens/sec", **metrics}))

@@ -37,6 +37,8 @@ import numpy as np
 from jax import lax
 
 from ..models.generate import cast_params, decode_model
+from ..telemetry import span
+from ..telemetry import events as ev
 from .scheduler import Request, RequestState, Scheduler
 from .slots import SlotManager
 
@@ -147,7 +149,13 @@ class ServingEngine:
     per-request results. Submit-with-future-`arrival` replays a trace.
     """
 
-    def __init__(self, model, params, config: Optional[EngineConfig] = None):
+    def __init__(self, model, params, config: Optional[EngineConfig] = None,
+                 telemetry=None, events=None):
+        """telemetry: a telemetry.ServeTelemetry — live TTFT/TPOT/step
+        histograms and queue/occupancy gauges (today these exist only as
+        a post-hoc trace reduction in serve_benchmark); events: a
+        telemetry.EventLog receiving slot_admit/slot_retire records.
+        Both optional and None-cost when absent."""
         cfg = config or EngineConfig()
         mcfg = model.config
         if not mcfg.causal:
@@ -161,6 +169,10 @@ class ServingEngine:
         self.dmodel = decode_model(model, cfg.decode_kernel, slots=True)
         self._base_rng = jax.random.PRNGKey(cfg.rng_seed)
         self._steps_dispatched = 0
+        self.telemetry = telemetry
+        self.events = events
+        if telemetry is not None:
+            telemetry.slots.set(cfg.slots)
 
         dmodel = self.dmodel
         dt = dmodel.config.dtype
@@ -255,9 +267,15 @@ class ServingEngine:
         p1 = len(st.req.prompt) - 1
         window = list(st.req.prompt[w:min(w + size, p1)])
         window += [0] * (size - len(window))     # right-pad short prompts
-        self.cache = self._prefill(
-            self.params, self.cache, jnp.int32(st.slot),
-            jnp.asarray(window, jnp.int32), jnp.int32(w))
+        t0 = time.perf_counter()
+        with span("serve.prefill"):
+            self.cache = self._prefill(
+                self.params, self.cache, jnp.int32(st.slot),
+                jnp.asarray(window, jnp.int32), jnp.int32(w))
+        if self.telemetry is not None:
+            # async dispatch: host wall time, not device time — the next
+            # decode step's sync absorbs any queued prefill work
+            self.telemetry.prefill_seconds.observe(time.perf_counter() - t0)
         st.pos = min(p1, w + size)
 
     def _run_decode_step(self, now_fn, on_token=None) \
@@ -275,16 +293,29 @@ class ServingEngine:
             mode = "full"
         rng = jax.random.fold_in(self._base_rng, self._steps_dispatched)
         self._steps_dispatched += 1
-        self.cache, out_tok, out_logp = self._step(
-            self.params, self.cache, jnp.asarray(toks), jnp.asarray(pos),
-            rng, jnp.asarray(temps), jnp.asarray(top_ks),
-            jnp.asarray(top_ps), mode)
-        out_tok = np.asarray(out_tok)            # host sync: stream point
-        out_logp = np.asarray(out_logp)
+        step_t0 = time.perf_counter()
+        with span("serve.decode_step"):
+            self.cache, out_tok, out_logp = self._step(
+                self.params, self.cache, jnp.asarray(toks), jnp.asarray(pos),
+                rng, jnp.asarray(temps), jnp.asarray(top_ks),
+                jnp.asarray(top_ps), mode)
+            out_tok = np.asarray(out_tok)        # host sync: stream point
+            out_logp = np.asarray(out_logp)
+        tel = self.telemetry
+        if tel is not None:
+            # the np.asarray host read above IS the device barrier, so
+            # this wall time is a true decode step time
+            tel.decode_step_seconds.observe(time.perf_counter() - step_t0)
         now = now_fn()
         finished = []
         for st in consumers:
             t = int(out_tok[st.slot])
+            if tel is not None:
+                if st.token_times:
+                    tel.tpot_seconds.observe(now - st.token_times[-1])
+                else:
+                    tel.ttft_seconds.observe(now - st.req.arrival)
+                tel.tokens_total.inc()
             st.pos += 1                          # the step wrote at pos
             st.next_input = t
             st.generated.append(t)
@@ -311,10 +342,19 @@ class ServingEngine:
         t0 = time.perf_counter()
         now_fn = lambda: time.perf_counter() - t0   # noqa: E731
         results: Dict[int, RequestResult] = {}
+        tel = self.telemetry
         while not self.scheduler.idle:
             now = now_fn()
-            for st in self.scheduler.admit(self.slots.free, now):
-                self.slots.bind(st)
+            with span("serve.schedule"):
+                for st in self.scheduler.admit(self.slots.free, now):
+                    self.slots.bind(st)
+                    if self.events is not None:
+                        self.events.emit(ev.SLOT_ADMIT, request=st.req.id,
+                                         slot=st.slot,
+                                         prompt_len=len(st.req.prompt))
+            if tel is not None:
+                tel.queue_depth.set(len(self.scheduler.queue))
+                tel.slot_occupancy.set(self.slots.occupied)
             # nothing resident yet and the next arrival is in the
             # future: sleep up to it instead of spinning
             if self.slots.occupied == 0:
@@ -329,12 +369,25 @@ class ServingEngine:
                 for st in self._run_decode_step(now_fn, on_token):
                     self.scheduler.retire(st)
                     self.slots.release(st)
+                    if self.events is not None:
+                        self.events.emit(
+                            ev.SLOT_RETIRE, request=st.req.id, slot=st.slot,
+                            finish_reason=st.finish_reason,
+                            new_tokens=len(st.generated))
+                    if tel is not None:
+                        tel.requests_total.inc()
                     results[st.req.id] = RequestResult(
                         id=st.req.id, tokens=list(st.generated),
                         logprobs=list(st.logprobs),
                         finish_reason=st.finish_reason,
                         ttft=st.token_times[0] - st.req.arrival,
                         token_times=list(st.token_times))
+        if tel is not None:
+            counts = self.compile_counts()
+            tel.step_compiles.set(counts["step"])
+            tel.prefill_compiles.set(counts["prefill"])
+            tel.queue_depth.set(0)
+            tel.slot_occupancy.set(self.slots.occupied)
         return results
 
 

@@ -1,0 +1,20 @@
+"""Data-plane telemetry: metrics core, Prometheus /metrics, event log,
+and XProf span annotations. See core.py for the design constraints."""
+from .core import Counter, Gauge, Histogram, Registry
+from .events import (EventLog, read_events, PREEMPTION_DRAIN,
+                     EMERGENCY_CHECKPOINT, DIVERGENCE_ROLLBACK, INIT_RETRY,
+                     SLOT_ADMIT, SLOT_RETIRE)
+from .prometheus import (CONTENT_TYPE, TelemetryServer, escape_label_value,
+                         format_value, histogram_lines, render_registry)
+from .spans import span
+from .worker import ServeTelemetry, TrainTelemetry, WorkerTelemetry
+
+__all__ = [
+    "Counter", "Gauge", "Histogram", "Registry",
+    "EventLog", "read_events", "PREEMPTION_DRAIN", "EMERGENCY_CHECKPOINT",
+    "DIVERGENCE_ROLLBACK", "INIT_RETRY", "SLOT_ADMIT", "SLOT_RETIRE",
+    "CONTENT_TYPE", "TelemetryServer", "escape_label_value", "format_value",
+    "histogram_lines", "render_registry",
+    "span",
+    "ServeTelemetry", "TrainTelemetry", "WorkerTelemetry",
+]

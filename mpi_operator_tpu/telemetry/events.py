@@ -1,0 +1,119 @@
+"""Structured event log: fsync'd JSONL for discrete data-plane events.
+
+Metrics answer "how fast"; events answer "what happened". Preemption
+drains, emergency checkpoints, divergence rollbacks, init retries, and
+slot admissions are rare, discrete, and individually precious — exactly
+the records a post-mortem needs after the process is already dead.
+
+The record discipline is bench.py's mid-kill-survivable one: each event
+is a single JSON line written, flushed, AND os.fsync'd before emit()
+returns. A SIGKILL between two emits loses nothing; a SIGKILL in the
+middle of a write can at worst truncate the LAST line, which
+`read_events` tolerates by skipping a trailing partial record. This is
+what makes the resilience contract honest: the `preemption_drain` event
+is durable on disk BEFORE the emergency checkpoint starts, so even a
+save that dies mid-write leaves evidence of why.
+
+Records: {"ts": <unix seconds>, "event": <kind>, ...fields}. One file
+per process — multi-host runs should point each worker at its own path
+(aggregation is a ROADMAP follow-up).
+"""
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Dict, List, Optional
+
+# Event kinds. Constants, not an enum: the log is a plain-text contract
+# read by shell greps (scripts/tier1.sh --resilience) and jq alike.
+PREEMPTION_DRAIN = "preemption_drain"
+EMERGENCY_CHECKPOINT = "emergency_checkpoint"
+DIVERGENCE_ROLLBACK = "divergence_rollback"
+INIT_RETRY = "init_retry"
+SLOT_ADMIT = "slot_admit"
+SLOT_RETIRE = "slot_retire"
+
+
+class EventLog:
+    """Append-only JSONL event sink with per-record durability."""
+
+    def __init__(self, path: str, clock=time.time):
+        self.path = path
+        parent = os.path.dirname(path)
+        if parent:
+            os.makedirs(parent, exist_ok=True)
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._fh = open(path, "a", encoding="utf-8")
+
+    def emit(self, event: str, **fields) -> Dict:
+        """Write one event record; durable on disk when this returns.
+
+        No-op after close() — shutdown paths (resilience __exit__,
+        benchmark finally blocks) may race a late checkpoint thread, and
+        losing a post-close event beats crashing the drain.
+        """
+        rec = {"ts": round(self._clock(), 3), "event": event, **fields}
+        with self._lock:
+            if self._fh.closed:
+                return rec
+            self._fh.write(json.dumps(rec) + "\n")
+            self._fh.flush()
+            os.fsync(self._fh.fileno())
+        return rec
+
+    def flush(self) -> None:
+        """Force-durability barrier. emit() already fsyncs per record, so
+        this only matters for buffered writes from a future batched mode;
+        kept explicit so shutdown paths can state their ordering."""
+        with self._lock:
+            if not self._fh.closed:
+                self._fh.flush()
+                os.fsync(self._fh.fileno())
+
+    def close(self) -> None:
+        with self._lock:
+            if not self._fh.closed:
+                self._fh.flush()
+                try:
+                    os.fsync(self._fh.fileno())
+                except OSError:
+                    pass
+                self._fh.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+def read_events(path: str, kind: Optional[str] = None) -> List[Dict]:
+    """Parse an event log, skipping a trailing partial record (the only
+    corruption a mid-write SIGKILL can produce). Optionally filter by
+    event kind."""
+    out: List[Dict] = []
+    try:
+        with open(path, "r", encoding="utf-8") as fh:
+            lines = fh.read().split("\n")
+    except FileNotFoundError:
+        return out
+    for i, line in enumerate(lines):
+        if not line.strip():
+            continue
+        try:
+            rec = json.loads(line)
+        except json.JSONDecodeError:
+            if i == len(lines) - 1:    # torn final write — expected
+                continue
+            raise
+        if kind is None or rec.get("event") == kind:
+            out.append(rec)
+    return out
+
+
+__all__ = ["EventLog", "read_events", "PREEMPTION_DRAIN",
+           "EMERGENCY_CHECKPOINT", "DIVERGENCE_ROLLBACK", "INIT_RETRY",
+           "SLOT_ADMIT", "SLOT_RETIRE"]

@@ -1,0 +1,44 @@
+"""Host-side span annotations that land in XProf traces.
+
+`jax.profiler.TraceAnnotation` names a host-thread region in the
+profiler timeline, so "schedule", "prefill", "decode_step", and
+"checkpoint.save" show up NEXT TO the device ops they caused — the view
+that makes a host-bound serving loop or a synchronous checkpoint stall
+obvious in one screenshot.
+
+Outside an active capture the annotation is close to free (TraceMe's
+fast path is a disabled-flag check), so call sites keep their spans
+unconditionally. If this jax build lacks the API the helper degrades to
+a nullcontext rather than gating every caller.
+"""
+from __future__ import annotations
+
+from contextlib import nullcontext
+
+# resolved on first span() call, not at import: the telemetry package is
+# shared with the CONTROL plane (controller/metrics.py reuses the
+# histogram/text-format code), which must stay importable without jax
+_TraceAnnotation = None
+_resolved = False
+
+
+def _resolve():
+    global _TraceAnnotation, _resolved
+    try:
+        from jax.profiler import TraceAnnotation
+        _TraceAnnotation = TraceAnnotation
+    except ImportError:                                # pragma: no cover
+        _TraceAnnotation = None
+    _resolved = True
+
+
+def span(name: str):
+    """Context manager marking a named host region in XProf traces."""
+    if not _resolved:
+        _resolve()
+    if _TraceAnnotation is None:                       # pragma: no cover
+        return nullcontext()
+    return _TraceAnnotation(name)
+
+
+__all__ = ["span"]

@@ -1,0 +1,226 @@
+"""Per-worker instrument bundles: the named series the data plane exports.
+
+This is the naming contract in one place — trainers and the serving
+engine take a bundle and bump instruments; they never invent series
+names. Everything is prefixed ``tpu_worker_`` (the operator owns
+``tpu_operator_``), so one Prometheus config scrapes both planes without
+collisions.
+
+Train series (LMTrainer / Trainer / PipelineLMTrainer benchmark loops):
+  step_seconds            histogram — per-step wall time (host-synced)
+  tokens_per_sec          gauge     — last-window LM throughput
+  examples_per_sec        gauge     — last-window image throughput
+  mfu                     gauge     — model FLOPs utilization, 0-1
+  goodput                 gauge     — productive / total steps, 0-1
+  steps_total             counter   — steps executed
+  skipped_steps_total     counter   — divergence-guard skipped (lower
+                                      bound: streaks are sampled at
+                                      window fetches, resets between
+                                      fetches are invisible)
+  rollback_steps_total    counter   — steps rewound by rollbacks
+
+Serve series (ServingEngine):
+  ttft_seconds            histogram — request arrival → first token
+  tpot_seconds            histogram — inter-token gap per slot
+  prefill_seconds         histogram — prefill chunk dispatch (async: host
+                                      wall time, not device time)
+  decode_step_seconds     histogram — decode step incl. token sync (the
+                                      host read IS the device barrier)
+  queue_depth             gauge     — requests waiting for a slot
+  slot_occupancy          gauge     — slots currently bound
+  slots                   gauge     — configured slot count
+  step_compiles           gauge     — decode-step compile count
+  prefill_compiles        gauge     — prefill compile count
+  requests_total          counter   — requests retired
+  tokens_total            counter   — new tokens emitted
+"""
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+from .core import Registry
+from .events import EventLog
+from .prometheus import TelemetryServer
+
+
+class TrainTelemetry:
+    """Train-loop instruments over a shared registry."""
+
+    def __init__(self, registry: Optional[Registry] = None):
+        reg = registry if registry is not None else Registry()
+        self.registry = reg
+        self.step_seconds = reg.histogram(
+            "tpu_worker_step_seconds", "per-step wall time (seconds)")
+        self.tokens_per_sec = reg.gauge(
+            "tpu_worker_tokens_per_sec", "last-window LM tokens/sec")
+        self.examples_per_sec = reg.gauge(
+            "tpu_worker_examples_per_sec", "last-window examples/sec")
+        self.mfu = reg.gauge(
+            "tpu_worker_mfu", "model FLOPs utilization (0-1)")
+        self.goodput = reg.gauge(
+            "tpu_worker_goodput", "productive steps / total steps (0-1)")
+        self.steps_total = reg.counter(
+            "tpu_worker_steps_total", "train steps executed")
+        self.skipped_steps_total = reg.counter(
+            "tpu_worker_skipped_steps_total",
+            "divergence-guard skipped steps (lower bound)")
+        self.rollback_steps_total = reg.counter(
+            "tpu_worker_rollback_steps_total",
+            "steps rewound by divergence rollbacks")
+        self._lock = threading.Lock()
+        self._last_streak = 0
+        self.goodput.set(1.0)
+
+    def observe_step(self, seconds: float) -> None:
+        self.step_seconds.observe(seconds)
+        self.steps_total.inc()
+
+    def observe_steps(self, avg_seconds: float, n: int) -> None:
+        """Fold a window's worth of steps in as n observations of the
+        window-average step time (the only per-step number an async
+        dispatch loop can honestly report — see benchmark loops)."""
+        self.step_seconds.observe_n(avg_seconds, n)
+        self.steps_total.inc(n)
+
+    def update_window(self, tokens_per_sec: Optional[float] = None,
+                      examples_per_sec: Optional[float] = None,
+                      mfu: Optional[float] = None) -> None:
+        if tokens_per_sec is not None:
+            self.tokens_per_sec.set(tokens_per_sec)
+        if examples_per_sec is not None:
+            self.examples_per_sec.set(examples_per_sec)
+        if mfu is not None:
+            self.mfu.set(mfu)
+
+    def record_streak(self, streak: int) -> int:
+        """Fold a window-fetch `nonfinite_streak` reading into the skipped
+        counter. Streaks are only visible at fetches, so this is a lower
+        bound: a streak that grew keeps its overlap with the previous
+        reading; one that reset and regrew is all new skips."""
+        streak = int(streak)
+        with self._lock:
+            if streak <= 0:
+                new = 0
+            elif streak > self._last_streak:
+                new = streak - self._last_streak
+            else:
+                new = streak
+            self._last_streak = streak
+        if new:
+            self.skipped_steps_total.inc(new)
+            self._update_goodput()
+        return new
+
+    def record_rollback(self, steps_rewound: int) -> None:
+        with self._lock:
+            self._last_streak = 0
+        if steps_rewound > 0:
+            self.rollback_steps_total.inc(steps_rewound)
+        self._update_goodput()
+
+    def _update_goodput(self) -> None:
+        total = self.steps_total.value
+        if total <= 0:
+            return
+        lost = (self.skipped_steps_total.value
+                + self.rollback_steps_total.value)
+        self.goodput.set(max(0.0, 1.0 - lost / total))
+
+    def step_percentiles_ms(self):
+        """(p50, p99) step time in milliseconds, Nones when empty — the
+        summary bench legs embed in their JSONL records."""
+        p50 = self.step_seconds.percentile(50)
+        p99 = self.step_seconds.percentile(99)
+        to_ms = lambda v: None if v is None else v * 1e3  # noqa: E731
+        return to_ms(p50), to_ms(p99)
+
+
+class ServeTelemetry:
+    """Serving-engine instruments over a shared registry."""
+
+    def __init__(self, registry: Optional[Registry] = None):
+        reg = registry if registry is not None else Registry()
+        self.registry = reg
+        # serving latencies reach sub-100µs on real accelerators; start
+        # the buckets a decade lower than the train histogram
+        hist = lambda n, h: reg.histogram(n, h, lo=1e-5, hi=1e3)  # noqa: E731
+        self.ttft_seconds = hist(
+            "tpu_worker_ttft_seconds", "request arrival to first token")
+        self.tpot_seconds = hist(
+            "tpu_worker_tpot_seconds", "inter-token gap per slot")
+        self.prefill_seconds = hist(
+            "tpu_worker_prefill_seconds",
+            "prefill chunk host dispatch time (async)")
+        self.decode_step_seconds = hist(
+            "tpu_worker_decode_step_seconds",
+            "decode step wall time incl. token sync")
+        self.queue_depth = reg.gauge(
+            "tpu_worker_queue_depth", "requests waiting for a slot")
+        self.slot_occupancy = reg.gauge(
+            "tpu_worker_slot_occupancy", "slots currently bound")
+        self.slots = reg.gauge(
+            "tpu_worker_slots", "configured decode slots")
+        self.step_compiles = reg.gauge(
+            "tpu_worker_step_compiles", "decode-step compile count")
+        self.prefill_compiles = reg.gauge(
+            "tpu_worker_prefill_compiles", "prefill compile count")
+        self.requests_total = reg.counter(
+            "tpu_worker_requests_total", "requests retired")
+        self.tokens_total = reg.counter(
+            "tpu_worker_tokens_total", "new tokens emitted")
+
+
+class WorkerTelemetry:
+    """One per worker process: shared registry + lazy train/serve bundles
+    + optional /metrics server + optional event log. Both hot loops feed
+    the SAME registry, so one scrape shows train and serve series side by
+    side (a worker can do both — e.g. background eval during serving)."""
+
+    def __init__(self, registry: Optional[Registry] = None,
+                 events: Optional[EventLog] = None):
+        self.registry = registry if registry is not None else Registry()
+        self.events = events
+        self._train: Optional[TrainTelemetry] = None
+        self._serving: Optional[ServeTelemetry] = None
+        self._server: Optional[TelemetryServer] = None
+
+    @property
+    def train(self) -> TrainTelemetry:
+        if self._train is None:
+            self._train = TrainTelemetry(self.registry)
+        return self._train
+
+    @property
+    def serving(self) -> ServeTelemetry:
+        if self._serving is None:
+            self._serving = ServeTelemetry(self.registry)
+        return self._serving
+
+    def serve(self, port: int = 0, host: str = "",
+              healthy=None) -> TelemetryServer:
+        if self._server is None:
+            self._server = TelemetryServer(
+                self.registry, port=port, host=host, healthy=healthy)
+        return self._server
+
+    @property
+    def port(self) -> Optional[int]:
+        return self._server.port if self._server else None
+
+    def close(self, close_events: bool = True) -> None:
+        """Shutdown order matters: the event log is flushed FIRST so the
+        final records (e.g. a preemption drain) are durable even if the
+        HTTP server teardown hangs or the process is about to exit(215).
+        close_events=False flushes but leaves a BORROWED event log open
+        (the caller that opened it closes it)."""
+        if self.events is not None:
+            self.events.flush()
+        if self._server is not None:
+            self._server.close()
+            self._server = None
+        if self.events is not None and close_events:
+            self.events.close()
+
+
+__all__ = ["ServeTelemetry", "TrainTelemetry", "WorkerTelemetry"]

@@ -17,6 +17,7 @@ from typing import Any, List, Optional, Tuple
 import jax
 import orbax.checkpoint as ocp
 
+from ..telemetry import span
 from .trainer import TrainState
 
 
@@ -81,11 +82,14 @@ def save_checkpoint(directory: str, state, step: Optional[int] = None,
     step = int(state.step) if step is None else step
     path = os.path.join(os.path.abspath(directory), f"step_{step}")
     ckptr = _async_checkpointer()
-    ckptr.save(path, args=ocp.args.StandardSave(_state_payload(state)),
-               force=True)
-    _LAST_SAVED[os.path.abspath(directory)] = step
-    if block:
-        ckptr.wait_until_finished()
+    # the span covers the device-array snapshot (and, when block=True, the
+    # full write) so checkpoint stalls show up next to device ops in XProf
+    with span("checkpoint.save"):
+        ckptr.save(path, args=ocp.args.StandardSave(_state_payload(state)),
+                   force=True)
+        _LAST_SAVED[os.path.abspath(directory)] = step
+        if block:
+            ckptr.wait_until_finished()
     return path
 
 

@@ -29,6 +29,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..parallel.mesh import BATCH_AXES, batch_spec
 from ..parallel.sharding import activation_rules_scope, shard_init
+from ..telemetry import TrainTelemetry, span
 from ..utils import flops
 from ..utils.profiling import WindowProfiler
 
@@ -540,7 +541,7 @@ class LMTrainer:
                   warmup_steps: int = 5, log: Callable[[str], None] = print,
                   profile_dir: Optional[str] = None,
                   step_hook: Optional[Callable] = None,
-                  resilience=None,
+                  resilience=None, telemetry: Optional[TrainTelemetry] = None,
                   ) -> Tuple[LMTrainState, Dict[str, float]]:
         """tokens/sec measurement, same windowed protocol as
         train.trainer.Trainer.benchmark (ref README.md:113-131 format).
@@ -550,8 +551,18 @@ class LMTrainer:
         resilience: an entered train.resilience.ResilienceContext —
         per-step stop-bit check (emergency checkpoint + Preempted on a
         gang drain) and divergence rollback at window fetches; see
-        Trainer.benchmark."""
+        Trainer.benchmark.
+
+        telemetry: a telemetry.TrainTelemetry to feed (pass one backed by
+        a served registry to expose a live /metrics); when None a private
+        recorder still runs so step_time_p50/p99_ms and goodput always
+        land in the returned metrics dict. Instruments are only touched at
+        window fetches — the loop body dispatches async, so per-iteration
+        host time is not a step time; the window average is."""
         cfg = self.config
+        tel = telemetry if telemetry is not None else TrainTelemetry()
+        if resilience is not None and resilience.telemetry is None:
+            resilience.telemetry = tel    # rollback accounting → goodput
         it = iter(dataset)
         probe = next(it)
         state, metrics = self.train_step(state, *probe)   # compiles
@@ -562,6 +573,7 @@ class LMTrainer:
         float(metrics["loss"])
         base_step = int(state.step)       # one host read, OUTSIDE the loop
         tokens_per_step = cfg.global_batch_size * cfg.seq_len
+        n = self.mesh.size
         log_every = max(1, min(cfg.log_every, num_steps))
         windows = []
         profiler = WindowProfiler(profile_dir, log)
@@ -571,7 +583,8 @@ class LMTrainer:
         try:
             for i in range(1, num_steps + 1):
                 batch = next(it)
-                state, metrics = self.train_step(state, *batch)
+                with span("train.step"):
+                    state, metrics = self.train_step(state, *batch)
                 if step_hook is not None:
                     step_hook(state, base_step + i)
                 if resilience is not None \
@@ -587,10 +600,17 @@ class LMTrainer:
                     profiler.stop_if_active()
                     tps = tokens_per_step * log_every / (t1 - t0)
                     windows.append(tps)
+                    tel.observe_steps((t1 - t0) / log_every, log_every)
+                    tel.update_window(
+                        tokens_per_sec=tps,
+                        mfu=flops.throughput_stats(
+                            flops_per_step, tps / tokens_per_step, n)["mfu"])
+                    streak = int(metrics.get("nonfinite_streak", 0))
+                    if streak:
+                        tel.record_streak(streak)
                     log(f"{i}\ttokens/sec: {tps:.0f}\tloss: {loss:.3f}")
-                    if resilience is not None and int(
-                            metrics.get("nonfinite_streak", 0)
-                    ) >= resilience.config.divergence_k:
+                    if resilience is not None \
+                            and streak >= resilience.config.divergence_k:
                         state = resilience.rollback(state)
                         base_step = int(state.step) - i
                     t0 = time.perf_counter()
@@ -598,11 +618,14 @@ class LMTrainer:
             profiler.stop_if_active()
         steady = windows[1:] if len(windows) > 1 else windows
         tps = sum(steady) / len(steady)
-        n = self.mesh.size
         stats = flops.throughput_stats(flops_per_step,
                                        tps / tokens_per_step, n)
+        p50_ms, p99_ms = tel.step_percentiles_ms()
         log("-" * 40)
         log(f"total tokens/sec: {tps:.0f}")
+        if p50_ms is not None:
+            log(f"step time: p50 {p50_ms:.1f} ms, p99 {p99_ms:.1f} ms, "
+                f"goodput {tel.goodput.value:.1%}")
         if stats["mfu"] is not None:
             log(f"per-device: {stats['tflops_per_sec_per_device']:.1f} "
                 f"TFLOP/s, MFU {stats['mfu']:.1%}")
@@ -612,6 +635,9 @@ class LMTrainer:
             "tokens_per_sec_per_device": tps / n,
             "wall_seconds": time.perf_counter() - wall0,
             "final_loss": float(metrics["loss"]),
+            "step_time_p50_ms": p50_ms,
+            "step_time_p99_ms": p99_ms,
+            "goodput": tel.goodput.value,
             **stats,
         }
 

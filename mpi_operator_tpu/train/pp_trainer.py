@@ -31,6 +31,7 @@ from ..models.transformer import CausalLM, MaskedLM, TransformerConfig
 from ..parallel.pipeline import (bubble_fraction, pipeline_lm_loss,
                                  pipeline_mlm_loss, stack_lm_params,
                                  stack_mlm_params)
+from ..telemetry import TrainTelemetry, span
 from ..utils import flops
 from .lm_trainer import LMTrainerConfig, _opt_shardings, make_adamw
 
@@ -430,7 +431,7 @@ class PipelineLMTrainer:
     def benchmark(self, state, dataset, num_steps: int = 50,
                   warmup_steps: int = 5, log: Callable[[str], None] = print,
                   step_hook: Optional[Callable] = None,
-                  resilience=None,
+                  resilience=None, telemetry: Optional[TrainTelemetry] = None,
                   ) -> Tuple[PPTrainState, Dict[str, float]]:
         """The stream may yield flat [B, S] pairs (microbatched and placed
         here) or pre-placed [M, mb, S] streams (real-data pipelines).
@@ -442,8 +443,13 @@ class PipelineLMTrainer:
         same as every pp checkpoint) so the restarted gang may pick a
         different schedule/interleave. The in-step divergence guard is a
         flat-trainer feature (1F1B computes grads in-schedule; there is
-        no single post-step select point)."""
+        no single post-step select point).
+
+        telemetry: a telemetry.TrainTelemetry to feed. The pp loop is a
+        single timed block (no window fetches), so the whole run folds in
+        as num_steps observations of the average step time."""
         cfg = self.config
+        tel = telemetry if telemetry is not None else TrainTelemetry()
 
         def prepare(batch):
             if batch[0].ndim == 2:
@@ -459,7 +465,8 @@ class PipelineLMTrainer:
         tokens_per_step = cfg.global_batch_size * cfg.seq_len
         t0 = time.perf_counter()
         for i in range(1, num_steps + 1):
-            state, metrics = step(state, *prepare(next(it)))
+            with span("train.pp_step"):
+                state, metrics = step(state, *prepare(next(it)))
             if step_hook is not None:
                 step_hook(state, base_step + i)
             if resilience is not None \
@@ -479,6 +486,9 @@ class PipelineLMTrainer:
             cfg.seq_len, causal=self.cfg.causal)
         stats = flops.throughput_stats(
             per_token * tokens_per_step, tps / tokens_per_step, n)
+        tel.observe_steps(dt / num_steps, num_steps)
+        tel.update_window(tokens_per_sec=tps, mfu=stats["mfu"])
+        p50_ms, p99_ms = tel.step_percentiles_ms()
         log(f"pp={self.pp} M={self.num_microbatches} "
             f"schedule={self.schedule}"
             + (f"×{self.interleave}" if self.interleave > 1 else "")
@@ -492,6 +502,8 @@ class PipelineLMTrainer:
                        "tokens_per_sec_per_device": tps / n,
                        "final_loss": final_loss,
                        "bubble_fraction": self.bubble,
+                       "step_time_p50_ms": p50_ms,
+                       "step_time_p99_ms": p99_ms,
                        **stats, **extra}
 
 

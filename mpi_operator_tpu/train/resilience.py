@@ -398,7 +398,8 @@ class ResilienceContext:
                  log: Callable[[str], None] = print,
                  listener: Optional[PreemptionListener] = None,
                  faults: Optional[FaultInjector] = None,
-                 watchdog: Optional[Watchdog] = None):
+                 watchdog: Optional[Watchdog] = None,
+                 events=None, telemetry=None):
         self.config = config or ResilienceConfig()
         self.log = log
         self.listener = (listener if listener is not None
@@ -407,6 +408,12 @@ class ResilienceContext:
         if watchdog is None and self.config.step_deadline > 0:
             watchdog = Watchdog(self.config.step_deadline, log=log)
         self.watchdog = watchdog
+        #: telemetry.EventLog — resilience transitions become durable JSONL
+        #: records; every emit is fsync'd before it returns, which is what
+        #: lets emergency_save promise the drain is on disk before exit(215)
+        self.events = events
+        #: telemetry.TrainTelemetry — rollback accounting feeds goodput
+        self.telemetry = telemetry
         self._pending_stop = False
         self._rollbacks = 0
 
@@ -421,6 +428,12 @@ class ResilienceContext:
         return self
 
     def __exit__(self, *exc) -> None:
+        # flush the event log BEFORE any teardown that could hang or kill
+        # the process: when __exit__ runs on the Preempted unwind path the
+        # very next thing the entrypoint does is exit(215), and the
+        # preemption record must already be durable by then
+        if self.events is not None:
+            self.events.flush()
         if self.watchdog is not None:
             self.watchdog.stop()
         self.listener.uninstall()
@@ -453,10 +466,22 @@ class ResilienceContext:
         """The final SYNCHRONOUS checkpoint before a preemption exit —
         blocks until committed (an async write racing SIGKILL is how you
         lose the run). Collective: every rank calls it at the same step
-        (on_step's replicated stop bit guarantees that)."""
+        (on_step's replicated stop bit guarantees that).
+
+        Event ordering is deliberate: `preemption_drain` is fsync'd to the
+        event log BEFORE the save starts, so a checkpoint write that dies
+        mid-flight still leaves durable evidence of WHY the process
+        exited; `emergency_checkpoint` lands after the commit."""
         from .checkpoint import maybe_save
 
+        step = int(state.step)
+        if self.events is not None:
+            from ..telemetry import events as ev
+            self.events.emit(ev.PREEMPTION_DRAIN, step=step)
         maybe_save(self.config.train_dir, state, self.log)
+        if self.events is not None:
+            self.events.emit(ev.EMERGENCY_CHECKPOINT, step=step,
+                             train_dir=self.config.train_dir)
 
     def rollback(self, state):
         """Restore the newest intact checkpoint after divergence_k
@@ -485,6 +510,14 @@ class ResilienceContext:
                 f"{self.config.train_dir!r}")
         self.log(f"divergence rollback #{self._rollbacks}: restored {path} "
                  f"(step {int(restored.step)})")
+        from_step, to_step = int(state.step), int(restored.step)
+        if self.events is not None:
+            from ..telemetry import events as ev
+            self.events.emit(ev.DIVERGENCE_ROLLBACK, from_step=from_step,
+                             to_step=to_step, rollback=self._rollbacks,
+                             path=path)
+        if self.telemetry is not None:
+            self.telemetry.record_rollback(max(0, from_step - to_step))
         return restored.replace(
             nonfinite_streak=jnp.zeros_like(jnp.asarray(restored.step)))
 

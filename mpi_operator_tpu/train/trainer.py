@@ -27,6 +27,7 @@ from flax.core import FrozenDict
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..parallel.mesh import batch_spec
+from ..telemetry import TrainTelemetry, span
 from ..utils import flops
 from ..utils.profiling import WindowProfiler
 
@@ -181,7 +182,7 @@ class Trainer:
                   log: Callable[[str], None] = print,
                   profile_dir: Optional[str] = None,
                   step_hook: Optional[Callable] = None,
-                  resilience=None,
+                  resilience=None, telemetry: Optional[TrainTelemetry] = None,
                   ) -> Tuple[TrainState, Dict[str, float]]:
         """Windowed throughput measurement, tf_cnn_benchmarks-style.
         Returns (final_state, metrics) — the input state is DONATED by the
@@ -202,7 +203,15 @@ class Trainer:
         the mean over steady-state windows (first window dropped — it
         absorbs pipeline fill), matching how tf_cnn_benchmarks averages
         per-step rates after warmup (ref README.md:113-131).
+
+        telemetry: a telemetry.TrainTelemetry to feed (see
+        LMTrainer.benchmark — same window-fetch-only discipline); a
+        private recorder runs when None so step_time_p50/p99_ms and
+        goodput always land in the returned metrics.
         """
+        tel = telemetry if telemetry is not None else TrainTelemetry()
+        if resilience is not None and resilience.telemetry is None:
+            resilience.telemetry = tel    # rollback accounting → goodput
         step_fn = self.compile_step(state)
         it = iter(dataset)
         log_every = max(1, min(self.config.log_every, num_steps))
@@ -215,6 +224,15 @@ class Trainer:
             step_fn.lower(state, *probe).compile())
         if flops_per_step is not None:
             flops_per_step *= self.mesh.size
+        else:
+            # analytic fallback resolved BEFORE the loop so per-window MFU
+            # gauges have a numerator too, not just the final summary
+            per_image = flops.resnet_train_flops_per_image(
+                getattr(self.model, "arch", "") or "",
+                self.config.image_size,
+                stem=getattr(self.model, "stem", "conv7"))
+            flops_per_step = (per_image * self.config.global_batch_size
+                              if per_image else None)
         state, metrics = step_fn(state, *probe)
         for _ in range(max(0, warmup_steps - 1)):
             images, labels = next(it)
@@ -230,7 +248,8 @@ class Trainer:
         try:
             for i in range(1, num_steps + 1):
                 images, labels = next(it)
-                state, metrics = step_fn(state, images, labels)
+                with span("train.step"):
+                    state, metrics = step_fn(state, images, labels)
                 if step_hook is not None:
                     # periodic async checkpointing
                     # (train/checkpoint.periodic_saver)
@@ -249,11 +268,20 @@ class Trainer:
                     ips = self.config.global_batch_size * log_every \
                         / (t1 - t0)
                     window_ips.append(ips)
+                    tel.observe_steps((t1 - t0) / log_every, log_every)
+                    tel.update_window(
+                        examples_per_sec=ips,
+                        mfu=flops.throughput_stats(
+                            flops_per_step,
+                            ips / self.config.global_batch_size,
+                            self.mesh.size)["mfu"])
+                    streak = int(metrics.get("nonfinite_streak", 0))
+                    if streak:
+                        tel.record_streak(streak)
                     # tf_cnn_benchmarks log format (ref README.md:113-125)
                     log(f"{i}\timages/sec: {ips:.1f}\tloss: {loss:.3f}")
-                    if resilience is not None and int(
-                            metrics.get("nonfinite_streak", 0)
-                    ) >= resilience.config.divergence_k:
+                    if resilience is not None \
+                            and streak >= resilience.config.divergence_k:
                         state = resilience.rollback(state)
                         base_step = int(state.step) - i
                     t0 = time.perf_counter()       # fetch/log time excluded
@@ -264,17 +292,14 @@ class Trainer:
         steady = window_ips[1:] if len(window_ips) > 1 else window_ips
         total_ips = sum(steady) / len(steady)
         n = self.mesh.size
-        if flops_per_step is None:
-            per_image = flops.resnet_train_flops_per_image(
-                getattr(self.model, "arch", "") or "",
-                self.config.image_size,
-                stem=getattr(self.model, "stem", "conv7"))
-            flops_per_step = (per_image * self.config.global_batch_size
-                              if per_image else None)
         stats = flops.throughput_stats(
             flops_per_step, total_ips / self.config.global_batch_size, n)
+        p50_ms, p99_ms = tel.step_percentiles_ms()
         log("-" * 40)
         log(f"total images/sec: {total_ips:.2f}")   # ref README.md:127-131
+        if p50_ms is not None:
+            log(f"step time: p50 {p50_ms:.1f} ms, p99 {p99_ms:.1f} ms, "
+                f"goodput {tel.goodput.value:.1%}")
         if stats["mfu"] is not None:
             log(f"per-device: {stats['tflops_per_sec_per_device']:.1f} "
                 f"TFLOP/s, MFU {stats['mfu']:.1%}")
@@ -285,6 +310,9 @@ class Trainer:
             "steps": num_steps,
             "wall_seconds": wall,
             "final_loss": final_loss,
+            "step_time_p50_ms": p50_ms,
+            "step_time_p99_ms": p99_ms,
+            "goodput": tel.goodput.value,
             **stats,
         }
 

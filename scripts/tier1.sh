@@ -46,6 +46,17 @@ if [ "${1:-}" = "--resilience" ]; then
   if [ ! -d "$dir/ckpt/step_5" ]; then
     echo "FAIL: no emergency checkpoint at step_5"; ls "$dir/ckpt"; exit 1
   fi
+  # the structured event log (telemetry/events.py, default path
+  # <train-dir>/events.jsonl) must carry the drain sequence, fsync'd
+  # BEFORE exit(215) — the durability contract a postmortem relies on
+  if ! grep -q '"event": "preemption_drain"' "$dir/ckpt/events.jsonl"; then
+    echo "FAIL: no preemption_drain record in the event log"
+    cat "$dir/ckpt/events.jsonl" 2>/dev/null; exit 1
+  fi
+  if ! grep -q '"event": "emergency_checkpoint"' "$dir/ckpt/events.jsonl"; then
+    echo "FAIL: no emergency_checkpoint record in the event log"
+    cat "$dir/ckpt/events.jsonl" 2>/dev/null; exit 1
+  fi
   echo "== resilience smoke: resume to step 8 =="
   "${run_env[@]}" "${args[@]}" --num-steps 20 --stop-at-step 8 \
     > "$dir/resume.log" 2>&1
@@ -61,8 +72,8 @@ if [ "${1:-}" = "--resilience" ]; then
     echo "FAIL: resumed run did not reach global step 8"
     ls "$dir/ckpt"; exit 1
   fi
-  echo "resilience smoke: OK (exit 215 -> emergency step_5 -> resume -> step_8)"
+  echo "resilience smoke: OK (exit 215 -> emergency step_5 -> events -> resume -> step_8)"
   exit 0
 fi
 
-set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 1320 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=${PIPESTATUS[0]}; echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c); exit $rc
+set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 1320 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --durations=15 --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=${PIPESTATUS[0]}; echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c); exit $rc
