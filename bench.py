@@ -323,7 +323,8 @@ def main() -> None:
         # these since the telemetry PR) — every ladder leg carries its
         # p50/p99 so a throughput regression can be told apart from a
         # tail-latency one without rerunning
-        for k in ("step_time_p50_ms", "step_time_p99_ms"):
+        for k in ("step_time_p50_ms", "step_time_p99_ms",
+                  "host_gap_p50_ms", "host_gap_p99_ms"):
             if metrics.get(k) is not None:
                 out[k] = round(metrics[k], 3)
         if metrics.get("goodput") is not None:
@@ -475,9 +476,13 @@ def main() -> None:
             slots=4 if args.smoke else 8,
             num_requests=8 if args.smoke else 32,
             prompt_grid=(8, 16, 24) if args.smoke else (32, 64, 128),
-            new_grid=(4, 8) if args.smoke else (32, 64),
+            # decode-heavy smoke: the async-vs-sync A/B's win scales
+            # with decode steps (host work hidden per step), so a
+            # 4-8-token trace measures only prefill + noise
+            new_grid=(16, 32) if args.smoke else (32, 64),
             chunk_buckets=(8, 16) if args.smoke else (32, 128),
             dtype_name=args.dtype,
+            compare_sync=True,
             log=lambda s: print(s, file=sys.stderr)))
 
     if args.workload == "serving":
@@ -679,9 +684,17 @@ def main() -> None:
             lm_leg("gpt2_tp2_overlap", workload="gpt2", steps=steps,
                    warmup=warm, batch=16, tp=2, fused_xent=True,
                    tp_overlap=True)
+            # third point of the A/B: same overlap bodies, halves of each
+            # shard rotating in OPPOSITE directions (half the bytes per
+            # hop on a bidirectional ICI link) — read against the
+            # gpt2_tp2_overlap leg; nothing else differs
+            lm_leg("gpt2_tp2_bidir", workload="gpt2", steps=steps,
+                   warmup=warm, batch=16, tp=2, fused_xent=True,
+                   tp_overlap=True, tp_ring="bidir")
         else:
             line["gpt2_tp2_skipped"] = "needs >=2 devices"
             line["gpt2_tp2_overlap_skipped"] = "needs >=2 devices"
+            line["gpt2_tp2_bidir_skipped"] = "needs >=2 devices"
         # MoE: expert-capacity dispatch on one chip — MFU + drop rate
         lm_leg("moe", workload="gpt2",
                size=None if args.smoke else "small",

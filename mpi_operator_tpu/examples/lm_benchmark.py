@@ -78,6 +78,7 @@ def run_lm_benchmark(
     flash_block_q: Optional[int] = None,
     flash_block_k: Optional[int] = None,
     tp_overlap: bool = False,
+    tp_ring: str = "uni",
     accum_steps: int = 1,
     data_dir: Optional[str] = None,
     train_dir: Optional[str] = None,
@@ -175,6 +176,10 @@ def run_lm_benchmark(
                              "only (the pipeline's partial-manual "
                              "shard_map already binds pp)")
         overrides["tp_overlap"] = True
+        overrides["tp_ring"] = tp_ring
+    elif tp_ring != "uni":
+        raise ValueError("--tp-ring=bidir only changes the overlap ring "
+                         "collectives; it needs --tp-overlap")
     model = create_lm(name, dtype=dtype, attention=attention, remat=remat,
                       remat_policy=remat_policy, max_len=max(seq_len, 32),
                       **overrides)
@@ -236,7 +241,8 @@ def run_lm_benchmark(
                                        schedule=pp_schedule,
                                        interleave=pp_interleave)
         pp_state = pp_trainer.init_state(jax.random.PRNGKey(0))
-        from ..train.checkpoint import maybe_resume, maybe_save
+        from ..train.checkpoint import (maybe_resume, maybe_save,
+                                        wait_for_checkpoints)
         pp_resilience = ResilienceContext(
             ResilienceConfig.from_env(train_dir=train_dir,
                                       divergence_k=divergence_k,
@@ -345,12 +351,18 @@ def run_lm_benchmark(
             pp_stream.close()
             pp_resilience.__exit__(None, None, None)
             wtel.close(close_events=owns_events)
-        maybe_save(train_dir, pp_trainer.canonical_state(pp_state), log)
+        # non-blocking final save: the write overlaps the canonical-state
+        # host transfer teardown; the join below makes it durable before
+        # the process can exit
+        maybe_save(train_dir, pp_trainer.canonical_state(pp_state), log,
+                   block=False)
+        wait_for_checkpoints()
         return pp_state, pp_metrics
     trainer = LMTrainer(model, mesh, tcfg)
     state = trainer.init_state(jax.random.PRNGKey(0))
 
-    from ..train.checkpoint import maybe_resume, maybe_save
+    from ..train.checkpoint import (maybe_resume, maybe_save,
+                                        wait_for_checkpoints)
     resilience = ResilienceContext(
         ResilienceConfig.from_env(train_dir=train_dir,
                                   divergence_k=divergence_k,
@@ -456,7 +468,10 @@ def run_lm_benchmark(
                     f"({eval_steps} batches)")
         finally:
             stream.close()
-        maybe_save(train_dir, state, log)
+        # non-blocking final save: the write overlaps the resilience/
+        # telemetry teardown (and the moe diagnostics probe below); the
+        # join at the end makes it durable before return
+        maybe_save(train_dir, state, log, block=False)
     finally:
         resilience.__exit__(None, None, None)
         wtel.close(close_events=owns_events)
@@ -483,6 +498,7 @@ def run_lm_benchmark(
                 log(f"moe drop rate: {metrics['moe_drop_rate']:.3f}")
         except Exception as exc:  # noqa: BLE001
             log(f"moe drop-rate probe failed: {exc!r}")
+    wait_for_checkpoints()        # join the overlapped final save
     return state, metrics
 
 
@@ -638,7 +654,8 @@ def run_vit_benchmark(
                         image_size=image_size, num_classes=1000)
     trainer = Trainer(model, mesh, cfg)
     state = trainer.init_state(jax.random.PRNGKey(0))
-    from ..train.checkpoint import maybe_resume, maybe_save
+    from ..train.checkpoint import (maybe_resume, maybe_save,
+                                        wait_for_checkpoints)
     wtel, owns_events = _worker_telemetry(metrics_port, event_log,
                                           train_dir, events, log)
     resilience = ResilienceContext(
@@ -670,10 +687,11 @@ def run_vit_benchmark(
         finally:
             if hasattr(dataset, "close"):
                 dataset.close()
-        maybe_save(train_dir, state, log)
+        maybe_save(train_dir, state, log, block=False)
     finally:
         resilience.__exit__(None, None, None)
         wtel.close(close_events=owns_events)
+    wait_for_checkpoints()        # join the overlapped final save
     return state, metrics
 
 
@@ -738,6 +756,13 @@ def main(argv=None) -> int:
                         help="ring collective-matmul TP projections + "
                              "overlapped vocab-parallel loss (needs "
                              "--tp > 1; see README 'TP overlap')")
+    parser.add_argument("--tp-ring", default="uni",
+                        choices=["uni", "bidir"],
+                        help="overlap ring direction: bidir splits each "
+                             "shard in half and rotates the halves in "
+                             "opposite directions — half the bytes per "
+                             "hop on a bidirectional ICI torus (needs "
+                             "--tp-overlap)")
     parser.add_argument("--fused-xent", action="store_true",
                         help="chunked tied-head cross-entropy: the full "
                              "[B*S, vocab] logits never hit HBM - slower "
@@ -867,6 +892,7 @@ def main(argv=None) -> int:
                 flash_block_q=args.flash_block_q or None,
                 flash_block_k=args.flash_block_k or None,
                 tp_overlap=args.tp_overlap,
+                tp_ring=args.tp_ring,
                 accum_steps=args.accum_steps,
                 num_slices=info.num_slices,
                 attention=args.attention, remat=args.remat,

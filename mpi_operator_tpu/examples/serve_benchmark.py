@@ -44,6 +44,7 @@ def run_serving_benchmark(
     kv_cache_dtype: Optional[str] = None,
     decode_kernel: Optional[bool] = None,
     baseline: bool = True,
+    compare_sync: bool = False,
     seed: int = 0,
     profile_dir: Optional[str] = None,
     metrics_port: Optional[int] = None,
@@ -54,6 +55,15 @@ def run_serving_benchmark(
     temperature with top_k=40 (the rest stay greedy) — per-request
     sampling params exercising ONE compiled step; the sequential
     baseline runs each request at its own matching params.
+
+    `compare_sync` re-runs the identical trace through the SAME engine
+    with the double-buffered dispatch disabled (EngineConfig.async_decode
+    = False, reset between — zero extra compiles) and reports the sync
+    throughput, the async speedup (best-of-2 walls per mode, runs
+    alternated — see the inline comment), and a token-identity check
+    over the greedy requests (sampled requests legitimately differ across modes:
+    an EOS retirement costs the async loop one extra dispatched step, so
+    the per-step rng stream shifts).
 
     `profile_dir` captures an XProf trace of the MEASURED trace only
     (warmup excluded, trace serialization after the closing timestamp —
@@ -147,6 +157,13 @@ def run_serving_benchmark(
     # program per bucket; anything beyond that is a recompile leak
     no_recompile = (counts["step"] <= 3
                     and counts["prefill"] <= len(chunk_buckets))
+    # host_gap percentiles BEFORE any sync rerun below touches the same
+    # histogram: these must describe the measured (async) trace only
+    gap50_ms, gap99_ms = None, None
+    gap = wtel.serving.host_gap_seconds
+    if gap.count:
+        gap50_ms = round(gap.percentile(50) * 1e3, 3)
+        gap99_ms = round(gap.percentile(99) * 1e3, 3)
 
     out: Dict[str, object] = {
         "serving_tokens_per_sec": round(tps, 1),
@@ -160,10 +177,13 @@ def run_serving_benchmark(
                                 if tpot[50] is not None else None),
         "serving_tpot_p99_ms": (round(tpot[99] * 1e3, 3)
                                 if tpot[99] is not None else None),
+        "serving_host_gap_p50_ms": gap50_ms,
+        "serving_host_gap_p99_ms": gap99_ms,
         "serving_step_compiles": counts["step"],
         "serving_prefill_compiles": counts["prefill"],
         "serving_no_recompile": bool(no_recompile),
         "serving_decode_kernel": bool(decode_kernel),
+        "serving_async_decode": bool(engine.config.async_decode),
     }
     log(f"serving {name}: {num_requests} reqs over {slots} slots: "
         f"{tps:.0f} new tokens/sec, TTFT p50/p99 "
@@ -171,6 +191,47 @@ def run_serving_benchmark(
         f"TPOT p50/p99 {out['serving_tpot_p50_ms']}/"
         f"{out['serving_tpot_p99_ms']} ms, recompile-free="
         f"{no_recompile}")
+
+    if compare_sync:
+        # the A/B the double-buffered loop has to win: same engine, same
+        # compiled programs, dispatch-then-drain instead of overlap.
+        # Best-of-2 per mode, runs ALTERNATED (sync, async, sync): the
+        # structural win is per-decode-step host time hidden under the
+        # device, a few percent of wall — smaller than single-run noise
+        # on a shared host, and a monotone drift (thermal, competing
+        # load) would otherwise charge one mode for running later. The
+        # measured (telemetry-backed) async wall above is async's first
+        # sample.
+        def timed_run(mode):
+            engine.config.async_decode = mode
+            engine.reset()
+            t0 = time.perf_counter()
+            r = engine.run(trace)
+            return r, time.perf_counter() - t0
+
+        sync_results, sync_wall = timed_run(False)
+        _, async_wall2 = timed_run(True)
+        _, sync_wall2 = timed_run(False)
+        engine.config.async_decode = True
+        sync_total = sum(len(r.tokens) for r in sync_results.values())
+        best_async = min(wall, async_wall2)
+        best_sync = min(sync_wall, sync_wall2)
+        sync_tps = sync_total / best_sync
+        async_tps = total_new / best_async
+        greedy_identical = all(
+            results[r.id].tokens == sync_results[r.id].tokens
+            for r in trace if r.temperature == 0.0)
+        out.update({
+            "serving_sync_tokens_per_sec": round(sync_tps, 1),
+            "serving_sync_wall_seconds": round(best_sync, 3),
+            "serving_async_speedup": (round(async_tps / sync_tps, 3)
+                                      if sync_tps else None),
+            "serving_async_greedy_identical": bool(greedy_identical),
+        })
+        log(f"sync-decode A/B (best-of-2 each): {sync_tps:.0f} sync vs "
+            f"{async_tps:.0f} async new tokens/sec -> "
+            f"{out['serving_async_speedup']}x, greedy token-identical="
+            f"{greedy_identical}")
 
     if baseline:
         # trace-sequential generate(): warm one compile per (P, N, temp)
@@ -225,6 +286,11 @@ def main(argv=None) -> int:
     parser.add_argument("--kv-cache-dtype", default=None,
                         choices=[None, "int8"])
     parser.add_argument("--no-baseline", action="store_true")
+    parser.add_argument("--compare-sync", action="store_true",
+                        help="re-run the trace with async_decode=False "
+                             "through the same engine and report the "
+                             "sync throughput + async speedup + greedy "
+                             "token-identity check")
     parser.add_argument("--seed", type=int, default=0)
     parser.add_argument("--profile-dir", default=None,
                         help="write an XProf trace of the measured trace "
@@ -237,7 +303,8 @@ def main(argv=None) -> int:
         size=args.size, family=args.family, slots=args.slots,
         num_requests=args.num_requests, dtype_name=args.dtype,
         temperature=args.temperature, kv_cache_dtype=args.kv_cache_dtype,
-        baseline=not args.no_baseline, seed=args.seed,
+        baseline=not args.no_baseline, compare_sync=args.compare_sync,
+        seed=args.seed,
         profile_dir=args.profile_dir, metrics_port=args.metrics_port)
     print(json.dumps({"metric": "serving_tokens_per_sec",
                       "value": metrics["serving_tokens_per_sec"],

@@ -108,6 +108,12 @@ class TransformerConfig:
     # has tp>1 and shapes divide (seq, heads, kv_heads, mlp_dim by tp);
     # decode and pipeline-stage bodies always use the oracle path.
     tp_overlap: bool = False
+    # ring schedule for the tp-overlap collective-matmuls: "uni" rotates
+    # each shard whole in one direction (the oracle ring); "bidir" splits
+    # every shard in half and rotates the halves in opposite directions —
+    # half the bytes per hop per direction, both transferring concurrently
+    # on full-duplex ICI links. Numerically identical layouts either way.
+    tp_ring: str = "uni"
     remat: bool = False                # jax.checkpoint each block
     # what remat may KEEP: "none" recomputes everything (min memory, ~2×
     # block fwd recompute); "dots" saves matmul outputs with no batch dims
@@ -183,8 +189,9 @@ def tp_overlap_ring(cfg: "TransformerConfig", mesh, seq_len: int) -> int:
     bodies run under shard_map over pp — nesting another manual region
     over tp there is the oracle path's job). Raises at trace time on
     layouts the ring can't express rather than letting GSPMD produce an
-    opaque placement error: sp>1 (both would shard the sequence dim) and
-    seq_len not divisible by tp (the rotating shards must tile)."""
+    opaque placement error: sp>1 (both would shard the sequence dim). A
+    seq_len not divisible by tp is fine — the overlap bodies zero-pad the
+    sequence up to the next multiple and slice the pad off their output."""
     if not cfg.tp_overlap or cfg.decode or mesh is None:
         return 0
     shape = dict(mesh.shape)
@@ -198,12 +205,21 @@ def tp_overlap_ring(cfg: "TransformerConfig", mesh, seq_len: int) -> int:
             f"tp_overlap=True does not compose with sp={shape['sp']}>1 — "
             f"both shard the sequence dim (the ring rotates seq-over-tp "
             f"shards); set sp=1 or tp_overlap=False")
-    if seq_len % tp:
+    if cfg.tp_ring not in ("uni", "bidir"):
         raise ValueError(
-            f"tp_overlap=True needs seq_len={seq_len} divisible by tp={tp}"
-            f" (the ring rotates one seq shard per rank); pad the sequence"
-            f" or disable tp_overlap")
+            f"tp_ring={cfg.tp_ring!r}; expected 'uni' or 'bidir'")
     return tp
+
+
+def _pad_seq(x, tp, axis=1):
+    """Zero-pad `axis` (the sequence dim) up to the next multiple of tp so
+    shard_map can tile it over the ring; callers slice the pad back off."""
+    pad = (-x.shape[axis]) % tp
+    if not pad:
+        return x
+    widths = [(0, 0)] * x.ndim
+    widths[axis] = (0, pad)
+    return jnp.pad(x, widths)
 
 
 def rope(x, positions, base: float = 10000.0):
@@ -327,11 +343,15 @@ class Attention(nn.Module):
                              ("heads", "kv"), name="value")()
         Hl, KVl = H // tp, KV // tp
 
+        S = x.shape[1]
+        x = _pad_seq(x, tp)
+
         def body(x_l, wq, bq, wk, bk, wv, bv):
             w_cat = jnp.concatenate(
                 [wq.reshape(E, Hl * D), wk.reshape(E, KVl * D),
                  wv.reshape(E, KVl * D)], axis=-1).astype(cfg.dtype)
-            y = allgather_matmul(x_l.astype(cfg.dtype), w_cat, "tp")
+            y = allgather_matmul(x_l.astype(cfg.dtype), w_cat, "tp",
+                                 ring=cfg.tp_ring)
             lead = y.shape[:-1]
             q = y[..., :Hl * D].reshape(lead + (Hl, D)) + bq.astype(cfg.dtype)
             k = (y[..., Hl * D:(Hl + KVl) * D].reshape(lead + (KVl, D))
@@ -350,7 +370,10 @@ class Attention(nn.Module):
                       w_spec, b_spec, w_spec, b_spec, w_spec, b_spec),
             out_specs=(head_spec, head_spec, head_spec),
             check_vma=False)
-        return fn(x, wq, bq, wk, bk, wv, bv)
+        q, k, v = fn(x, wq, bq, wk, bk, wv, bv)
+        if q.shape[1] != S:        # slice the seq pad off the projections
+            q, k, v = q[:, :S], k[:, :S], v[:, :S]
+        return q, k, v
 
     def _overlap_out(self, a, mesh, tp):
         """Row-parallel output projection as a ring matmul_reducescatter:
@@ -370,8 +393,11 @@ class Attention(nn.Module):
 
         def body(a_l, w_l, b):
             flat = a_l.reshape(a_l.shape[:-2] + (Hl * D,)).astype(cfg.dtype)
+            # matmul_reducescatter zero-pads non-divisible rows internally;
+            # the global output then carries the pad rows (sliced below)
             y = matmul_reducescatter(
-                flat, w_l.reshape(Hl * D, E).astype(cfg.dtype), "tp")
+                flat, w_l.reshape(Hl * D, E).astype(cfg.dtype), "tp",
+                ring=cfg.tp_ring)
             return y + b.astype(cfg.dtype)
 
         fn = shard_map(
@@ -382,7 +408,8 @@ class Attention(nn.Module):
                       tp_manual_spec(("embed",))),
             out_specs=tp_overlap_activation_spec(3),
             check_vma=False)
-        return fn(a, wo, bo)
+        y = fn(a, wo, bo)
+        return y[:, :a.shape[1]] if y.shape[1] != a.shape[1] else y
 
     def _decode_attend(self, q, k, v, positions=None):
         """KV-cache attention for autoregressive decoding: append this
@@ -692,20 +719,26 @@ class Mlp(nn.Module):
                              name="fc_out")()
         Ml = M // tp
 
+        S = x.shape[1]
+        x = _pad_seq(x, tp)
+
         def body(x_l, *ws):
             if swiglu:
                 wg_l, bg_l, wi_l, bi_l, wo_l, bo_l = ws
                 w_cat = jnp.concatenate([wg_l, wi_l], -1).astype(cfg.dtype)
-                y = allgather_matmul(x_l.astype(cfg.dtype), w_cat, "tp")
+                y = allgather_matmul(x_l.astype(cfg.dtype), w_cat, "tp",
+                                     ring=cfg.tp_ring)
                 h = (nn.silu(y[..., :Ml] + bg_l.astype(cfg.dtype))
                      * (y[..., Ml:] + bi_l.astype(cfg.dtype)))
             else:
                 wi_l, bi_l, wo_l, bo_l = ws
                 h = nn.gelu(
                     allgather_matmul(x_l.astype(cfg.dtype),
-                                     wi_l.astype(cfg.dtype), "tp")
+                                     wi_l.astype(cfg.dtype), "tp",
+                                     ring=cfg.tp_ring)
                     + bi_l.astype(cfg.dtype))
-            y = matmul_reducescatter(h, wo_l.astype(cfg.dtype), "tp")
+            y = matmul_reducescatter(h, wo_l.astype(cfg.dtype), "tp",
+                                     ring=cfg.tp_ring)
             return y + bo_l.astype(cfg.dtype)
 
         col_specs = (tp_manual_spec(("embed", "mlp")),
@@ -717,7 +750,8 @@ class Mlp(nn.Module):
                        out_specs=tp_overlap_activation_spec(3),
                        check_vma=False)
         args = (x, wg, bg, wi, bi, wo, bo) if swiglu else (x, wi, bi, wo, bo)
-        return fn(*args)
+        y = fn(*args)
+        return y[:, :S] if y.shape[1] != S else y
 
 
 def _layer_norm(cfg, name):

@@ -118,6 +118,17 @@ def _ring_perm(n):
     return [(j, (j + 1) % n) for j in range(n)]
 
 
+def _ring_perm_rev(n):
+    return [(j, (j - 1) % n) for j in range(n)]
+
+
+def _halves(size):
+    """Split a row count for the bidirectional ring: front half rides the
+    forward ring, back half the reverse ring. Front gets the odd row."""
+    back = size // 2
+    return size - back, back
+
+
 def _rows(x, start, size):
     """Slice `size` rows from the second-to-last dim at traced `start`."""
     return lax.dynamic_slice_in_dim(x, start, size, axis=x.ndim - 2)
@@ -189,6 +200,224 @@ def _mrs_fwd_pass(axis_name, x, w):
     acc = acc + jnp.matmul(_rows(x, idx * Sl, Sl), w,
                            preferred_element_type=jnp.float32)
     return acc.astype(out_dtype)
+
+
+# --- bidirectional ring passes ---------------------------------------------
+#
+# Same schedules as above, but each rank's shard is split in half and the
+# halves travel the ring in OPPOSITE directions. Every hop then moves half
+# the bytes, and on full-duplex ICI links both directions transfer
+# concurrently — the exposed per-hop latency halves while the matmul work
+# per step is unchanged (two half-size matmuls). Falls back to the
+# unidirectional pass when a shard is too small to split (1 row) or the
+# ring is trivial (n == 1).
+
+
+def _agm_bidir_fwd_pass(axis_name, x, w):
+    """Bidirectional `all_gather(x, rows) @ w`: front rows rotate forward
+    (after t hops I hold rank (idx-t)'s front half), back rows rotate
+    backward (rank (idx+t)'s back half). Output layout matches the
+    unidirectional pass exactly: rank src's rows land at src*Sl."""
+    n = axis_size(axis_name)
+    Sl = x.shape[-2]
+    Hf, Hb = _halves(Sl)
+    if n == 1 or Hb == 0:
+        return _agm_fwd_pass(axis_name, x, w)
+    idx = lax.axis_index(axis_name)
+    perm_f, perm_b = _ring_perm(n), _ring_perm_rev(n)
+    out_dtype = jnp.promote_types(x.dtype, w.dtype)
+    out0 = _tie(jnp.zeros(x.shape[:-2] + (n * Sl, w.shape[-1]), out_dtype),
+                x, w)
+    xf, xb = _rows(x, 0, Hf), _rows(x, Hf, Hb)
+
+    def place(out, t, xf_t, xb_t):
+        src_f = (idx - t) % n
+        src_b = (idx + t) % n
+        out = lax.dynamic_update_slice_in_dim(
+            out, jnp.matmul(xf_t, w).astype(out_dtype), src_f * Sl,
+            axis=out.ndim - 2)
+        return lax.dynamic_update_slice_in_dim(
+            out, jnp.matmul(xb_t, w).astype(out_dtype), src_b * Sl + Hf,
+            axis=out.ndim - 2)
+
+    def body(t, carry):
+        xf_t, xb_t, out = carry
+        out = place(out, t, xf_t, xb_t)
+        return (lax.ppermute(xf_t, axis_name, perm_f),
+                lax.ppermute(xb_t, axis_name, perm_b), out)
+
+    xf_t, xb_t, out = lax.fori_loop(0, n - 1, body, (xf, xb, out0))
+    return place(out, n - 1, xf_t, xb_t)
+
+
+def _mrs_bidir_fwd_pass(axis_name, x, w):
+    """Bidirectional `reduce_scatter(x @ w, rows)`: one accumulator per
+    half-chunk, rotating in opposite directions, each rank adding its
+    contribution for the destination currently passing through. After n-1
+    hops both accumulators are home; concat rebuilds the local chunk."""
+    n = axis_size(axis_name)
+    Sl = x.shape[-2] // n
+    Hf, Hb = _halves(Sl)
+    if n == 1 or Hb == 0:
+        return _mrs_fwd_pass(axis_name, x, w)
+    idx = lax.axis_index(axis_name)
+    perm_f, perm_b = _ring_perm(n), _ring_perm_rev(n)
+    out_dtype = jnp.promote_types(x.dtype, w.dtype)
+    accA0 = _tie(jnp.zeros(x.shape[:-2] + (Hf, w.shape[-1]), jnp.float32),
+                 x, w)
+    accB0 = _tie(jnp.zeros(x.shape[:-2] + (Hb, w.shape[-1]), jnp.float32),
+                 x, w)
+
+    def add(t, accA, accB):
+        # forward accumulator in hand at step t is bound for (idx-1-t)'s
+        # front rows; the backward one for (idx+1+t)'s back rows
+        dst_a = (idx - 1 - t) % n
+        dst_b = (idx + 1 + t) % n
+        accA = accA + jnp.matmul(_rows(x, dst_a * Sl, Hf), w,
+                                 preferred_element_type=jnp.float32)
+        accB = accB + jnp.matmul(_rows(x, dst_b * Sl + Hf, Hb), w,
+                                 preferred_element_type=jnp.float32)
+        return accA, accB
+
+    def body(t, carry):
+        accA, accB = add(t, *carry)
+        return (lax.ppermute(accA, axis_name, perm_f),
+                lax.ppermute(accB, axis_name, perm_b))
+
+    accA, accB = lax.fori_loop(0, n - 1, body, (accA0, accB0))
+    # home: both accumulators are mine — add my own rows
+    accA = accA + jnp.matmul(_rows(x, idx * Sl, Hf), w,
+                             preferred_element_type=jnp.float32)
+    accB = accB + jnp.matmul(_rows(x, idx * Sl + Hf, Hb), w,
+                             preferred_element_type=jnp.float32)
+    return jnp.concatenate([accA, accB], axis=-2).astype(out_dtype)
+
+
+def _agm_bidir_bwd(axis_name, res, g):
+    """Mirror of _agm_bwd with both rings split: dx follows the
+    bidirectional reduce-scatter schedule over g·wᵀ; dw re-rotates the x
+    halves in opposite directions, accumulating against g's matching
+    row blocks. One fused loop, four ppermutes per step, each half the
+    unidirectional payload."""
+    x, w = res
+    n = axis_size(axis_name)
+    Sl = x.shape[-2]
+    Hf, Hb = _halves(Sl)
+    if n == 1 or Hb == 0:
+        return _agm_bwd(axis_name, res, g)
+    idx = lax.axis_index(axis_name)
+    perm_f, perm_b = _ring_perm(n), _ring_perm_rev(n)
+    K = x.shape[-1]
+    N = w.shape[-1]
+    wt = w.T
+
+    def dw_part(x_t, g_chunk):
+        return jnp.matmul(x_t.reshape(-1, K).T.astype(jnp.float32),
+                          g_chunk.reshape(-1, N).astype(jnp.float32),
+                          preferred_element_type=jnp.float32)
+
+    def accumulate(t, xf_t, xb_t, accA, accB, dw):
+        dst_a = (idx - 1 - t) % n
+        dst_b = (idx + 1 + t) % n
+        accA = accA + jnp.matmul(_rows(g, dst_a * Sl, Hf), wt,
+                                 preferred_element_type=jnp.float32)
+        accB = accB + jnp.matmul(_rows(g, dst_b * Sl + Hf, Hb), wt,
+                                 preferred_element_type=jnp.float32)
+        src_f = (idx - t) % n
+        src_b = (idx + t) % n
+        dw = dw + dw_part(xf_t, _rows(g, src_f * Sl, Hf))
+        dw = dw + dw_part(xb_t, _rows(g, src_b * Sl + Hf, Hb))
+        return accA, accB, dw
+
+    def body(t, carry):
+        xf_t, xb_t, accA, accB, dw = carry
+        accA, accB, dw = accumulate(t, xf_t, xb_t, accA, accB, dw)
+        return (lax.ppermute(xf_t, axis_name, perm_f),
+                lax.ppermute(xb_t, axis_name, perm_b),
+                lax.ppermute(accA, axis_name, perm_f),
+                lax.ppermute(accB, axis_name, perm_b), dw)
+
+    accA0 = _tie(jnp.zeros(x.shape[:-2] + (Hf, K), jnp.float32), g, w)
+    accB0 = _tie(jnp.zeros(x.shape[:-2] + (Hb, K), jnp.float32), g, w)
+    dw0 = _tie(jnp.zeros((K, N), jnp.float32), x, g)
+    xf, xb = _rows(x, 0, Hf), _rows(x, Hf, Hb)
+    xf_t, xb_t, accA, accB, dw = lax.fori_loop(
+        0, n - 1, body, (xf, xb, accA0, accB0, dw0))
+    accA, accB, dw = accumulate(n - 1, xf_t, xb_t, accA, accB, dw)
+    dx = jnp.concatenate([accA, accB], axis=-2)
+    return dx.astype(x.dtype), dw.astype(w.dtype)
+
+
+def _mrs_bidir_bwd(axis_name, res, g):
+    """Mirror of _mrs_bwd with g's halves rotating in opposite directions:
+    dx places g·wᵀ blocks by the bidirectional all-gather schedule; dw
+    accumulates xᵀ·g against the matching x row blocks as g rotates."""
+    x, w = res
+    n = axis_size(axis_name)
+    Sl = g.shape[-2]
+    Hf, Hb = _halves(Sl)
+    if n == 1 or Hb == 0:
+        return _mrs_bwd(axis_name, res, g)
+    idx = lax.axis_index(axis_name)
+    perm_f, perm_b = _ring_perm(n), _ring_perm_rev(n)
+    K = x.shape[-1]
+    N = w.shape[-1]
+    wt = w.T
+    dx0 = _tie(jnp.zeros(x.shape, x.dtype), g, w)
+    dw0 = _tie(jnp.zeros((K, N), jnp.float32), x, g)
+    gf, gb = _rows(g, 0, Hf), _rows(g, Hf, Hb)
+
+    def dw_part(x_chunk, g_t):
+        return jnp.matmul(x_chunk.reshape(-1, K).T.astype(jnp.float32),
+                          g_t.reshape(-1, N).astype(jnp.float32),
+                          preferred_element_type=jnp.float32)
+
+    def step(t, gf_t, gb_t, dx, dw):
+        src_f = (idx - t) % n
+        src_b = (idx + t) % n
+        dx = lax.dynamic_update_slice_in_dim(
+            dx, jnp.matmul(gf_t, wt).astype(x.dtype), src_f * Sl,
+            axis=dx.ndim - 2)
+        dx = lax.dynamic_update_slice_in_dim(
+            dx, jnp.matmul(gb_t, wt).astype(x.dtype), src_b * Sl + Hf,
+            axis=dx.ndim - 2)
+        dw = dw + dw_part(_rows(x, src_f * Sl, Hf), gf_t)
+        dw = dw + dw_part(_rows(x, src_b * Sl + Hf, Hb), gb_t)
+        return dx, dw
+
+    def body(t, carry):
+        gf_t, gb_t, dx, dw = carry
+        dx, dw = step(t, gf_t, gb_t, dx, dw)
+        return (lax.ppermute(gf_t, axis_name, perm_f),
+                lax.ppermute(gb_t, axis_name, perm_b), dx, dw)
+
+    gf_t, gb_t, dx, dw = lax.fori_loop(0, n - 1, body, (gf, gb, dx0, dw0))
+    dx, dw = step(n - 1, gf_t, gb_t, dx, dw)
+    return dx, dw.astype(w.dtype)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(0,))
+def _agm_bidir(axis_name, x, w):
+    return _agm_bidir_fwd_pass(axis_name, x, w)
+
+
+def _agm_bidir_fwd(axis_name, x, w):
+    return _agm_bidir_fwd_pass(axis_name, x, w), (x, w)
+
+
+_agm_bidir.defvjp(_agm_bidir_fwd, _agm_bidir_bwd)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(0,))
+def _mrs_bidir(axis_name, x, w):
+    return _mrs_bidir_fwd_pass(axis_name, x, w)
+
+
+def _mrs_bidir_fwd(axis_name, x, w):
+    return _mrs_bidir_fwd_pass(axis_name, x, w), (x, w)
+
+
+_mrs_bidir.defvjp(_mrs_bidir_fwd, _mrs_bidir_bwd)
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(0,))
@@ -289,7 +518,13 @@ def _mrs_bwd(axis_name, res, g):
 _mrs.defvjp(_mrs_fwd, _mrs_bwd)
 
 
-def allgather_matmul(x, w, axis_name: str = "tp"):
+def _check_ring(name, ring):
+    if ring not in ("uni", "bidir"):
+        raise ValueError(
+            f"{name}: ring must be 'uni' or 'bidir', got {ring!r}")
+
+
+def allgather_matmul(x, w, axis_name: str = "tp", ring: str = "uni"):
     """Overlapped `all_gather(x, rows) @ w` — call INSIDE shard_map over
     `axis_name`.
 
@@ -299,7 +534,12 @@ def allgather_matmul(x, w, axis_name: str = "tp"):
     Returns [..., n·S_local, N_local]: every rank's rows against the local
     columns, with each ppermute hop hidden behind the previous shard's
     matmul. The custom_vjp backward runs the mirrored rings (dx via the
-    reduce-scatter schedule, dw with x re-rotated)."""
+    reduce-scatter schedule, dw with x re-rotated).
+
+    ring='bidir' splits each shard in half and rotates the halves in
+    opposite directions — half the bytes per hop per direction, both
+    transferring concurrently on full-duplex ICI. Numerics and output
+    layout are identical to 'uni' (which stays the oracle)."""
     if x.ndim < 2 or w.ndim != 2:
         raise ValueError(
             f"allgather_matmul: x must be rank>=2 and w rank 2; got "
@@ -308,19 +548,26 @@ def allgather_matmul(x, w, axis_name: str = "tp"):
         raise ValueError(
             f"allgather_matmul: contraction mismatch — x[..., {x.shape[-1]}]"
             f" @ w[{w.shape[0]}, ...] (x last dim must equal w first dim)")
-    return _agm(axis_name, x, w)
+    _check_ring("allgather_matmul", ring)
+    return (_agm_bidir if ring == "bidir" else _agm)(axis_name, x, w)
 
 
-def matmul_reducescatter(x, w, axis_name: str = "tp"):
+def matmul_reducescatter(x, w, axis_name: str = "tp", ring: str = "uni"):
     """Overlapped `reduce_scatter(x @ w, rows)` — call INSIDE shard_map
     over `axis_name`.
 
     x: [..., S, K_local] — rows full, contraction dim locally sharded.
     w: [K_local, N]      — this rank's (row) shard of the weight.
-    Returns [..., S/n, N]: rank r holds rows [r·S/n, (r+1)·S/n) of the
-    full cross-rank sum. The partial-product accumulator for each
-    destination rotates around the ring (f32 accumulation), each add
-    overlapping the next hop. S must divide the ring size."""
+    Returns [..., ceil(S/n), N]: rank r holds rows [r·Sl, (r+1)·Sl) of
+    the full cross-rank sum, Sl = ceil(S/n). When S doesn't divide the
+    ring size the rows are zero-padded up to n·Sl before the ring — the
+    pad rows are exactly zero in the global output (they land on the
+    highest ranks); callers slice the concatenated result back to S.
+    The partial-product accumulator for each destination rotates around
+    the ring (f32 accumulation), each add overlapping the next hop.
+
+    ring='bidir' runs two half-size accumulators in opposite directions
+    (see allgather_matmul); 'uni' stays the oracle."""
     if x.ndim < 2 or w.ndim != 2:
         raise ValueError(
             f"matmul_reducescatter: x must be rank>=2 and w rank 2; got "
@@ -330,13 +577,13 @@ def matmul_reducescatter(x, w, axis_name: str = "tp"):
             f"matmul_reducescatter: contraction mismatch — x[..., "
             f"{x.shape[-1]}] @ w[{w.shape[0]}, ...] (x last dim must equal "
             f"w first dim)")
+    _check_ring("matmul_reducescatter", ring)
     n = axis_size(axis_name)
-    if x.shape[-2] % n:
-        raise ValueError(
-            f"matmul_reducescatter: {x.shape[-2]} rows do not divide over "
-            f"the ring size {n} of axis {axis_name!r}; pad the row dim to "
-            f"a multiple of the tp degree or disable tp_overlap")
-    return _mrs(axis_name, x, w)
+    pad = (-x.shape[-2]) % n
+    if pad:
+        widths = [(0, 0)] * (x.ndim - 2) + [(0, pad), (0, 0)]
+        x = jnp.pad(x, widths)
+    return (_mrs_bidir if ring == "bidir" else _mrs)(axis_name, x, w)
 
 
 # ---------------------------------------------------------------------------

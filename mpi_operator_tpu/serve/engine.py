@@ -20,10 +20,26 @@ model the way a frontend needs it served:
   iteration, interleaved with decode steps — a long prompt cannot stall
   in-flight decodes, and ragged prompt lengths stop forcing per-shape
   recompiles.
+- **Double-buffered decode.** The step's input tokens chain ON DEVICE:
+  a decoding row's next input is the previous step's output for its slot
+  (`jnp.where(use_prev, prev_tok, host_toks)`), so the host never has to
+  read a token to dispatch the next step. `run()` dispatches step N+1
+  BEFORE syncing step N's tokens — host-side scheduling, stream
+  callbacks, EOS/length retirement, and prefill planning all hide under
+  the in-flight device step. Length-finished rows free at DISPATCH time
+  (exhaustion is deterministic host state, no token read needed), so
+  admission runs at full occupancy; only EOS — which the host can't see
+  until the sync — is one step delayed, costing that request a single
+  discarded junk step, and a freed row's junk write is overwritten by
+  its next occupant exactly like a free slot's (slots.py).
+  `EngineConfig.async_decode=False` drains each step before the next
+  dispatch — same compiled program (compile_counts is mode-blind),
+  token-identical at temperature 0, the A/B baseline the serving bench
+  measures against.
 
 Parity: at temperature 0 a single request produces token-for-token the
 same output as `generate()` — tests/test_serve.py pins this across the
-dense and Pallas decode-kernel paths.
+dense and Pallas decode-kernel paths, async and sync.
 """
 from __future__ import annotations
 
@@ -49,11 +65,15 @@ class EngineConfig:
     `chunk_buckets` are the ≤3 compiled prefill widths — cover your
     common prompt lengths with the fewest windows (a prompt of length P
     prefills ceil((P-1)/largest) windows, ragged tail right-aligned).
-    `decode_kernel` None inherits the model config."""
+    `decode_kernel` None inherits the model config. `async_decode`
+    dispatches decode step N+1 before syncing step N's tokens (the
+    double-buffered loop — see the module docstring); False drains every
+    step before the next dispatch, through the same compiled program."""
     slots: int = 8
     chunk_buckets: Tuple[int, ...] = (32, 128, 512)
     decode_kernel: Optional[bool] = None
     rng_seed: int = 0
+    async_decode: bool = True
 
 
 @dataclasses.dataclass
@@ -207,10 +227,15 @@ class ServingEngine:
                     full, r, slot, 0),
                 cache, vars_["cache"])
 
-        def step(params, cache, tokens, positions, rng,
-                 temperature, top_k, top_p, mode):
-            # ONE token for ALL slots: [S] tokens at [S] cursors
+        def step(params, cache, prev_tok, host_toks, use_prev, positions,
+                 rng, temperature, top_k, top_p, mode):
+            # ONE token for ALL slots: [S] tokens at [S] cursors. The
+            # input token per row comes from the DEVICE-side chain
+            # (prev_tok = last step's output, rows with use_prev) or from
+            # the host (bonus token after prefill) — the chain is what
+            # lets the host dispatch step N+1 without reading step N.
             from ..models.transformer import _head_matmul
+            tokens = jnp.where(use_prev, prev_tok, host_toks)
             h, vars_ = dmodel.apply(
                 {"params": params, "cache": cache}, tokens[:, None],
                 positions=positions[:, None], with_head=False,
@@ -223,16 +248,19 @@ class ServingEngine:
         # cache buffers are donated — the engine holds the only live
         # reference, and [SLOTS, KV, L, D] per layer is the biggest
         # allocation here; donation keeps it single-buffered. (CPU has
-        # no donation support and would warn per program.)
+        # no donation support and would warn per program.) prev_tok is
+        # NOT donated: the pending sync still reads its buffer after the
+        # next step consumed it.
         donate = (1,) if jax.default_backend() in ("tpu", "gpu") else ()
         self._init_cache = jax.jit(init_cache)
         self._prefill = jax.jit(prefill, donate_argnums=donate)
         self._step = jax.jit(step, donate_argnums=donate,
-                             static_argnums=(8,))
+                             static_argnums=(10,))
 
         self.scheduler = Scheduler(cfg.chunk_buckets, mcfg.max_len)
         self.slots = SlotManager(S)
         self.cache = self._init_cache(self.params)
+        self._prev_tok = jnp.zeros((S,), jnp.int32)
 
     # -- bookkeeping ------------------------------------------------------
 
@@ -244,6 +272,7 @@ class ServingEngine:
                                    self.model_config.max_len)
         self.slots = SlotManager(self.config.slots)
         self.cache = self._init_cache(self.params)
+        self._prev_tok = jnp.zeros((self.config.slots,), jnp.int32)
         # the per-step rng folds in this counter — rewind it so a reset
         # engine replays a trace with identical draws
         self._steps_dispatched = 0
@@ -278,10 +307,17 @@ class ServingEngine:
             self.telemetry.prefill_seconds.observe(time.perf_counter() - t0)
         st.pos = min(p1, w + size)
 
-    def _run_decode_step(self, now_fn, on_token=None) \
-            -> List[RequestState]:
-        toks, pos, temps, top_ks, top_ps, consumers = \
+    def _dispatch_decode_step(self):
+        """Build the step arrays and dispatch ONE decode step without
+        waiting for its result. Returns the pending sync handle
+        (device token/logprob refs + the consumers at dispatch time),
+        or None when no state is eligible to consume a step. Cursors
+        and dispatch counts advance HERE — they are deterministic, so
+        the host's view stays exact while the tokens are in flight."""
+        toks, pos, use_prev, temps, top_ks, top_ps, consumers = \
             self.slots.step_arrays()
+        if not consumers:
+            return None
         # pick the cheapest step variant the active rows allow (the host
         # knows the sampling params exactly; see sample_slots)
         sampling = [st.req for st in consumers if st.req.temperature > 0.0]
@@ -296,19 +332,50 @@ class ServingEngine:
         step_t0 = time.perf_counter()
         with span("serve.decode_step"):
             self.cache, out_tok, out_logp = self._step(
-                self.params, self.cache, jnp.asarray(toks), jnp.asarray(pos),
+                self.params, self.cache, self._prev_tok,
+                jnp.asarray(toks), jnp.asarray(use_prev), jnp.asarray(pos),
                 rng, jnp.asarray(temps), jnp.asarray(top_ks),
                 jnp.asarray(top_ps), mode)
-            out_tok = np.asarray(out_tok)        # host sync: stream point
-            out_logp = np.asarray(out_logp)
+        self._prev_tok = out_tok                 # the device-side chain
+        for st in consumers:
+            st.pos += 1                          # the step wrote at pos
+            st.dispatched += 1
+            if st.dispatched >= st.req.max_new_tokens:
+                # length exhaustion is known NOW, not at sync: free the
+                # row so the next iteration admits into it — the final
+                # token arrives at this step's sync, which reads the
+                # dispatched snapshot, not the row. A new occupant's
+                # prefill is dispatched after this step, so its writes
+                # land on top of (never under) this request's K/V.
+                self.slots.release(st)
+                st.slot_released = True
+        return out_tok, out_logp, consumers, step_t0
+
+    def _sync_decode_step(self, pending, now_fn, on_token=None) \
+            -> List[RequestState]:
+        """Host-sync a previously dispatched step: fetch its tokens
+        (the only blocking device read in the loop — host_gap_seconds
+        is exactly this wait), stream them, and mark EOS/length
+        retirements. A consumer already done at sync time took its
+        one post-EOS junk step; its junk token is discarded here."""
+        dev_tok, dev_logp, consumers, step_t0 = pending
         tel = self.telemetry
+        gap_t0 = time.perf_counter()
+        out_tok = np.asarray(dev_tok)            # host sync: stream point
+        out_logp = np.asarray(dev_logp)
+        t_sync = time.perf_counter()
         if tel is not None:
-            # the np.asarray host read above IS the device barrier, so
-            # this wall time is a true decode step time
-            tel.decode_step_seconds.observe(time.perf_counter() - step_t0)
+            # how long the host was BLOCKED on the device — near zero
+            # when the dispatched work fully hides under host scheduling
+            tel.host_gap_seconds.observe(t_sync - gap_t0)
+            # dispatch → sync: the effective per-step latency (in async
+            # mode this spans the loop iteration that hid under it)
+            tel.decode_step_seconds.observe(t_sync - step_t0)
         now = now_fn()
         finished = []
         for st in consumers:
+            if st.done:
+                continue
             t = int(out_tok[st.slot])
             if tel is not None:
                 if st.token_times:
@@ -316,7 +383,6 @@ class ServingEngine:
                 else:
                     tel.ttft_seconds.observe(now - st.req.arrival)
                 tel.tokens_total.inc()
-            st.pos += 1                          # the step wrote at pos
             st.next_input = t
             st.generated.append(t)
             st.logprobs.append(float(out_logp[st.slot]))
@@ -343,7 +409,35 @@ class ServingEngine:
         now_fn = lambda: time.perf_counter() - t0   # noqa: E731
         results: Dict[int, RequestResult] = {}
         tel = self.telemetry
-        while not self.scheduler.idle:
+
+        def retire(finished: List[RequestState]) -> None:
+            for st in finished:
+                self.scheduler.retire(st)
+                if not st.slot_released:      # EOS path: freed here; the
+                    self.slots.release(st)    # length path freed its row
+                    st.slot_released = True   # at dispatch already
+                if self.events is not None:
+                    self.events.emit(
+                        ev.SLOT_RETIRE, request=st.req.id, slot=st.slot,
+                        finish_reason=st.finish_reason,
+                        new_tokens=len(st.generated))
+                if tel is not None:
+                    tel.requests_total.inc()
+                results[st.req.id] = RequestResult(
+                    id=st.req.id, tokens=list(st.generated),
+                    logprobs=list(st.logprobs),
+                    finish_reason=st.finish_reason,
+                    ttft=st.token_times[0] - st.req.arrival,
+                    token_times=list(st.token_times))
+
+        # the double buffer: the step whose tokens are still on the
+        # device. Each iteration dispatches step N+1 FIRST, then syncs
+        # step N — admission/retirement/prefill planning all happen
+        # while the dispatched step runs, and a slot retired at step N
+        # stays masked until step N+1's dispatch already consumed the
+        # old occupancy (the one-step-lagged lifecycle).
+        pending = None
+        while not (self.scheduler.idle and pending is None):
             now = now_fn()
             with span("serve.schedule"):
                 for st in self.scheduler.admit(self.slots.free, now):
@@ -357,7 +451,7 @@ class ServingEngine:
                 tel.slot_occupancy.set(self.slots.occupied)
             # nothing resident yet and the next arrival is in the
             # future: sleep up to it instead of spinning
-            if self.slots.occupied == 0:
+            if self.slots.occupied == 0 and pending is None:
                 nxt = self.scheduler.next_arrival()
                 if nxt is not None and nxt > now_fn():
                     time.sleep(min(nxt - now_fn(), 0.05))
@@ -365,23 +459,17 @@ class ServingEngine:
             st = self.scheduler.next_prefill()
             if st is not None:
                 self._run_prefill_chunk(st)
-            if self.scheduler.decoding():
-                for st in self._run_decode_step(now_fn, on_token):
-                    self.scheduler.retire(st)
-                    self.slots.release(st)
-                    if self.events is not None:
-                        self.events.emit(
-                            ev.SLOT_RETIRE, request=st.req.id, slot=st.slot,
-                            finish_reason=st.finish_reason,
-                            new_tokens=len(st.generated))
-                    if tel is not None:
-                        tel.requests_total.inc()
-                    results[st.req.id] = RequestResult(
-                        id=st.req.id, tokens=list(st.generated),
-                        logprobs=list(st.logprobs),
-                        finish_reason=st.finish_reason,
-                        ttft=st.token_times[0] - st.req.arrival,
-                        token_times=list(st.token_times))
+            new_pending = (self._dispatch_decode_step()
+                           if self.scheduler.decoding() else None)
+            if pending is not None:
+                retire(self._sync_decode_step(pending, now_fn, on_token))
+                pending = None
+            if self.config.async_decode:
+                pending = new_pending
+            elif new_pending is not None:
+                # sync mode: same compiled step, fetched immediately
+                retire(self._sync_decode_step(new_pending, now_fn,
+                                              on_token))
         if tel is not None:
             counts = self.compile_counts()
             tel.step_compiles.set(counts["step"])

@@ -48,6 +48,18 @@ class RequestState:
     pos: int = 0
     chunks: List[Tuple[int, int]] = dataclasses.field(default_factory=list)
     next_input: int = 0
+    # decode steps DISPATCHED for this request (>= len(generated): with
+    # the async engine the newest step's token is still on the device).
+    # dispatched >= 1 means the next step chains its input from the
+    # previous step's device output (slots.step_arrays use_prev); once
+    # dispatched reaches max_new_tokens the request stops consuming
+    # steps and retires at the next sync.
+    dispatched: int = 0
+    # slot row already returned to the free pool (length exhaustion is
+    # known at DISPATCH time, so the engine frees the row before the
+    # final sync delivers the last token — the guard keeps the sync-side
+    # retirement from releasing a row that may already be re-bound)
+    slot_released: bool = False
     generated: List[int] = dataclasses.field(default_factory=list)
     logprobs: List[float] = dataclasses.field(default_factory=list)
     token_times: List[float] = dataclasses.field(default_factory=list)

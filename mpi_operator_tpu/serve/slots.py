@@ -47,15 +47,28 @@ class SlotManager:
         return self.n - len(self.free)
 
     def step_arrays(self):
-        """The decode step's host-built inputs: tokens, cursors, and
-        per-slot sampling params, plus which states actually consume
-        this step's samples. Slots mid-prefill or free still get a row
-        (the step is fixed-shape): their position is their own next
+        """The decode step's host-built inputs: tokens, cursors, use_prev
+        flags, and per-slot sampling params, plus which states actually
+        consume this step's samples. Slots mid-prefill or free still get
+        a row (the step is fixed-shape): their position is their own next
         write offset, so the one junk K/V they write lands exactly
         where the next real write (chunk or cursor) overwrites it, and
-        their sampled token is simply discarded."""
+        their sampled token is simply discarded.
+
+        use_prev marks rows whose input token is the PREVIOUS step's
+        device output for the same slot (st.dispatched >= 1: a decoding
+        slot consumes every subsequent step, so the previous step's row
+        is guaranteed to be its token) — the device-side chain that lets
+        the engine dispatch step N+1 before step N's tokens reach the
+        host. Rows with use_prev False read the host token (the bonus
+        token after prefill). States that have dispatched all
+        max_new_tokens steps stop consuming: the engine already returned
+        their row to the free pool at dispatch time (slot_released), so
+        a drained state still tracked here is skipped — only the final
+        sync's bookkeeping remains for it."""
         toks = np.zeros((self.n,), np.int32)
         pos = np.zeros((self.n,), np.int32)
+        use_prev = np.zeros((self.n,), bool)
         temps = np.zeros((self.n,), np.float32)
         top_ks = np.zeros((self.n,), np.int32)
         top_ps = np.ones((self.n,), np.float32)
@@ -63,15 +76,18 @@ class SlotManager:
         for st in self.states:
             if st is None:
                 continue
+            if not st.prefilling and st.dispatched >= st.req.max_new_tokens:
+                continue                  # drained: awaiting final sync
             pos[st.slot] = st.pos
             if st.prefilling:
                 continue
             toks[st.slot] = st.next_input
+            use_prev[st.slot] = st.dispatched >= 1
             temps[st.slot] = st.req.temperature
             top_ks[st.slot] = st.req.top_k
             top_ps[st.slot] = st.req.top_p
             consumers.append(st)
-        return toks, pos, temps, top_ks, top_ps, consumers
+        return toks, pos, use_prev, temps, top_ks, top_ps, consumers
 
 
 __all__ = ["SlotManager"]

@@ -31,9 +31,20 @@ and events.py (fsync'd JSONL for discrete events).
 """
 from __future__ import annotations
 
+import os
 import threading
 from bisect import bisect_left
 from typing import Dict, Iterable, List, Optional, Tuple
+
+
+def _env_float(name: str) -> Optional[float]:
+    v = os.environ.get(name, "").strip()
+    if not v:
+        return None
+    try:
+        return float(v)
+    except ValueError:
+        raise ValueError(f"{name}={v!r} is not a number") from None
 
 
 def _label_key(labels: Optional[Dict[str, str]]) -> Tuple:
@@ -109,6 +120,14 @@ class Histogram:
     estimate with relative error bounded by the edge ratio (~26% at the
     default resolution), which is what a p50/p99 summary needs; exact
     quantiles would require keeping every sample.
+
+    Deploy-time overrides: ``TPU_HIST_LO``, ``TPU_HIST_HI`` (seconds) and
+    ``TPU_HIST_PER_DECADE`` (int) replace the constructor's range/
+    resolution for EVERY histogram in the process — the operator knob for
+    re-ranging telemetry on hardware whose latencies fall off the baked-in
+    edges (e.g. sub-10 µs decode steps, or coarser buckets to shrink
+    scrape payloads) without touching call sites. Unset or empty
+    variables leave the code-specified values alone.
     """
 
     kind = "histogram"
@@ -117,6 +136,15 @@ class Histogram:
                  lo: float = 1e-4, hi: float = 1e3,
                  per_decade: int = 10,
                  labels: Optional[Dict[str, str]] = None):
+        env_lo = _env_float("TPU_HIST_LO")
+        env_hi = _env_float("TPU_HIST_HI")
+        env_pd = _env_float("TPU_HIST_PER_DECADE")
+        if env_lo is not None:
+            lo = env_lo
+        if env_hi is not None:
+            hi = env_hi
+        if env_pd is not None:
+            per_decade = int(env_pd)
         if not (0 < lo < hi):
             raise ValueError(f"need 0 < lo < hi, got lo={lo} hi={hi}")
         if per_decade < 1:

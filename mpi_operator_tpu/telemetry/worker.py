@@ -12,6 +12,9 @@ Train series (LMTrainer / Trainer / PipelineLMTrainer benchmark loops):
   examples_per_sec        gauge     — last-window image throughput
   mfu                     gauge     — model FLOPs utilization, 0-1
   goodput                 gauge     — productive / total steps, 0-1
+  host_gap_seconds        histogram — host blocked-on-device time per
+                                      window fetch (how much of the step
+                                      the async dispatch did NOT hide)
   steps_total             counter   — steps executed
   skipped_steps_total     counter   — divergence-guard skipped (lower
                                       bound: streaks are sampled at
@@ -24,8 +27,12 @@ Serve series (ServingEngine):
   tpot_seconds            histogram — inter-token gap per slot
   prefill_seconds         histogram — prefill chunk dispatch (async: host
                                       wall time, not device time)
-  decode_step_seconds     histogram — decode step incl. token sync (the
-                                      host read IS the device barrier)
+  decode_step_seconds     histogram — decode step dispatch → token sync
+                                      (async: spans the loop iteration
+                                      that hid under it)
+  host_gap_seconds        histogram — host blocked on the device token
+                                      read per step (≈0 when the decode
+                                      fully hides under host scheduling)
   queue_depth             gauge     — requests waiting for a slot
   slot_occupancy          gauge     — slots currently bound
   slots                   gauge     — configured slot count
@@ -52,6 +59,10 @@ class TrainTelemetry:
         self.registry = reg
         self.step_seconds = reg.histogram(
             "tpu_worker_step_seconds", "per-step wall time (seconds)")
+        self.host_gap_seconds = reg.histogram(
+            "tpu_worker_host_gap_seconds",
+            "host blocked-on-device time at window fetches",
+            lo=1e-5, hi=1e3)
         self.tokens_per_sec = reg.gauge(
             "tpu_worker_tokens_per_sec", "last-window LM tokens/sec")
         self.examples_per_sec = reg.gauge(
@@ -135,6 +146,18 @@ class TrainTelemetry:
         to_ms = lambda v: None if v is None else v * 1e3  # noqa: E731
         return to_ms(p50), to_ms(p99)
 
+    def host_gap_percentiles_ms(self):
+        """(p50, p99) host blocked-on-device time in milliseconds, Nones
+        when empty. One observation per window fetch: the wall time of
+        the device read that closes the window — everything else in the
+        loop body is async dispatch, so this is the only place the host
+        actually waits and the honest measure of how much step time the
+        dispatch pipeline failed to hide."""
+        p50 = self.host_gap_seconds.percentile(50)
+        p99 = self.host_gap_seconds.percentile(99)
+        to_ms = lambda v: None if v is None else v * 1e3  # noqa: E731
+        return to_ms(p50), to_ms(p99)
+
 
 class ServeTelemetry:
     """Serving-engine instruments over a shared registry."""
@@ -154,7 +177,10 @@ class ServeTelemetry:
             "prefill chunk host dispatch time (async)")
         self.decode_step_seconds = hist(
             "tpu_worker_decode_step_seconds",
-            "decode step wall time incl. token sync")
+            "decode step wall time, dispatch to token sync")
+        self.host_gap_seconds = hist(
+            "tpu_worker_host_gap_seconds",
+            "host blocked on the device token read per step")
         self.queue_depth = reg.gauge(
             "tpu_worker_queue_depth", "requests waiting for a slot")
         self.slot_occupancy = reg.gauge(

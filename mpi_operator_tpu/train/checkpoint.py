@@ -214,7 +214,7 @@ def maybe_resume(train_dir, state, log=print):
     return state
 
 
-def maybe_save(train_dir, state, log=print):
+def maybe_save(train_dir, state, log=print, block: bool = True):
     """Write a checkpoint when train_dir is set (collective across all
     processes — see examples/benchmark.py for why every rank must call).
     Skips the write when THIS process already saved this step (the
@@ -223,15 +223,22 @@ def maybe_save(train_dir, state, log=print):
     destroy the newest checkpoint for nothing. The skip decision uses the
     in-process _LAST_SAVED pair, replicated across ranks by construction
     (same hook sequence everywhere) — NEVER the local filesystem, which
-    diverges on per-host paths and would deadlock the collective."""
+    diverges on per-host paths and would deadlock the collective.
+
+    block=True (the default) returns with the write committed — what the
+    emergency path needs (resilience.emergency_save runs under a SIGTERM
+    grace window; returning before commit would let the pod die with a
+    torn tmp directory). Benchmark exits pass block=False to overlap the
+    final write with teardown and join once via wait_for_checkpoints()."""
     if not train_dir:
         return
     step = int(state.step)
     if _LAST_SAVED.get(os.path.abspath(train_dir)) == step:
-        wait_for_checkpoints()                # join the in-flight write
+        if block:
+            wait_for_checkpoints()            # join the in-flight write
         log(f"checkpoint for step {step} already written")
         return
-    path = save_checkpoint(train_dir, state)
+    path = save_checkpoint(train_dir, state, block=block)
     log(f"checkpoint written to {path}")
 
 
@@ -281,10 +288,16 @@ def periodic_saver(train_dir, every: int, log=print, keep_last: int = 0):
 
     def hook(state, step: int) -> None:
         if step % every == 0:
+            # join the PREVIOUS write before gc'ing or dispatching the
+            # next one: near-free (it had `every` steps to finish), and
+            # it guarantees the newest committed checkpoint exists before
+            # gc deletes older ones — gc must never race an in-flight
+            # write it cannot see (tmp-named until commit)
+            wait_for_checkpoints()
+            if keep_last > 0:
+                gc_checkpoints(train_dir, keep_last, log)
             # explicit step: save_checkpoint(step=None) would host-read
             # state.step, a device sync the training loop must not pay
             path = save_checkpoint(train_dir, state, step=step, block=False)
             log(f"async checkpoint -> {path}")
-            if keep_last > 0:
-                gc_checkpoints(train_dir, keep_last, log)
     return hook

@@ -173,7 +173,7 @@ def fused_lm_loss(h, table, targets, mask=None, num_chunks: int = 8,
 
 
 def tp_overlap_lm_loss(h, table, targets, mask, mesh, num_chunks: int = 8,
-                       denom=None):
+                       denom=None, ring: str = "uni"):
     """fused_lm_loss with the logits matmul VOCAB-PARALLEL and overlapped:
     one manual region over the whole chunk scan where h enters seq-over-tp
     sharded and each chunk's logits tile is a ring
@@ -188,8 +188,12 @@ def tp_overlap_lm_loss(h, table, targets, mask, mesh, num_chunks: int = 8,
     Megatron vocab-parallel cross-entropy, in autodiff form. Numerically
     equals fused_lm_loss / lm_loss to accumulation-order tolerance.
 
-    Requires vocab and seq divisible by the mesh's tp degree (raises with
-    the fix otherwise); trainers gate on TransformerConfig.tp_overlap."""
+    Vocab/seq not divisible by the tp degree are zero-padded up to the
+    next multiple (pad seq rows carry mask 0, pad vocab columns are forced
+    to -inf logits before the normalizer) — the loss is exactly the
+    unpadded one; trainers gate on TransformerConfig.tp_overlap.
+    `ring` selects the collective-matmul schedule ('uni'/'bidir' — see
+    parallel/collectives.py); both are numerically identical."""
     from ..parallel.collectives import allgather_matmul
     from ..parallel.sharding import (tp_manual_spec,
                                      tp_overlap_activation_spec)
@@ -198,20 +202,22 @@ def tp_overlap_lm_loss(h, table, targets, mask, mesh, num_chunks: int = 8,
     B, S, E = h.shape
     V = table.shape[0]
     tp = dict(mesh.shape).get("tp", 1)
-    if V % tp:
-        raise ValueError(
-            f"tp_overlap=True needs vocab_size={V} divisible by tp={tp} "
-            f"(the table's vocab rows are the ring's stationary shards); "
-            f"pad the vocab (model configs pad to a multiple of 128) or "
-            f"disable tp_overlap")
-    if S % tp:
-        raise ValueError(
-            f"tp_overlap=True needs seq_len={S} divisible by tp={tp} (the "
-            f"ring rotates one seq shard per rank); pad the sequence or "
-            f"disable tp_overlap")
     if mask is None:
         mask = jnp.ones((B, S), jnp.float32)
     mask = mask.astype(jnp.float32)
+    pad_s = (-S) % tp
+    if pad_s:
+        # pad rows: zero hidden, target 0 (any valid id), mask 0 — they
+        # contribute nothing to the loss or the denominator
+        h = jnp.pad(h, ((0, 0), (0, pad_s), (0, 0)))
+        targets = jnp.pad(targets, ((0, 0), (0, pad_s)))
+        mask = jnp.pad(mask, ((0, 0), (0, pad_s)))
+        S += pad_s
+    pad_v = (-V) % tp
+    if pad_v:
+        # pad vocab rows are zeros; their logit columns are masked to -inf
+        # inside the chunk so they never enter the softmax normalizer
+        table = jnp.pad(table, ((0, pad_v), (0, 0)))
     Sl = S // tp
     nc = math.gcd(num_chunks, Sl)
     Cl = Sl // nc
@@ -231,7 +237,10 @@ def tp_overlap_lm_loss(h, table, targets, mask, mesh, num_chunks: int = 8,
             h_c, t_c, m_c = xs                           # [Bl, Cl, ...]
             # [Bl, tp·Cl, Vl]: every rank's chunk rows × my vocab columns;
             # row placement (src·Cl) matches the tiled all_gather below
-            logits = allgather_matmul(h_c, wt, "tp")
+            logits = allgather_matmul(h_c, wt, "tp", ring=ring)
+            if pad_v:
+                cols = offset + jnp.arange(Vl)
+                logits = jnp.where(cols < V, logits, -1e30)
             t_g = lax.all_gather(t_c, "tp", axis=1, tiled=True)
             # vocab-parallel softmax-xent: max/normalizer/target-pick each
             # completed across the vocab shards with one collective
@@ -378,9 +387,10 @@ class LMTrainer:
                 {"params": params}, tokens, with_head=False,
                 mutable=["intermediates"])
             if self._use_overlap_loss():
+                ring = getattr(self.model.config, "tp_ring", "uni")
                 loss = tp_overlap_lm_loss(h, params["wte"]["embedding"],
                                           targets, mask, self.mesh,
-                                          denom=denom)
+                                          denom=denom, ring=ring)
             else:
                 loss = fused_lm_loss(h, params["wte"]["embedding"], targets,
                                      mask, denom=denom)
@@ -595,8 +605,10 @@ class LMTrainer:
                     resilience.emergency_save(state)
                     raise Preempted(base_step + i)
                 if i % log_every == 0:
-                    loss = float(metrics["loss"])
+                    g0 = time.perf_counter()
+                    loss = float(metrics["loss"])  # the window's one sync
                     t1 = time.perf_counter()       # BEFORE the trace write
+                    tel.host_gap_seconds.observe(t1 - g0)
                     profiler.stop_if_active()
                     tps = tokens_per_step * log_every / (t1 - t0)
                     windows.append(tps)
@@ -621,6 +633,7 @@ class LMTrainer:
         stats = flops.throughput_stats(flops_per_step,
                                        tps / tokens_per_step, n)
         p50_ms, p99_ms = tel.step_percentiles_ms()
+        gap50_ms, gap99_ms = tel.host_gap_percentiles_ms()
         log("-" * 40)
         log(f"total tokens/sec: {tps:.0f}")
         if p50_ms is not None:
@@ -637,6 +650,8 @@ class LMTrainer:
             "final_loss": float(metrics["loss"]),
             "step_time_p50_ms": p50_ms,
             "step_time_p99_ms": p99_ms,
+            "host_gap_p50_ms": gap50_ms,
+            "host_gap_p99_ms": gap99_ms,
             "goodput": tel.goodput.value,
             **stats,
         }

@@ -476,8 +476,10 @@ class PipelineLMTrainer:
                     f"{base_step + i}")
                 resilience.emergency_save(self.canonical_state(state))
                 raise Preempted(base_step + i)
+        g0 = time.perf_counter()
         final_loss = float(metrics["loss"])         # host read barrier
         dt = time.perf_counter() - t0
+        tel.host_gap_seconds.observe(time.perf_counter() - g0)
         tps = tokens_per_step * num_steps / dt
         n = self.mesh.size
         num_params = flops.param_count(state.params)
@@ -489,6 +491,7 @@ class PipelineLMTrainer:
         tel.observe_steps(dt / num_steps, num_steps)
         tel.update_window(tokens_per_sec=tps, mfu=stats["mfu"])
         p50_ms, p99_ms = tel.step_percentiles_ms()
+        gap50_ms, gap99_ms = tel.host_gap_percentiles_ms()
         log(f"pp={self.pp} M={self.num_microbatches} "
             f"schedule={self.schedule}"
             + (f"×{self.interleave}" if self.interleave > 1 else "")
@@ -504,6 +507,8 @@ class PipelineLMTrainer:
                        "bubble_fraction": self.bubble,
                        "step_time_p50_ms": p50_ms,
                        "step_time_p99_ms": p99_ms,
+                       "host_gap_p50_ms": gap50_ms,
+                       "host_gap_p99_ms": gap99_ms,
                        **stats, **extra}
 
 

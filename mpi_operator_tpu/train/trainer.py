@@ -262,8 +262,10 @@ class Trainer:
                     resilience.emergency_save(state)
                     raise Preempted(base_step + i)
                 if i % log_every == 0:
+                    g0 = time.perf_counter()
                     loss = float(metrics["loss"])  # sync: closes the window
                     t1 = time.perf_counter()       # BEFORE the trace write
+                    tel.host_gap_seconds.observe(t1 - g0)
                     profiler.stop_if_active()
                     ips = self.config.global_batch_size * log_every \
                         / (t1 - t0)
@@ -295,6 +297,7 @@ class Trainer:
         stats = flops.throughput_stats(
             flops_per_step, total_ips / self.config.global_batch_size, n)
         p50_ms, p99_ms = tel.step_percentiles_ms()
+        gap50_ms, gap99_ms = tel.host_gap_percentiles_ms()
         log("-" * 40)
         log(f"total images/sec: {total_ips:.2f}")   # ref README.md:127-131
         if p50_ms is not None:
@@ -312,6 +315,8 @@ class Trainer:
             "final_loss": final_loss,
             "step_time_p50_ms": p50_ms,
             "step_time_p99_ms": p99_ms,
+            "host_gap_p50_ms": gap50_ms,
+            "host_gap_p99_ms": gap99_ms,
             "goodput": tel.goodput.value,
             **stats,
         }

@@ -204,3 +204,58 @@ def test_periodic_async_checkpointing(tmp_path):
     assert "step_7" in logs[-1] and "already" not in logs[-1]
     maybe_save(str(tmp_path), state, log=logs.append)   # step 7 again
     assert "already written" in logs[-1]
+
+
+def test_nonblocking_periodic_saves_gc_and_restore_exact(tmp_path):
+    """The non-blocking hook's join -> gc -> dispatch ordering: back-to-
+    back firings with keep_last=1 never delete the newest committed
+    checkpoint out from under the in-flight write, every surviving
+    step_N is intact, and the final restore is bit-identical to the
+    state that was saved."""
+    import optax
+
+    from mpi_operator_tpu.models.transformer import CausalLM, gpt2_config
+    from mpi_operator_tpu.train import LMTrainer, LMTrainerConfig
+    from mpi_operator_tpu.train.checkpoint import (
+        maybe_save, periodic_saver, verify_checkpoint,
+        wait_for_checkpoints)
+
+    cfg = gpt2_config("test", attention="dense", dtype=jnp.float32,
+                      vocab_size=64, max_len=16)
+    tr = LMTrainer(CausalLM(cfg), make_mesh(MeshConfig(dp=8)),
+                   LMTrainerConfig(global_batch_size=8, seq_len=8),
+                   tx=optax.sgd(0.1))
+    state = tr.init_state(jax.random.PRNGKey(0))
+
+    hook = periodic_saver(str(tmp_path), every=1, log=lambda s: None,
+                          keep_last=1)
+    # fire WITHOUT intervening waits — each firing joins the previous
+    # write itself before gc runs, so no gc can see a half-written dir
+    for step in range(1, 6):
+        hook(state.replace(step=jnp.asarray(step)), step)
+    wait_for_checkpoints()
+    steps = sorted(int(p.name[5:]) for p in tmp_path.iterdir()
+                   if p.name.startswith("step_"))
+    # keep_last=1 gc runs BEFORE each dispatch, so the previous step
+    # survives alongside the newest: {4, 5} after five firings
+    assert steps == [4, 5], steps
+    for s in steps:
+        assert verify_checkpoint(str(tmp_path / f"step_{s}"))
+    restored = restore_checkpoint(str(tmp_path / "step_5"),
+                                  tr.init_state(jax.random.PRNGKey(3)))
+    assert int(restored.step) == 5
+    for a, b in zip(jax.tree.leaves(restored.params),
+                    jax.tree.leaves(state.params)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    # the benchmark-exit path: maybe_save(block=False) overlaps the
+    # final write; after the explicit join it restores bit-identical too
+    final = state.replace(step=jnp.asarray(9))
+    maybe_save(str(tmp_path), final, log=lambda s: None, block=False)
+    wait_for_checkpoints()
+    back = restore_checkpoint(str(tmp_path / "step_9"),
+                              tr.init_state(jax.random.PRNGKey(4)))
+    assert int(back.step) == 9
+    for a, b in zip(jax.tree.leaves(back.params),
+                    jax.tree.leaves(final.params)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))

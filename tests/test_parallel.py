@@ -1181,12 +1181,16 @@ class TestRingCollectiveMatmul:
     The ring decomposition (ppermute hops hidden behind per-shard matmuls)
     must be a pure re-schedule: same values forward AND backward, where the
     backward runs the mirrored ring via custom_vjp. Cotangents come from a
-    nonlinear loss so each output element gets a distinct pullback."""
+    nonlinear loss so each output element gets a distinct pullback.
+    ring="bidir" halves each shard and rotates the halves in opposite
+    directions (half the bytes per hop); with Sl=2 on the 4-ring below the
+    halves are 1+1, so the odd-split arithmetic is exercised too."""
 
     def _mesh(self):
         return make_mesh(MeshConfig(dp=2, tp=4))
 
-    def test_allgather_matmul_matches_einsum(self):
+    @pytest.mark.parametrize("tp_ring", ["uni", "bidir"])
+    def test_allgather_matmul_matches_einsum(self, tp_ring):
         from mpi_operator_tpu.parallel.collectives import allgather_matmul
         from mpi_operator_tpu.utils.compat import shard_map
 
@@ -1196,7 +1200,7 @@ class TestRingCollectiveMatmul:
         w = jax.random.normal(k1, (16, 12), jnp.float32)      # cols over tp
 
         ring = shard_map(
-            lambda xl, wl: allgather_matmul(xl, wl, "tp"),
+            lambda xl, wl: allgather_matmul(xl, wl, "tp", ring=tp_ring),
             mesh=mesh,
             in_specs=(P("dp", "tp", None), P(None, "tp")),
             out_specs=P("dp", None, "tp"), check_vma=False)
@@ -1215,7 +1219,8 @@ class TestRingCollectiveMatmul:
             np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                        atol=1e-5, rtol=1e-5)
 
-    def test_matmul_reducescatter_matches_einsum(self):
+    @pytest.mark.parametrize("tp_ring", ["uni", "bidir"])
+    def test_matmul_reducescatter_matches_einsum(self, tp_ring):
         from mpi_operator_tpu.parallel.collectives import matmul_reducescatter
         from mpi_operator_tpu.utils.compat import shard_map
 
@@ -1225,7 +1230,7 @@ class TestRingCollectiveMatmul:
         w = jax.random.normal(k1, (16, 12), jnp.float32)      # rows over tp
 
         ring = shard_map(
-            lambda xl, wl: matmul_reducescatter(xl, wl, "tp"),
+            lambda xl, wl: matmul_reducescatter(xl, wl, "tp", ring=tp_ring),
             mesh=mesh,
             in_specs=(P("dp", None, "tp"), P("tp", None)),
             out_specs=P("dp", "tp", None), check_vma=False)
@@ -1244,22 +1249,41 @@ class TestRingCollectiveMatmul:
             np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                        atol=1e-5, rtol=1e-5)
 
-    def test_non_divisible_rows_rejected(self):
-        """S=6 cannot reduce-scatter over a 4-ring: a clear ValueError at
-        trace time, not a wrong-shaped output."""
+    @pytest.mark.parametrize("tp_ring", ["uni", "bidir"])
+    def test_non_divisible_rows_padded(self, tp_ring):
+        """S=6 over a 4-ring: the internal zero-row pad takes it to 8,
+        pad rows land at the END of the global output (highest ranks) as
+        exact zeros, and grads flow correctly through the caller's
+        slice."""
         from mpi_operator_tpu.parallel.collectives import matmul_reducescatter
         from mpi_operator_tpu.utils.compat import shard_map
 
         mesh = self._mesh()
-        x = jnp.ones((6, 16), jnp.float32)
-        w = jnp.ones((16, 12), jnp.float32)
+        k0, k1 = jax.random.split(jax.random.PRNGKey(3))
+        x = jax.random.normal(k0, (6, 16), jnp.float32)
+        w = jax.random.normal(k1, (16, 12), jnp.float32)
         f = shard_map(
-            lambda xl, wl: matmul_reducescatter(xl, wl, "tp"),
+            lambda xl, wl: matmul_reducescatter(xl, wl, "tp", ring=tp_ring),
             mesh=mesh,
             in_specs=(P(None, "tp"), P("tp", None)),
             out_specs=P("tp", None), check_vma=False)
-        with pytest.raises(ValueError, match="do not divide over the ring"):
-            f(x, w)
+        out = f(x, w)
+        assert out.shape == (8, 12)              # 4 * ceil(6/4)
+        np.testing.assert_allclose(np.asarray(out[:6]), np.asarray(x @ w),
+                                   atol=1e-5)
+        assert np.all(np.asarray(out[6:]) == 0.0)
+
+        def loss_ring(x, w):
+            return jnp.sin(f(x, w)[:6]).sum()    # caller slices the pad
+
+        def loss_ref(x, w):
+            return jnp.sin(x @ w).sum()
+
+        g_ring = jax.jit(jax.grad(loss_ring, argnums=(0, 1)))(x, w)
+        g_ref = jax.jit(jax.grad(loss_ref, argnums=(0, 1)))(x, w)
+        for a, b in zip(g_ring, g_ref):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       atol=1e-5, rtol=1e-5)
 
     def test_contraction_mismatch_rejected(self):
         from mpi_operator_tpu.parallel.collectives import allgather_matmul
@@ -1280,10 +1304,11 @@ class TestRingCollectiveMatmul:
         toks, tgts = toks[:, :-1], toks[:, 1:]
         mesh = make_mesh(MeshConfig(dp=2, fsdp=2, tp=2))
         outs = {}
-        for overlap in (False, True):
+        for mode in ("einsum", "uni", "bidir"):
             cfg = gpt2_config("test", attention="dense", dtype=jnp.float32,
                               vocab_size=256, max_len=32,
-                              tp_overlap=overlap)
+                              tp_overlap=mode != "einsum",
+                              tp_ring="bidir" if mode == "bidir" else "uni")
             t = LMTrainer(CausalLM(cfg), mesh,
                           LMTrainerConfig(global_batch_size=8, seq_len=16,
                                           fused_xent=True),
@@ -1291,5 +1316,35 @@ class TestRingCollectiveMatmul:
             s = t.init_state(jax.random.PRNGKey(0))
             s, m1 = t.train_step(s, toks, tgts)
             s, m2 = t.train_step(s, toks, tgts)   # after a real update
-            outs[overlap] = (float(m1["loss"]), float(m2["loss"]))
-        np.testing.assert_allclose(outs[True], outs[False], rtol=2e-6)
+            outs[mode] = (float(m1["loss"]), float(m2["loss"]))
+        np.testing.assert_allclose(outs["uni"], outs["einsum"], rtol=2e-6)
+        np.testing.assert_allclose(outs["bidir"], outs["einsum"], rtol=2e-6)
+
+    def test_tp_overlap_non_divisible_seq_and_vocab(self):
+        """seq=15 and vocab=255 over tp=2: the overlap bodies zero-pad
+        internally (seq rows masked out, pad vocab columns forced to
+        -inf before the softmax normalizer) instead of raising — the
+        loss must equal the einsum path's exactly."""
+        import optax
+
+        from mpi_operator_tpu.train import LMTrainer, LMTrainerConfig
+
+        toks = jax.random.randint(jax.random.PRNGKey(9), (8, 16), 0, 255)
+        toks, tgts = toks[:, :-1], toks[:, 1:]
+        mesh = make_mesh(MeshConfig(dp=2, fsdp=2, tp=2))
+        outs = {}
+        for mode in ("einsum", "uni", "bidir"):
+            cfg = gpt2_config("test", attention="dense", dtype=jnp.float32,
+                              vocab_size=255, max_len=32,
+                              tp_overlap=mode != "einsum",
+                              tp_ring="bidir" if mode == "bidir" else "uni")
+            t = LMTrainer(CausalLM(cfg), mesh,
+                          LMTrainerConfig(global_batch_size=8, seq_len=15,
+                                          fused_xent=True),
+                          tx=optax.sgd(0.1))
+            s = t.init_state(jax.random.PRNGKey(0))
+            s, m1 = t.train_step(s, toks, tgts)
+            s, m2 = t.train_step(s, toks, tgts)
+            outs[mode] = (float(m1["loss"]), float(m2["loss"]))
+        np.testing.assert_allclose(outs["uni"], outs["einsum"], rtol=2e-6)
+        np.testing.assert_allclose(outs["bidir"], outs["einsum"], rtol=2e-6)

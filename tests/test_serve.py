@@ -98,11 +98,20 @@ def test_slot_manager_reuse_and_step_arrays():
     s.submit(Request(1, [8], 4, temperature=0.5, top_k=3, top_p=0.9))
     for st in s.admit(m.free, now=0.0):
         m.bind(st)
-    toks, pos, temps, top_ks, top_ps, consumers = m.step_arrays()
+    toks, pos, use_prev, temps, top_ks, top_ps, consumers = m.step_arrays()
     # slot 0 is mid-prefill: present in pos, absent from consumers
     assert [st.req.id for st in consumers] == [1]
     assert toks[1] == 8 and temps[1] == np.float32(0.5)
     assert top_ks[1] == 3 and top_ps[1] == np.float32(0.9)
+    # first decode step reads the host bonus token, not the device chain
+    assert not use_prev[1]
+    consumers[0].dispatched = 1
+    arrs = m.step_arrays()
+    assert arrs[2][1]                 # chained now
+    # drained request: stops consuming, awaits its final sync
+    consumers[0].dispatched = consumers[0].req.max_new_tokens
+    arrs = m.step_arrays()
+    assert arrs[-1] == []
     st0, st1 = m.states
     m.release(st0)
     assert m.free == [0] and m.occupied == 1
@@ -292,6 +301,58 @@ def test_engine_sampling_reproducible_and_in_support():
             {"params": params}, jnp.asarray([ctx], jnp.int32)))[0, -1]
         assert t in np.argsort(logits)[-3:], "token outside top_k support"
         ctx.append(t)
+
+
+@pytest.mark.parametrize("decode_kernel", [False, True])
+def test_engine_async_matches_sync_token_exact(decode_kernel):
+    """The double-buffered loop vs the drain-every-step loop: identical
+    greedy tokens (including EOS cuts mid-flight, which cost the async
+    loop one discarded junk step), identical finish reasons, and ZERO
+    extra compiles — async/sync share the same compiled step."""
+    model, params, engine = _setup(decode_kernel)
+    rs = np.random.RandomState(11)
+    probe = Request(99, list(rs.randint(0, 64, (6,))), max_new_tokens=8)
+    eos = _oracle(model, params, probe)[2]     # a token greedy WILL emit
+    engine.reset()
+    reqs = [Request(i, list(rs.randint(0, 64, (3 + i,))),
+                    max_new_tokens=8, eos_id=eos)
+            for i in range(6)]                 # 6 requests, 4 slots
+    assert engine.config.async_decode          # the default
+    a = engine.run(reqs)
+    counts_async = engine.compile_counts()
+    engine.config.async_decode = False
+    engine.reset()
+    b = engine.run(reqs)
+    assert engine.compile_counts() == counts_async
+    assert any(r.finish_reason == "eos" for r in a.values())
+    for req in reqs:
+        assert a[req.id].tokens == b[req.id].tokens == \
+            _oracle(model, params, req), f"request {req.id} diverged"
+        assert a[req.id].finish_reason == b[req.id].finish_reason
+
+
+def test_engine_async_compile_pins_and_sampled_replay():
+    """Async mode holds the same no-recompile contract as sync, across
+    run -> reset -> run with mixed greedy+sampled traffic; and a reset
+    async engine replays its sampled draws exactly (the per-step rng
+    counter rewinds with it)."""
+    _, _, engine = _setup()
+    rs = np.random.RandomState(29)
+    reqs = [Request(i, list(rs.randint(0, 64, (p,))),
+                    max_new_tokens=5,
+                    temperature=1.1 if i % 2 else 0.0,
+                    top_k=4 if i % 2 else 0)
+            for i, p in enumerate([2, 7, 10, 3, 12])]
+    a = engine.run(reqs)
+    first = engine.compile_counts()
+    engine.reset()
+    b = engine.run(reqs)
+    second = engine.compile_counts()
+    assert first == second                    # reset must not recompile
+    assert second["step"] <= 3
+    assert second["prefill"] <= len(engine.config.chunk_buckets)
+    for req in reqs:                          # sampled draws replay too
+        assert a[req.id].tokens == b[req.id].tokens
 
 
 @pytest.mark.multichip

@@ -57,6 +57,26 @@ def test_histogram_default_edges():
     assert all(len(repr(e)) <= 12 for e in h.edges)
 
 
+def test_histogram_env_knobs_override_range(monkeypatch):
+    """TPU_HIST_LO/HI/PER_DECADE re-range every histogram at construction
+    time — a deploy-time knob, no code change."""
+    monkeypatch.setenv("TPU_HIST_LO", "1e-2")
+    monkeypatch.setenv("TPU_HIST_HI", "1e1")
+    monkeypatch.setenv("TPU_HIST_PER_DECADE", "2")
+    h = Histogram("h", lo=1e-4, hi=1e3)   # code values lose to the env
+    assert h.edges == (0.01, 0.0316228, 0.1, 0.316228, 1.0, 3.16228, 10.0)
+    # empty string behaves like unset: code values win again
+    monkeypatch.setenv("TPU_HIST_LO", "")
+    monkeypatch.setenv("TPU_HIST_HI", "")
+    monkeypatch.setenv("TPU_HIST_PER_DECADE", "")
+    d = Histogram("d")
+    assert d.edges[0] == 1e-4 and d.edges[-1] == 1000.0 and len(d.edges) == 71
+    # a knob that doesn't parse fails loudly, not as a silent default
+    monkeypatch.setenv("TPU_HIST_LO", "fast")
+    with pytest.raises(ValueError):
+        Histogram("bad")
+
+
 def test_histogram_no_observation_dropped():
     h = Histogram("h", lo=1e-3, hi=1e1)
     h.observe(1e-9)          # below lo -> first bucket
@@ -354,6 +374,7 @@ def test_one_scrape_serves_train_and_serve_series(live_run):
     for series in ("tpu_worker_step_seconds_count",     # train
                    "tpu_worker_steps_total",
                    "tpu_worker_goodput",
+                   "tpu_worker_host_gap_seconds_count", # both legs feed it
                    "tpu_worker_ttft_seconds_count",     # serve
                    "tpu_worker_decode_step_seconds_count",
                    "tpu_worker_requests_total"):
@@ -371,6 +392,10 @@ def test_benchmark_metrics_carry_step_percentiles(live_run):
     _, _, metrics = live_run
     assert metrics["step_time_p50_ms"] > 0
     assert metrics["step_time_p99_ms"] >= metrics["step_time_p50_ms"]
+    # the host-gap histogram (time blocked on the window's device fetch)
+    # rides along in the same summary
+    assert metrics["host_gap_p50_ms"] > 0
+    assert metrics["host_gap_p99_ms"] >= metrics["host_gap_p50_ms"]
     assert metrics["goodput"] == 1.0
 
 
