@@ -485,6 +485,32 @@ def main() -> None:
             compare_sync=True,
             log=lambda s: print(s, file=sys.stderr)))
 
+    def serving_paged_metrics():
+        # the paged-KV engine over a shared-system-prompt trace: every
+        # request carries the same seeded prefix, so the first wave
+        # prefills it cold and publishes while later waves pin the shared
+        # pages — prefix_hit_rate, cold-vs-hit TTFT, and page-occupancy
+        # peaks land in the JSONL under serving_paged_*. No sequential
+        # baseline rerun (the serving leg already priced that); the
+        # contiguous serving leg in the same line IS the A/B.
+        from mpi_operator_tpu.examples.serve_benchmark import (
+            run_serving_benchmark)
+        m = retry_infra_once(lambda: run_serving_benchmark(
+            size="test" if args.smoke else None,
+            slots=4 if args.smoke else 8,
+            num_requests=8 if args.smoke else 32,
+            prompt_grid=(8, 16, 24) if args.smoke else (32, 64, 128),
+            new_grid=(16, 32) if args.smoke else (32, 64),
+            chunk_buckets=(8, 16) if args.smoke else (32, 128),
+            dtype_name=args.dtype,
+            paged=True,
+            page_size=16 if args.smoke else 64,
+            shared_prefix_len=16 if args.smoke else 128,
+            baseline=False,
+            log=lambda s: print(s, file=sys.stderr)))
+        return {k.replace("serving_", "serving_paged_", 1): v
+                for k, v in m.items()}
+
     if args.workload == "serving":
         line = {
             "metric": "serving_tokens_per_sec",
@@ -497,6 +523,9 @@ def main() -> None:
         line.update(m)
         line["value"] = m["serving_tokens_per_sec"]
         emit_leg("serving", m)
+        pm = serving_paged_metrics()
+        line.update(pm)
+        emit_leg("serving_paged", pm)
         finish(line)
         return
     if args.workload == "generate":
@@ -731,6 +760,23 @@ def main() -> None:
                 line["serving_error"] = type(exc).__name__
                 emit_leg("serving",
                          {"serving_error": type(exc).__name__})
+        # paged-KV serving over the shared-system-prompt trace (prefix
+        # hit rate + cold/hit TTFT; the contiguous leg above is its A/B)
+        if not over_budget("serving_paged"):
+            try:
+                clear_residue()
+                spm = serving_paged_metrics()
+                line.update(spm)
+                emit_leg("serving_paged", spm)
+            except Exception as exc:  # noqa: BLE001
+                from mpi_operator_tpu.train.resilience import Preempted
+                if isinstance(exc, Preempted):
+                    raise
+                print(f"# serving_paged bench leg failed: {exc!r}",
+                      file=sys.stderr)
+                line["serving_paged_error"] = type(exc).__name__
+                emit_leg("serving_paged",
+                         {"serving_paged_error": type(exc).__name__})
         # ViT-B/16 (BASELINE configs[5] single-chip point; the multi-slice
         # variant is the dryrun's dcn leg)
         if not over_budget("vit"):

@@ -43,6 +43,10 @@ def run_serving_benchmark(
     temperature: float = 0.0,
     kv_cache_dtype: Optional[str] = None,
     decode_kernel: Optional[bool] = None,
+    paged: bool = False,
+    page_size: int = 64,
+    num_pages: Optional[int] = None,
+    shared_prefix_len: int = 0,
     baseline: bool = True,
     compare_sync: bool = False,
     seed: int = 0,
@@ -64,6 +68,15 @@ def run_serving_benchmark(
     over the greedy requests (sampled requests legitimately differ across modes:
     an EOS retirement costs the async loop one extra dispatched step, so
     the per-step rng stream shifts).
+
+    `paged` serves through the paged KV cache (EngineConfig.paged) with
+    `page_size`-token pages and `num_pages` physical pages (None = the
+    contiguous layout's byte budget). `shared_prefix_len` > 0 prepends
+    ONE seeded system prompt of that many tokens to every request — the
+    prefix-cache trace: the first wave prefills it cold and publishes,
+    later waves pin the shared pages and skip that prefill. The paged
+    report adds prefix_hit_rate, cold-vs-hit TTFT (admission-relative —
+    a hit skips prefill, not the queue), and page-occupancy peaks.
 
     `profile_dir` captures an XProf trace of the MEASURED trace only
     (warmup excluded, trace serialization after the closing timestamp —
@@ -94,8 +107,10 @@ def run_serving_benchmark(
     # cache length: fits the longest request, rounded up so the decode
     # kernel's k-tile divides it (decode_block_k caps at max_len, so any
     # multiple of 128 — or anything <= 128 that the tile equals — works)
-    need = max(prompt_grid) + max(new_grid)
+    need = shared_prefix_len + max(prompt_grid) + max(new_grid)
     max_len = need if need <= 128 else -(-need // 128) * 128
+    if paged and max_len % page_size:
+        max_len = -(-max_len // page_size) * page_size
     name = f"{family}-{size}" if size else family
     model = create_lm(name, dtype=dtype, kv_cache_dtype=kv_cache_dtype,
                       decode_kernel=decode_kernel, max_len=max_len)
@@ -107,11 +122,12 @@ def run_serving_benchmark(
 
     vocab = model.config.vocab_size
     rs = np.random.RandomState(seed)
+    system_prompt = rs.randint(0, vocab, (shared_prefix_len,)).tolist()
 
     def make_request(i, p, n):
         temp = (temperature if temperature > 0 and i % 2 == 1 else 0.0)
         return Request(
-            id=i, prompt=rs.randint(0, vocab, (p,)).tolist(),
+            id=i, prompt=system_prompt + rs.randint(0, vocab, (p,)).tolist(),
             max_new_tokens=n, temperature=temp,
             top_k=40 if temp > 0 else 0)
 
@@ -122,7 +138,8 @@ def run_serving_benchmark(
     wtel = WorkerTelemetry()
     engine = ServingEngine(model, params, EngineConfig(
         slots=slots, chunk_buckets=tuple(chunk_buckets),
-        decode_kernel=decode_kernel, rng_seed=seed),
+        decode_kernel=decode_kernel, rng_seed=seed,
+        paged=paged, page_size=page_size, num_pages=num_pages),
         telemetry=wtel.serving)
     if metrics_port is not None:
         log(f"worker /metrics listening on port "
@@ -184,7 +201,43 @@ def run_serving_benchmark(
         "serving_no_recompile": bool(no_recompile),
         "serving_decode_kernel": bool(decode_kernel),
         "serving_async_decode": bool(engine.config.async_decode),
+        "serving_paged": bool(paged),
     }
+    if paged:
+        # snapshot the allocator BEFORE any compare_sync rerun resets it
+        alloc = engine.page_allocator
+        lookups = alloc.hits + alloc.misses
+        ms = lambda v: round(v * 1e3, 3) if v is not None else None  # noqa: E731
+        # admission-relative TTFT: a prefix hit skips prefill work, not
+        # queueing delay, so the cold/hit split excludes the queue
+        adm = lambda r: r.token_times[0] - r.admitted_at  # noqa: E731
+        cold = _percentiles([adm(r) for r in results.values()
+                             if r.cached_tokens == 0])
+        hit = _percentiles([adm(r) for r in results.values()
+                            if r.cached_tokens > 0])
+        hit_reqs = sum(1 for r in results.values() if r.cached_tokens > 0)
+        out.update({
+            "serving_page_size": page_size,
+            "serving_pages_total": alloc.usable,
+            "serving_pages_in_use_peak": engine.pages_in_use_peak,
+            "serving_occupancy_peak": engine.occupancy_peak,
+            "serving_prefix_hit_rate": (round(alloc.hits / lookups, 4)
+                                        if lookups else 0.0),
+            "serving_prefix_hit_pages": alloc.hits,
+            "serving_prefix_miss_pages": alloc.misses,
+            "serving_prefix_hit_requests": hit_reqs,
+            "serving_ttft_cold_p50_ms": ms(cold[50]),
+            "serving_ttft_cold_p99_ms": ms(cold[99]),
+            "serving_ttft_hit_p50_ms": ms(hit[50]),
+            "serving_ttft_hit_p99_ms": ms(hit[99]),
+        })
+        log(f"paged KV: {alloc.usable} pages x {page_size} tokens, "
+            f"peak {engine.pages_in_use_peak} pages / "
+            f"{engine.occupancy_peak} slots in use; prefix hit rate "
+            f"{out['serving_prefix_hit_rate']} ({hit_reqs} hit reqs), "
+            f"TTFT-from-admission cold p50 "
+            f"{out['serving_ttft_cold_p50_ms']} ms vs hit p50 "
+            f"{out['serving_ttft_hit_p50_ms']} ms")
     log(f"serving {name}: {num_requests} reqs over {slots} slots: "
         f"{tps:.0f} new tokens/sec, TTFT p50/p99 "
         f"{out['serving_ttft_p50_ms']}/{out['serving_ttft_p99_ms']} ms, "
@@ -285,6 +338,17 @@ def main(argv=None) -> int:
     parser.add_argument("--temperature", type=float, default=0.0)
     parser.add_argument("--kv-cache-dtype", default=None,
                         choices=[None, "int8"])
+    parser.add_argument("--paged", action="store_true",
+                        help="serve through the paged KV cache "
+                             "(block-table pages + prefix caching)")
+    parser.add_argument("--page-size", type=int, default=64)
+    parser.add_argument("--num-pages", type=int, default=None,
+                        help="physical KV pages (default: the contiguous "
+                             "layout's byte budget)")
+    parser.add_argument("--shared-prefix-len", type=int, default=0,
+                        help="prepend one seeded system prompt of this "
+                             "many tokens to every request (the "
+                             "prefix-cache trace)")
     parser.add_argument("--no-baseline", action="store_true")
     parser.add_argument("--compare-sync", action="store_true",
                         help="re-run the trace with async_decode=False "
@@ -303,6 +367,9 @@ def main(argv=None) -> int:
         size=args.size, family=args.family, slots=args.slots,
         num_requests=args.num_requests, dtype_name=args.dtype,
         temperature=args.temperature, kv_cache_dtype=args.kv_cache_dtype,
+        paged=args.paged, page_size=args.page_size,
+        num_pages=args.num_pages,
+        shared_prefix_len=args.shared_prefix_len,
         baseline=not args.no_baseline, compare_sync=args.compare_sync,
         seed=args.seed,
         profile_dir=args.profile_dir, metrics_port=args.metrics_port)

@@ -97,6 +97,21 @@ class TransformerConfig:
     # per-slot cursors host-side, so admitting/retiring requests never
     # touches compiled code. Requires decode=True and explicit positions.
     decode_slots: bool = False
+    # paged KV cache (serve/): the decode cache becomes a global POOL of
+    # fixed-size pages [decode_num_pages, KV, decode_page_size, D]
+    # instead of one contiguous [B, KV, max_len, D] row per slot. Each
+    # call takes `pages` ([B, max_len // page_size] int32): the per-row
+    # page table mapping logical KV blocks to physical pages. Writes
+    # scatter to (table[pos // page_size], pos % page_size); reads gather
+    # the table back into logical order (dense path) or index pages
+    # directly per block (Pallas path). Page 0 is the reserved TRASH
+    # page: unallocated table entries point at it, so fixed-shape junk
+    # writes from free/masked rows land somewhere harmless. Decouples
+    # slot count from max_len — HBM is budgeted in pages actually used,
+    # and prompt-prefix pages can be SHARED between requests (refcounted
+    # by the serving engine's PageAllocator). Requires decode_slots.
+    decode_page_size: Optional[int] = None
+    decode_num_pages: int = 0
     # latency-hiding tensor parallelism: run the tp-sharded projections
     # (Attention qkv/out, Mlp in/out, and the fused-LM-loss logits matmul)
     # as explicit ring collective-matmuls
@@ -253,7 +268,7 @@ class Attention(nn.Module):
     config: TransformerConfig
 
     @nn.compact
-    def __call__(self, x, mask=None, positions=None):
+    def __call__(self, x, mask=None, positions=None, pages=None):
         cfg = self.config
         B, S, E = x.shape
         H, D = cfg.num_heads, cfg.head_dim
@@ -301,7 +316,8 @@ class Attention(nn.Module):
             q = rope(q, pos)
             k = rope(k, pos)
         if cfg.decode:
-            out = self._decode_attend(q, k, v, positions=positions)
+            out = self._decode_attend(q, k, v, positions=positions,
+                                      pages=pages)
         else:
             if KV != H:
                 # repeat K/V across query groups for the shared kernels
@@ -411,7 +427,7 @@ class Attention(nn.Module):
         y = fn(a, wo, bo)
         return y[:, :a.shape[1]] if y.shape[1] != a.shape[1] else y
 
-    def _decode_attend(self, q, k, v, positions=None):
+    def _decode_attend(self, q, k, v, positions=None, pages=None):
         """KV-cache attention for autoregressive decoding: append this
         call's K/V at the cache cursor, attend q against everything
         written so far (positions > cursor+S masked). Handles both the
@@ -436,11 +452,40 @@ class Attention(nn.Module):
         GQA-native AND length-aware (only the filled prefix streams, int8
         dequant fused into the read) — the dense path below stays the
         correctness oracle and handles prefill + unaligned cache
-        lengths."""
+        lengths.
+
+        With cfg.decode_page_size the slot rows stop owning contiguous
+        cache: the cache variables become a POOL of pages
+        [num_pages, KV, page_size, D] and `pages` ([B, L // page_size])
+        maps each row's logical KV blocks to physical pages. Writes
+        scatter to (pages[pos // ps], pos % ps); the dense oracle gathers
+        the table back into the logical [B, KV, L, D] layout, and the
+        Pallas path resolves pages per block inside the kernel's index
+        maps (ops.attention.paged_decode_attention). Page 0 is the trash
+        sink for unallocated table entries."""
         cfg = self.config
         B, S, H, D = q.shape
         KV = k.shape[2]
         L = cfg.max_len
+        paged = cfg.decode_page_size is not None
+        if paged:
+            ps = cfg.decode_page_size
+            NP = cfg.decode_num_pages
+            if not cfg.decode_slots:
+                raise ValueError(
+                    "decode_page_size requires decode_slots=True (the "
+                    "serving engine owns the page tables)")
+            if ps < 1 or L % ps:
+                raise ValueError(f"max_len={L} must be a multiple of "
+                                 f"decode_page_size={ps}")
+            if NP < 2:
+                raise ValueError(
+                    f"decode_num_pages={NP}: need >= 2 (page 0 is the "
+                    f"reserved trash sink)")
+            if pages is None:
+                raise ValueError(
+                    "paged decode needs the [B, max_len//page_size] page "
+                    "table from the serving engine")
         if cfg.decode_slots:
             if positions is None:
                 raise ValueError(
@@ -450,15 +495,40 @@ class Attention(nn.Module):
                 jnp.asarray(positions, jnp.int32), (B, S))  # [B, S]
             cur = pos[:, 0]                       # [B] per-slot cursors
 
-            def upd4(c, u):   # [B, KV, L, D] ← [B, KV, S, D] at row cursors
-                return jax.vmap(
-                    lambda cb, ub, s: jax.lax.dynamic_update_slice(
-                        cb, ub, (0, s, 0)))(c, u, cur)
+            if paged:
+                nblk = L // ps
+                pt = jnp.broadcast_to(jnp.asarray(pages, jnp.int32),
+                                      (B, nblk))
+                blk = jnp.minimum(pos // ps, nblk - 1)
+                phys = jnp.take_along_axis(pt, blk, axis=1)   # [B, S]
+                # junk positions past the logical cache (padded prefill
+                # tails, a retiring row's one post-EOS step) get an
+                # out-of-range page id: JAX scatters DROP out-of-bounds
+                # updates, so they never land anywhere — stronger than
+                # the contiguous path's clamp-to-last-row, which paging
+                # can't afford (a clamped write could land inside a
+                # SHARED prefix page)
+                phys = jnp.where(pos < L, phys, NP)
+                off = pos % ps
 
-            def upd3(c, u):   # [B, KV, L] ← [B, KV, S] (int8 scales)
-                return jax.vmap(
-                    lambda cb, ub, s: jax.lax.dynamic_update_slice(
-                        cb, ub, (0, s)))(c, u, cur)
+                def upd4(c, u):   # pool [NP, KV, ps, D] ← [B, KV, S, D]
+                    # two advanced indices split by slices put the index
+                    # dims in front: target block is [B, S, KV, D]
+                    return c.at[phys, :, off, :].set(
+                        u.transpose(0, 2, 1, 3))
+
+                def upd3(c, u):   # pool [NP, KV, ps] ← [B, KV, S]
+                    return c.at[phys, :, off].set(u.transpose(0, 2, 1))
+            else:
+                def upd4(c, u):   # [B, KV, L, D] ← [B, KV, S, D] at cursors
+                    return jax.vmap(
+                        lambda cb, ub, s: jax.lax.dynamic_update_slice(
+                            cb, ub, (0, s, 0)))(c, u, cur)
+
+                def upd3(c, u):   # [B, KV, L] ← [B, KV, S] (int8 scales)
+                    return jax.vmap(
+                        lambda cb, ub, s: jax.lax.dynamic_update_slice(
+                            cb, ub, (0, s)))(c, u, cur)
 
             def bump():
                 pass          # the engine owns the cursors host-side
@@ -483,6 +553,15 @@ class Attention(nn.Module):
         # kv-head-major [B, KV, S, D] slab
         k_t = k.transpose(0, 2, 1, 3)
         v_t = v.transpose(0, 2, 1, 3)
+        if paged:
+            kv_shape, sc_shape = (NP, KV, ps, D), (NP, KV, ps)
+            # the page pool is GLOBAL state shared by all rows — there is
+            # no batch axis to shard, so skip the per-row cache constraint
+            # and let GSPMD place (replicate) it
+            constrain = lambda x_: x_                       # noqa: E731
+        else:
+            kv_shape, sc_shape = (B, KV, L, D), (B, KV, L)
+            constrain = _constrain_cache
         k_scale = v_scale = None
         if cfg.kv_cache_dtype == "int8":
             # symmetric per-vector int8: scale = max|x|/127 over the head
@@ -498,46 +577,73 @@ class Attention(nn.Module):
                 return q8, scale[..., 0]
 
             ck = self.variable("cache", "cached_key", jnp.zeros,
-                               (B, KV, L, D), jnp.int8)
+                               kv_shape, jnp.int8)
             cv = self.variable("cache", "cached_value", jnp.zeros,
-                               (B, KV, L, D), jnp.int8)
+                               kv_shape, jnp.int8)
             ks = self.variable("cache", "key_scale", jnp.zeros,
-                               (B, KV, L), jnp.float32)
+                               sc_shape, jnp.float32)
             vs = self.variable("cache", "value_scale", jnp.zeros,
-                               (B, KV, L), jnp.float32)
+                               sc_shape, jnp.float32)
             k8, k_sc = quant(k_t)
             v8, v_sc = quant(v_t)
-            ck.value = _constrain_cache(upd4(ck.value, k8))
-            cv.value = _constrain_cache(upd4(cv.value, v8))
+            ck.value = constrain(upd4(ck.value, k8))
+            cv.value = constrain(upd4(cv.value, v8))
             ks.value = upd3(ks.value, k_sc)
             vs.value = upd3(vs.value, v_sc)
             bump()
             k_scale, v_scale = ks.value, vs.value
         else:
             ck = self.variable("cache", "cached_key", jnp.zeros,
-                               (B, KV, L, D), k.dtype)
+                               kv_shape, k.dtype)
             cv = self.variable("cache", "cached_value", jnp.zeros,
-                               (B, KV, L, D), v.dtype)
-            ck.value = _constrain_cache(upd4(ck.value, k_t))
-            cv.value = _constrain_cache(upd4(cv.value, v_t))
+                               kv_shape, v.dtype)
+            ck.value = constrain(upd4(ck.value, k_t))
+            cv.value = constrain(upd4(cv.value, v_t))
             bump()
 
         if cfg.decode_kernel and S == 1:
-            from ..ops.attention import decode_attention, decode_block_k
-            if L % decode_block_k(L, cfg.decode_block_k) == 0:
-                out = decode_attention(
-                    q[:, 0], ck.value, cv.value, cur,
-                    k_scale=k_scale, v_scale=v_scale,
-                    block_k=cfg.decode_block_k)
-                return out[:, None]
-        # dense oracle path (prefill, CPU correctness, unaligned L)
-        if cfg.kv_cache_dtype == "int8":
-            keys = (ck.value.astype(cfg.dtype)
-                    * k_scale[..., None].astype(cfg.dtype))
-            values = (cv.value.astype(cfg.dtype)
-                      * v_scale[..., None].astype(cfg.dtype))
+            if paged:
+                from ..ops.attention import paged_decode_attention
+                # Mosaic second-minor tiling for the (ps, D) page block:
+                # int8 needs 32, bf16 16, f32 8 — pages below that fall
+                # back to the dense gather oracle
+                need = (32 if ck.value.dtype == jnp.int8
+                        else 16 if ck.value.dtype == jnp.bfloat16 else 8)
+                if ps % need == 0:
+                    out = paged_decode_attention(
+                        q[:, 0], ck.value, cv.value, cur, pt,
+                        k_scale=k_scale, v_scale=v_scale)
+                    return out[:, None]
+            else:
+                from ..ops.attention import (decode_attention,
+                                             decode_block_k)
+                if L % decode_block_k(L, cfg.decode_block_k) == 0:
+                    out = decode_attention(
+                        q[:, 0], ck.value, cv.value, cur,
+                        k_scale=k_scale, v_scale=v_scale,
+                        block_k=cfg.decode_block_k)
+                    return out[:, None]
+        # dense oracle path (prefill, CPU correctness, unaligned shapes).
+        # Paged caches gather the page table back into the logical
+        # [B, KV, L, D] layout first — trash/junk entries land at
+        # positions the visibility mask below excludes.
+        if paged:
+            def gather4(c):           # [NP, KV, ps, D] → [B, KV, L, D]
+                g = c[pt]             # [B, nblk, KV, ps, D]
+                return g.transpose(0, 2, 1, 3, 4).reshape(B, KV, L, D)
+
+            def gather3(c):           # [NP, KV, ps] → [B, KV, L]
+                g = c[pt]
+                return g.transpose(0, 2, 1, 3).reshape(B, KV, L)
         else:
-            keys, values = ck.value, cv.value
+            gather4 = gather3 = lambda x_: x_               # noqa: E731
+        if cfg.kv_cache_dtype == "int8":
+            keys = (gather4(ck.value).astype(cfg.dtype)
+                    * gather3(k_scale)[..., None].astype(cfg.dtype))
+            values = (gather4(cv.value).astype(cfg.dtype)
+                      * gather3(v_scale)[..., None].astype(cfg.dtype))
+        else:
+            keys, values = gather4(ck.value), gather4(cv.value)
         if KV != H:
             keys = jnp.repeat(keys, H // KV, axis=1)
             values = jnp.repeat(values, H // KV, axis=1)
@@ -795,12 +901,13 @@ class Block(nn.Module):
     use_moe: bool = False
 
     @nn.compact
-    def __call__(self, x, mask=None, positions=None):
+    def __call__(self, x, mask=None, positions=None, pages=None):
         cfg = self.config
         x = _constrain(x)
         y = _layer_norm(cfg, "ln_1")(x)
         x = _constrain(x + Attention(cfg, name="attn")(y, mask=mask,
-                                                       positions=positions))
+                                                       positions=positions,
+                                                       pages=pages))
         y = _layer_norm(cfg, "ln_2")(x)
         if self.use_moe:
             from ..parallel.moe import MoeMlp
@@ -827,7 +934,7 @@ class Backbone(nn.Module):
     config: TransformerConfig
 
     @nn.compact
-    def __call__(self, h, mask=None, positions=None):
+    def __call__(self, h, mask=None, positions=None, pages=None):
         cfg = self.config
         block = Block
         if cfg.remat:
@@ -846,7 +953,7 @@ class Backbone(nn.Module):
             use_moe = (cfg.num_experts > 0
                        and i % cfg.moe_every == cfg.moe_every - 1)
             h = block(cfg, use_moe=use_moe, name=f"block_{i}")(
-                h, mask=mask, positions=positions)
+                h, mask=mask, positions=positions, pages=pages)
         return _constrain(_layer_norm(cfg, "ln_f")(h))
 
 
@@ -873,14 +980,17 @@ class CausalLM(nn.Module):
     config: TransformerConfig
 
     @nn.compact
-    def __call__(self, tokens, with_head: bool = True, positions=None):
+    def __call__(self, tokens, with_head: bool = True, positions=None,
+                 pages=None):
         """with_head=False returns the backbone output h [B, S, E] instead
         of logits — the chunked fused-xent path (train/lm_trainer.py)
         consumes h + the wte table directly so the full [B·S, vocab]
         logits never materialize in HBM. Both modes create identical
         params (the tied head adds none). `positions` overrides the
         default arange(S) position ids (decode steps pass the absolute
-        position of each token past the cached prefix)."""
+        position of each token past the cached prefix). `pages` is the
+        paged-KV page table ([B, max_len // page_size] int32), required
+        when cfg.decode_page_size is set (serve/engine.py)."""
         cfg = self.config
         B, S = tokens.shape
         wte = _embed(cfg, cfg.vocab_size, cfg.embed_dim, "wte", "vocab")
@@ -892,7 +1002,8 @@ class CausalLM(nn.Module):
         # rope: no position table — rotations happen inside attention;
         # positions pass through UNsliced (rope broadcasts [S] or [B, S],
         # so per-row ids — left-padded prompts — stay per-row)
-        h = Backbone(cfg, name="backbone")(h, positions=positions)
+        h = Backbone(cfg, name="backbone")(h, positions=positions,
+                                           pages=pages)
         if not with_head:
             return h
         # tied LM head; bf16 MXU matmul, f32 accumulation (tied_logits)

@@ -627,4 +627,108 @@ def decode_attention(q, k_cache, v_cache, cache_index,
     return out.reshape(B, H, D)
 
 
-__all__ = ["flash_attention", "decode_attention", "decode_block_k"]
+def paged_decode_attention(q, k_pages, v_pages, cache_index, page_table,
+                           k_scale=None, v_scale=None,
+                           interpret: Optional[bool] = None):
+    """`decode_attention` over a PAGED cache — the serving engine's
+    block-table layout (transformer.py decode_page_size).
+
+    q            [B, H, D]          this step's queries (RoPE applied)
+    k_pages/v_pages [NP, KV, ps, D]  the global page POOL: NP fixed pages
+                 of ps positions each; bf16/f32, or int8 with scales
+    cache_index  int32 [B] per-row cursors (same contract as the
+                 contiguous kernel: row b attends positions <= cursor(b))
+    page_table   int32 [B, nblk]: row b's logical KV block j lives in
+                 physical page page_table[b, j]. nblk * ps is the logical
+                 cache length; unallocated entries point at the trash
+                 page (their positions sit beyond the cursor, so the
+                 column mask already excludes them)
+    k_scale/v_scale [NP, KV, ps] f32  int8 per-(page-slot, head) scales
+
+    The kernel body is IDENTICAL to the contiguous one — block_k equals
+    the page size and logical block ki covers positions [ki*ps, ki*ps+ps),
+    so the cursor skip/mask arithmetic carries over unchanged. Only the
+    index maps differ: the second scalar-prefetch operand (the page
+    table) resolves which PHYSICAL page streams for logical block ki,
+    with past-the-cursor blocks pinned to the boundary block's page so
+    the pipeline re-reads a resident page instead of streaming dead pool.
+    That one extra prefetched operand is the whole cost of paging — the
+    MXU work per step is byte-for-byte the contiguous kernel's.
+    """
+    B, H, D = q.shape
+    NP, KV, ps, _ = k_pages.shape
+    if H % KV:
+        raise ValueError(f"H={H} must be a multiple of KV={KV}")
+    G = H // KV
+    if page_table.ndim != 2 or page_table.shape[0] != B:
+        raise ValueError(f"page_table must be [B={B}, nblk], got shape "
+                         f"{page_table.shape}")
+    nblk = page_table.shape[1]
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    quantized = k_scale is not None
+    cur = jnp.asarray(cache_index, jnp.int32)
+    if cur.shape != (B,):
+        raise ValueError(f"cache_index must be [B]={B} per-row cursors, "
+                         f"got shape {cur.shape}")
+    pt = jnp.asarray(page_table, jnp.int32)
+
+    def page_of(b, ki, cur_ref, pt_ref):
+        # physical page for logical block ki, clamped to the row's
+        # boundary block (blocks past the cursor re-use its page — the
+        # kernel skips their compute anyway)
+        last = jnp.minimum(cur_ref[b] // ps, nblk - 1)
+        return pt_ref[b, jnp.minimum(ki, last)]
+
+    q4 = q.reshape(B, KV, G, D)
+    kv_spec = pl.BlockSpec(
+        (1, 1, ps, D),
+        lambda b, h, ki, cur, pt_: (page_of(b, ki, cur, pt_), h, 0, 0))
+    in_specs = [
+        pl.BlockSpec((1, 1, G, D),
+                     lambda b, h, ki, cur, pt_: (b, h, 0, 0)),
+        kv_spec,
+        kv_spec,
+    ]
+    args = [q4, k_pages, v_pages]
+    kern = functools.partial(_decode_kernel, sm_scale=1.0 / (D ** 0.5),
+                             block_k=ps)
+    if quantized:
+        scale_spec = pl.BlockSpec(
+            (1, 1, ps, 1),
+            lambda b, h, ki, cur, pt_: (page_of(b, ki, cur, pt_), h, 0, 0))
+        in_specs += [scale_spec, scale_spec]
+        args += [k_scale[..., None], v_scale[..., None]]
+
+        def kern2(cur_ref, pt_ref, q_ref, k_ref, v_ref, ks_ref, vs_ref,
+                  o_ref, *scratch, _inner=kern):
+            return _inner(cur_ref, q_ref, k_ref, v_ref, ks_ref, vs_ref,
+                          o_ref, *scratch)
+    else:
+        def kern2(cur_ref, pt_ref, q_ref, k_ref, v_ref, o_ref, *scratch,
+                  _inner=kern):
+            return _inner(cur_ref, q_ref, k_ref, v_ref, None, None, o_ref,
+                          *scratch)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(B, KV, nblk),
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec((1, 1, G, D),
+                               lambda b, h, ki, cur, pt_: (b, h, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((G, D), jnp.float32),      # acc
+            pltpu.VMEM((G, LANES), jnp.float32),  # running max m
+            pltpu.VMEM((G, LANES), jnp.float32),  # running sum l
+        ],
+    )
+    out = pl.pallas_call(
+        kern2,
+        grid_spec=grid_spec,
+        out_shape=_out_struct((B, KV, G, D), q.dtype, q, k_pages, v_pages),
+        interpret=interpret,
+    )(cur, pt, *args)
+    return out.reshape(B, H, D)
+
+
+__all__ = ["flash_attention", "decode_attention", "decode_block_k",
+           "paged_decode_attention"]

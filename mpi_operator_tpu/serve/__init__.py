@@ -13,10 +13,10 @@ from .engine import (  # noqa: F401
 from .scheduler import (  # noqa: F401
     Request, RequestState, Scheduler, plan_chunks,
 )
-from .slots import SlotManager  # noqa: F401
+from .slots import PageAllocator, SlotManager  # noqa: F401
 
 __all__ = [
-    "EngineConfig", "Request", "RequestResult", "RequestState",
-    "Scheduler", "ServingEngine", "SlotManager", "plan_chunks",
-    "sample_slots",
+    "EngineConfig", "PageAllocator", "Request", "RequestResult",
+    "RequestState", "Scheduler", "ServingEngine", "SlotManager",
+    "plan_chunks", "sample_slots",
 ]

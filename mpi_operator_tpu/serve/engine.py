@@ -36,6 +36,27 @@ model the way a frontend needs it served:
   dispatch — same compiled program (compile_counts is mode-blind),
   token-identical at temperature 0, the A/B baseline the serving bench
   measures against.
+- **Paged KV + prefix caching** (`EngineConfig.paged`). The per-layer
+  cache becomes a POOL of fixed-size pages ([num_pages, KV, page_size,
+  D], transformer.py decode_page_size) and each slot carries a page
+  TABLE instead of a contiguous row — slot count decouples from
+  max_len, so the same cache bytes serve strictly more concurrent
+  requests whenever typical spans run short of the worst case.
+  Admission reserves a request's whole worst-case page span up front
+  (slots.PageAllocator; scheduler packing skips past a head that
+  doesn't fit), so decode never allocates mid-flight. On top of pages:
+  fully-prefilled PROMPT pages are published into a refcounted prefix
+  cache (chained keys — exact token equality back to position 0), so a
+  request sharing a system prompt pins the existing pages and starts
+  prefill at the first divergent page; at worst-case TTFT the whole
+  prompt is already resident and the request goes straight to decode.
+  Retired requests' published pages linger in an evictable LRU until
+  the free list runs dry. Paged prefill is BATCHED: one fixed-shape
+  [slots, C] program per bucket advances every waiting slot whose next
+  chunk shares the bucket — same ≤3 compiled widths, deeper queues
+  amortize them. The contiguous path (paged=False, the default) stays
+  byte-for-byte what it was — it is the token-exactness oracle the
+  paged engine is pinned against in tests/test_paged_kv.py.
 
 Parity: at temperature 0 a single request produces token-for-token the
 same output as `generate()` — tests/test_serve.py pins this across the
@@ -56,7 +77,7 @@ from ..models.generate import cast_params, decode_model
 from ..telemetry import span
 from ..telemetry import events as ev
 from .scheduler import Request, RequestState, Scheduler
-from .slots import SlotManager
+from .slots import PageAllocator, SlotManager
 
 
 @dataclasses.dataclass
@@ -68,12 +89,29 @@ class EngineConfig:
     `decode_kernel` None inherits the model config. `async_decode`
     dispatches decode step N+1 before syncing step N's tokens (the
     double-buffered loop — see the module docstring); False drains every
-    step before the next dispatch, through the same compiled program."""
+    step before the next dispatch, through the same compiled program.
+
+    `paged` switches the cache to the page-pool layout: `page_size`
+    tokens per page (64 default — big enough that the page-table
+    indirection amortizes, small enough that a short request doesn't
+    strand half a row; must divide max_len, and the Pallas path wants a
+    multiple of 32 so every cache dtype tiles), `num_pages` physical
+    pages plus the reserved trash page (None sizes the pool to the
+    contiguous layout's bytes: slots * max_len // page_size, + 1 —
+    capacity wins then come from requests that DON'T use their worst
+    case). `prefix_cache` publishes fully-prefilled prompt pages for
+    cross-request sharing; False keeps pure paging. `admit_lookahead`
+    bounds the packing scan past a head-of-queue that doesn't fit."""
     slots: int = 8
     chunk_buckets: Tuple[int, ...] = (32, 128, 512)
     decode_kernel: Optional[bool] = None
     rng_seed: int = 0
     async_decode: bool = True
+    paged: bool = False
+    page_size: int = 64
+    num_pages: Optional[int] = None
+    prefix_cache: bool = True
+    admit_lookahead: int = 8
 
 
 @dataclasses.dataclass
@@ -84,6 +122,14 @@ class RequestResult:
     finish_reason: str                # "eos" | "length"
     ttft: float                       # arrival → first new token, seconds
     token_times: List[float]          # absolute (run-relative) per token
+    cached_tokens: int = 0            # prompt span served from the prefix
+    #                                   cache (paged mode; 0 = cold)
+    admitted_at: float = 0.0          # run-relative admission time —
+    #                                   token_times[0] - admitted_at is
+    #                                   TTFT with queueing excluded (the
+    #                                   prefix-cache comparison the bench
+    #                                   makes: a hit skips prefill, not
+    #                                   the queue)
 
 
 #: bounded-mode candidate pool: exact for any request with an active
@@ -186,13 +232,31 @@ class ServingEngine:
                                  f"max_len={mcfg.max_len}")
         self.config = cfg
         self.model_config = mcfg
-        self.dmodel = decode_model(model, cfg.decode_kernel, slots=True)
+        ps = cfg.page_size
+        if cfg.paged:
+            if ps < 1 or mcfg.max_len % ps:
+                raise ValueError(f"page_size={ps} must be >= 1 and divide "
+                                 f"max_len={mcfg.max_len}")
+            NP = cfg.num_pages
+            if NP is None:
+                # contiguous layout's byte budget, plus the trash page
+                NP = cfg.slots * (mcfg.max_len // ps) + 1
+            self.page_allocator: Optional[PageAllocator] = \
+                PageAllocator(NP, ps)
+        else:
+            NP = 0
+            self.page_allocator = None
+        self.dmodel = decode_model(model, cfg.decode_kernel, slots=True,
+                                   page_size=ps if cfg.paged else None,
+                                   num_pages=NP)
         self._base_rng = jax.random.PRNGKey(cfg.rng_seed)
         self._steps_dispatched = 0
         self.telemetry = telemetry
         self.events = events
         if telemetry is not None:
             telemetry.slots.set(cfg.slots)
+            if cfg.paged:
+                telemetry.pages_total.set(self.page_allocator.usable)
 
         dmodel = self.dmodel
         dt = dmodel.config.dtype
@@ -203,12 +267,18 @@ class ServingEngine:
         self._cast = jax.jit(lambda p: cast_params(p, dt))
         self.params = self._cast(params)
 
+        nblk = mcfg.max_len // ps if cfg.paged else 0
+        self._nblk = nblk
+
         def init_cache(params):
             # a zero-token step apply materializes the cache collection
             # at its serving shape; the hidden-state output is discarded
             z = jnp.zeros((S, 1), jnp.int32)
+            kw = ({"pages": jnp.zeros((S, nblk), jnp.int32)}
+                  if cfg.paged else {})
             _, vars_ = dmodel.apply({"params": params}, z, positions=z,
-                                    with_head=False, mutable=["cache"])
+                                    with_head=False, mutable=["cache"],
+                                    **kw)
             return vars_["cache"]
 
         def prefill(params, cache, slot, tokens, start):
@@ -226,6 +296,23 @@ class ServingEngine:
                 lambda full, r: lax.dynamic_update_slice_in_dim(
                     full, r, slot, 0),
                 cache, vars_["cache"])
+
+        def prefill_paged(params, cache, tokens, starts, pages):
+            # BATCHED chunk over the page pool: [S, C] tokens, one row
+            # per slot, writes routed through the page tables — the pool
+            # is shared so there is no row to slice out, and every
+            # waiting slot whose next chunk shares this bucket advances
+            # in the same program. Non-member rows carry zero tokens at
+            # their OWN cursor: their junk K/V lands exactly where their
+            # next real write (chunk or decode step) overwrites it, the
+            # same argument as the fixed-shape decode step's masked rows
+            # (free rows' tables are all trash-page entries).
+            positions = starts[:, None] + jnp.arange(tokens.shape[1])[None]
+            _, vars_ = dmodel.apply(
+                {"params": params, "cache": cache}, tokens,
+                positions=positions, with_head=False, mutable=["cache"],
+                pages=pages)
+            return vars_["cache"]
 
         def step(params, cache, prev_tok, host_toks, use_prev, positions,
                  rng, temperature, top_k, top_p, mode):
@@ -245,37 +332,77 @@ class ServingEngine:
                                      top_p, mode=mode)
             return vars_["cache"], tok, logp
 
+        def step_paged(params, cache, prev_tok, host_toks, use_prev,
+                       positions, rng, temperature, top_k, top_p, pages,
+                       mode):
+            # the decode step with the per-slot page tables as one extra
+            # [S, nblk] operand — table churn (admit/retire) never
+            # recompiles, exactly like cursor churn
+            from ..models.transformer import _head_matmul
+            tokens = jnp.where(use_prev, prev_tok, host_toks)
+            h, vars_ = dmodel.apply(
+                {"params": params, "cache": cache}, tokens[:, None],
+                positions=positions[:, None], with_head=False,
+                mutable=["cache"], pages=pages)
+            logits = _head_matmul(h[:, 0], params["wte"]["embedding"])
+            tok, logp = sample_slots(logits, rng, temperature, top_k,
+                                     top_p, mode=mode)
+            return vars_["cache"], tok, logp
+
         # cache buffers are donated — the engine holds the only live
-        # reference, and [SLOTS, KV, L, D] per layer is the biggest
-        # allocation here; donation keeps it single-buffered. (CPU has
-        # no donation support and would warn per program.) prev_tok is
-        # NOT donated: the pending sync still reads its buffer after the
-        # next step consumed it.
+        # reference, and the cache ([SLOTS, KV, L, D] per layer, or the
+        # page pool) is the biggest allocation here; donation keeps it
+        # single-buffered. (CPU has no donation support and would warn
+        # per program.) prev_tok is NOT donated: the pending sync still
+        # reads its buffer after the next step consumed it.
         donate = (1,) if jax.default_backend() in ("tpu", "gpu") else ()
         self._init_cache = jax.jit(init_cache)
-        self._prefill = jax.jit(prefill, donate_argnums=donate)
-        self._step = jax.jit(step, donate_argnums=donate,
-                             static_argnums=(10,))
+        if cfg.paged:
+            self._prefill = jax.jit(prefill_paged, donate_argnums=donate)
+            self._step = jax.jit(step_paged, donate_argnums=donate,
+                                 static_argnums=(11,))
+        else:
+            self._prefill = jax.jit(prefill, donate_argnums=donate)
+            self._step = jax.jit(step, donate_argnums=donate,
+                                 static_argnums=(10,))
 
-        self.scheduler = Scheduler(cfg.chunk_buckets, mcfg.max_len)
+        self.scheduler = Scheduler(cfg.chunk_buckets, mcfg.max_len,
+                                   admit_lookahead=cfg.admit_lookahead)
         self.slots = SlotManager(S)
         self.cache = self._init_cache(self.params)
         self._prev_tok = jnp.zeros((S,), jnp.int32)
+        # high-water marks over a run(): the capacity story in one pair
+        # of numbers (paged mode sustains more slots than contiguous at
+        # equal cache bytes exactly when pages_in_use_peak stays under
+        # the pool while occupancy_peak exceeds the contiguous slot cap)
+        self.occupancy_peak = 0
+        self.pages_in_use_peak = 0
 
     # -- bookkeeping ------------------------------------------------------
 
     def reset(self) -> None:
-        """Clear all serving state (queue, slots, cache contents) but
-        keep every compiled program — what the bench calls between the
-        warmup trace and the measured trace."""
+        """Clear all serving state (queue, slots, cache contents, page
+        allocator and prefix cache) but keep every compiled program —
+        what the bench calls between the warmup trace and the measured
+        trace. A reset engine replays a trace with identical tokens AND
+        identical compile counts."""
         self.scheduler = Scheduler(self.config.chunk_buckets,
-                                   self.model_config.max_len)
+                                   self.model_config.max_len,
+                                   admit_lookahead=self.config
+                                   .admit_lookahead)
         self.slots = SlotManager(self.config.slots)
+        if self.page_allocator is not None:
+            # rewind refcounts, free list, AND the prefix cache — cached
+            # pages index into a cache whose contents init_cache is about
+            # to zero, so carrying them over would serve stale K/V
+            self.page_allocator.reset()
         self.cache = self._init_cache(self.params)
         self._prev_tok = jnp.zeros((self.config.slots,), jnp.int32)
         # the per-step rng folds in this counter — rewind it so a reset
         # engine replays a trace with identical draws
         self._steps_dispatched = 0
+        self.occupancy_peak = 0
+        self.pages_in_use_peak = 0
 
     def compile_counts(self) -> Dict[str, int]:
         """Executable-cache sizes of the engine's jitted programs —
@@ -307,6 +434,74 @@ class ServingEngine:
             self.telemetry.prefill_seconds.observe(time.perf_counter() - t0)
         st.pos = min(p1, w + size)
 
+    def _page_table_array(self) -> np.ndarray:
+        """[S, nblk] physical-page tables for every slot row; free rows
+        are all trash-page entries (their masked writes sink there)."""
+        pt = np.zeros((self.config.slots, self._nblk), np.int32)
+        for st in self.slots.states:
+            if st is not None:
+                pt[st.slot] = st.page_table
+        return pt
+
+    def _run_prefill_batched(self, lead: RequestState) -> None:
+        """Paged prefill: advance EVERY waiting slot whose next chunk
+        shares the lead's bucket in one [S, C] program — deeper queues
+        amortize the same ≤3 compiled widths instead of serializing one
+        chunk per loop iteration. Bound non-member rows run zero tokens
+        at their own cursor (junk lands at their next write offset)."""
+        size = lead.chunks[0][1]
+        batch = [st for st in self.scheduler.active
+                 if st.prefilling and st.chunks[0][1] == size]
+        toks = np.zeros((self.config.slots, size), np.int32)
+        starts = np.zeros((self.config.slots,), np.int32)
+        for st in self.slots.states:
+            if st is not None:
+                starts[st.slot] = st.pos
+        done = []
+        for st in batch:
+            w, _ = st.chunks.pop(0)
+            p1 = len(st.req.prompt) - 1
+            window = list(st.req.prompt[w:min(w + size, p1)])
+            window += [0] * (size - len(window))
+            toks[st.slot] = window
+            starts[st.slot] = w
+            done.append((st, w, p1))
+        t0 = time.perf_counter()
+        with span("serve.prefill"):
+            self.cache = self._prefill(
+                self.params, self.cache, jnp.asarray(toks),
+                jnp.asarray(starts), jnp.asarray(self._page_table_array()))
+        if self.telemetry is not None:
+            self.telemetry.prefill_seconds.observe(time.perf_counter() - t0)
+        for st, w, p1 in done:
+            st.pos = max(st.pos, min(p1, w + size))
+            if self.config.prefix_cache:
+                self._publish_prompt_pages(st)
+
+    def _publish_prompt_pages(self, st: RequestState) -> None:
+        """Register this request's newly COMPLETED prompt pages in the
+        prefix cache (chained keys, slots.PageAllocator.publish). Only
+        full pages of prompt positions [0, P-1) are ever published — the
+        partial tail page also holds decode tokens and stays private.
+        A False from publish() means another request registered the
+        identical prefix concurrently; our copy stays private, and we
+        stop publishing descendants (they would chain off a parent page
+        nothing can reach through the cache)."""
+        alloc = self.page_allocator
+        ps = alloc.page_size
+        p1 = len(st.req.prompt) - 1
+        full = p1 // ps
+        while (st.published_pages < full
+               and (st.published_pages + 1) * ps <= st.pos):
+            k = st.published_pages
+            page = st.page_table[k]
+            if not alloc.publish(page, st.publish_parent,
+                                 st.req.prompt[k * ps:(k + 1) * ps]):
+                st.published_pages = full
+                break
+            st.published_pages = k + 1
+            st.publish_parent = page
+
     def _dispatch_decode_step(self):
         """Build the step arrays and dispatch ONE decode step without
         waiting for its result. Returns the pending sync handle
@@ -330,12 +525,14 @@ class ServingEngine:
         rng = jax.random.fold_in(self._base_rng, self._steps_dispatched)
         self._steps_dispatched += 1
         step_t0 = time.perf_counter()
+        extra = ((jnp.asarray(self._page_table_array()),)
+                 if self.config.paged else ())
         with span("serve.decode_step"):
             self.cache, out_tok, out_logp = self._step(
                 self.params, self.cache, self._prev_tok,
                 jnp.asarray(toks), jnp.asarray(use_prev), jnp.asarray(pos),
                 rng, jnp.asarray(temps), jnp.asarray(top_ks),
-                jnp.asarray(top_ps), mode)
+                jnp.asarray(top_ps), *extra, mode)
         self._prev_tok = out_tok                 # the device-side chain
         for st in consumers:
             st.pos += 1                          # the step wrote at pos
@@ -403,7 +600,18 @@ class ServingEngine:
         """Drive the engine until every submitted request completes.
         `on_token(request, token)` streams tokens as they are fetched.
         Returns {request.id: RequestResult}."""
+        alloc = self.page_allocator
         for r in requests:
+            if alloc is not None:
+                need = Scheduler.pages_needed(r, alloc.page_size)
+                if need > alloc.usable:
+                    # a request the pool can NEVER satisfy would sit at
+                    # the head of the queue forever (admission livelock);
+                    # reject it up front like an over-max_len prompt
+                    raise ValueError(
+                        f"request {r.id}: worst-case span needs {need} KV "
+                        f"pages but the pool has {alloc.usable} usable "
+                        f"(raise num_pages or lower max_new_tokens)")
             self.scheduler.submit(r)
         t0 = time.perf_counter()
         now_fn = lambda: time.perf_counter() - t0   # noqa: E731
@@ -416,6 +624,14 @@ class ServingEngine:
                 if not st.slot_released:      # EOS path: freed here; the
                     self.slots.release(st)    # length path freed its row
                     st.slot_released = True   # at dispatch already
+                if alloc is not None:
+                    # drop every reference this request held — pinned
+                    # shared prefix pages and private pages alike; its
+                    # PUBLISHED pages park in the evictable LRU where
+                    # future lookups still find them
+                    for p in st.owned_pages:
+                        alloc.release(p)
+                    st.owned_pages = []
                 if self.events is not None:
                     self.events.emit(
                         ev.SLOT_RETIRE, request=st.req.id, slot=st.slot,
@@ -428,7 +644,9 @@ class ServingEngine:
                     logprobs=list(st.logprobs),
                     finish_reason=st.finish_reason,
                     ttft=st.token_times[0] - st.req.arrival,
-                    token_times=list(st.token_times))
+                    token_times=list(st.token_times),
+                    cached_tokens=st.cached_tokens,
+                    admitted_at=st.admitted_at)
 
         # the double buffer: the step whose tokens are still on the
         # device. Each iteration dispatches step N+1 FIRST, then syncs
@@ -440,15 +658,31 @@ class ServingEngine:
         while not (self.scheduler.idle and pending is None):
             now = now_fn()
             with span("serve.schedule"):
-                for st in self.scheduler.admit(self.slots.free, now):
+                for st in self.scheduler.admit(self.slots.free, now,
+                                               allocator=alloc):
                     self.slots.bind(st)
                     if self.events is not None:
                         self.events.emit(ev.SLOT_ADMIT, request=st.req.id,
                                          slot=st.slot,
-                                         prompt_len=len(st.req.prompt))
+                                         prompt_len=len(st.req.prompt),
+                                         cached_tokens=st.cached_tokens)
+                    if tel is not None and alloc is not None:
+                        ps_ = alloc.page_size
+                        full = (len(st.req.prompt) - 1) // ps_
+                        hit = st.cached_tokens // ps_
+                        tel.prefix_hit_pages.inc(hit)
+                        tel.prefix_miss_pages.inc(full - hit)
+            self.occupancy_peak = max(self.occupancy_peak,
+                                      self.slots.occupied)
+            if alloc is not None:
+                self.pages_in_use_peak = max(self.pages_in_use_peak,
+                                             alloc.in_use)
             if tel is not None:
                 tel.queue_depth.set(len(self.scheduler.queue))
                 tel.slot_occupancy.set(self.slots.occupied)
+                if alloc is not None:
+                    tel.pages_in_use.set(alloc.in_use)
+                    tel.pages_cached.set(alloc.cached_pages)
             # nothing resident yet and the next arrival is in the
             # future: sleep up to it instead of spinning
             if self.slots.occupied == 0 and pending is None:
@@ -458,7 +692,10 @@ class ServingEngine:
                 continue
             st = self.scheduler.next_prefill()
             if st is not None:
-                self._run_prefill_chunk(st)
+                if self.config.paged:
+                    self._run_prefill_batched(st)
+                else:
+                    self._run_prefill_chunk(st)
             new_pending = (self._dispatch_decode_step()
                            if self.scheduler.decoding() else None)
             if pending is not None:

@@ -65,6 +65,26 @@ class RequestState:
     token_times: List[float] = dataclasses.field(default_factory=list)
     admitted_at: float = 0.0
     finish_reason: Optional[str] = None   # "eos" | "length" once done
+    # paged-KV mode only (all None/zero otherwise): `page_table` maps the
+    # slot's logical KV blocks to physical pages (length max_len //
+    # page_size, unallocated entries = trash page 0); `owned_pages` are
+    # the references this request holds — pinned shared prefix pages plus
+    # its private pages — each release()d exactly once at retirement.
+    # The request's whole worst-case span is reserved at ADMISSION
+    # (ceil((P-1 + max_new) / page_size) pages, minus prefix hits), so
+    # decode never allocates mid-flight and can never deadlock.
+    page_table: Optional[List[int]] = None
+    owned_pages: List[int] = dataclasses.field(default_factory=list)
+    # prompt positions [0, cached_tokens) resolved from the prefix cache:
+    # prefill starts at the cached span (TTFT win of a hit)
+    cached_tokens: int = 0
+    # prefix-publishing cursor: this request's prompt pages [0,
+    # published_pages) are already in the prefix cache (hits count —
+    # they were published by their original prefiller); the engine
+    # advances it as prefill completes pages. publish_parent is the
+    # chain key's parent page for the NEXT page to publish.
+    published_pages: int = 0
+    publish_parent: int = -1
 
     @property
     def prefilling(self) -> bool:
@@ -75,8 +95,9 @@ class RequestState:
         return self.finish_reason is not None
 
 
-def plan_chunks(n: int, buckets: Sequence[int]) -> List[Tuple[int, int]]:
-    """Windows (start, size) covering prompt positions [0, n), sizes
+def plan_chunks(n: int, buckets: Sequence[int],
+                start: int = 0) -> List[Tuple[int, int]]:
+    """Windows (start, size) covering prompt positions [start, n), sizes
     drawn from the ≤3 compiled `buckets` (ascending). Full largest-bucket
     windows walk left→right; the ragged tail takes the smallest bucket
     that fits, RIGHT-ALIGNED (start = n - size) so no window writes past
@@ -86,18 +107,30 @@ def plan_chunks(n: int, buckets: Sequence[int]) -> List[Tuple[int, int]]:
     shorter than every bucket pads (one window at 0; the engine
     right-pads the tokens, and those pad writes land past the prompt
     where the decode cursor overwrites them before they are ever
-    attended)."""
+    attended).
+
+    `start` > 0 is the prefix-cache span (positions already resolved to
+    shared pages): windows begin there, and the ragged tail is LEFT-
+    aligned with padding instead of right-aligned — reaching backwards
+    would rewrite SHARED pages, which other requests may be attending
+    concurrently. The pad writes land past n where the decode cursor
+    overwrites them, same as the short-prompt case."""
     if n < 0:
         raise ValueError(f"negative prefill length {n}")
+    if not 0 <= start <= n:
+        raise ValueError(f"prefill start {start} outside [0, {n}]")
     out: List[Tuple[int, int]] = []
-    done = 0
+    done = start
     big = buckets[-1]
     while n - done >= big:
         out.append((done, big))
         done += big
     if done < n:
         size = next(b for b in buckets if b >= n - done)
-        out.append((max(0, n - size), size))
+        if start > 0:
+            out.append((done, size))            # left-aligned, padded
+        else:
+            out.append((max(0, n - size), size))
     return out
 
 
@@ -106,9 +139,19 @@ class Scheduler:
     per loop: who newly fits into a free slot (`admit`), and which
     admitted request should run its next prefill chunk
     (`next_prefill`, oldest-admitted first so a burst of long prompts
-    drains in arrival order while decode steps interleave)."""
+    drains in arrival order while decode steps interleave).
 
-    def __init__(self, chunk_buckets: Sequence[int], max_len: int):
+    In paged mode admission also reserves KV pages (the binding
+    resource): a request needs its worst-case page span free — minus
+    whatever its prompt prefix resolves to in the cache — before it gets
+    a slot. When the head of the queue doesn't fit, `admit` looks ahead
+    up to `admit_lookahead` arrived requests for one whose page demand
+    DOES fit (prompt-length packing): a burst of long prompts no longer
+    head-of-line-blocks the short requests that would ride along in the
+    pages left over. FCFS order is preserved whenever the head fits."""
+
+    def __init__(self, chunk_buckets: Sequence[int], max_len: int,
+                 admit_lookahead: int = 8):
         buckets = tuple(chunk_buckets)
         if not 1 <= len(buckets) <= 3:
             raise ValueError(f"chunk_buckets must have 1-3 entries "
@@ -119,8 +162,12 @@ class Scheduler:
         if buckets[-1] > max_len:
             raise ValueError(f"largest chunk bucket {buckets[-1]} exceeds "
                              f"max_len={max_len}")
+        if admit_lookahead < 1:
+            raise ValueError(f"admit_lookahead must be >= 1, "
+                             f"got {admit_lookahead}")
         self.chunk_buckets = buckets
         self.max_len = max_len
+        self.admit_lookahead = admit_lookahead
         self.queue: deque[Request] = deque()
         self.active: List[RequestState] = []
 
@@ -147,19 +194,76 @@ class Scheduler:
     def next_arrival(self) -> Optional[float]:
         return self.queue[0].arrival if self.queue else None
 
-    def admit(self, free_slots: List[int], now: float) \
-            -> List[RequestState]:
-        """Move arrived requests into free slots, FCFS. Returns the new
-        RequestStates (also tracked in self.active)."""
+    @staticmethod
+    def pages_needed(req: Request, page_size: int) -> int:
+        """Worst-case page span of a request: prefill writes positions
+        [0, P-1) and decode writes [P-1, P-1 + max_new) — the last
+        written position is P-2+max_new, so the span is its page + 1."""
+        return (len(req.prompt) - 2 + req.max_new_tokens) // page_size + 1
+
+    def _reserve_pages(self, req: Request, allocator):
+        """Try to reserve `req`'s whole page span: pin its cached prefix
+        chain, then allocate the rest — or undo the pins and return None
+        when the pool (free + evictable) can't cover it. Reserving
+        up-front is what makes decode allocation-free: a request that
+        gets a slot can always finish."""
+        ps = allocator.page_size
+        p1 = len(req.prompt) - 1              # bonus token excluded
+        full = p1 // ps                       # complete PROMPT pages
+        total = self.pages_needed(req, ps)
+        chain = allocator.lookup(req.prompt, full)
+        if allocator.available < total - len(chain):
+            for p in reversed(chain):
+                allocator.release(p)
+            return None
+        private = [allocator.alloc() for _ in range(total - len(chain))]
+        table = [allocator.TRASH] * (self.max_len // ps)
+        table[:len(chain)] = chain
+        table[len(chain):total] = private
+        return chain, private, table
+
+    def admit(self, free_slots: List[int], now: float,
+              allocator=None) -> List[RequestState]:
+        """Move arrived requests into free slots, FCFS. With a
+        PageAllocator, a request is admitted only when its page span
+        reserves (see `_reserve_pages`); a head that doesn't fit lets up
+        to `admit_lookahead` arrived requests behind it try (packing).
+        Returns the new RequestStates (also tracked in self.active)."""
         out = []
         while free_slots and self.queue and self.queue[0].arrival <= now:
-            req = self.queue.popleft()
+            picked = None
+            for idx, req in enumerate(self.queue):
+                if idx >= self.admit_lookahead or req.arrival > now:
+                    break
+                if allocator is None:
+                    picked = (idx, req, None)
+                    break
+                reserved = self._reserve_pages(req, allocator)
+                if reserved is not None:
+                    picked = (idx, req, reserved)
+                    break
+            if picked is None:
+                break
+            idx, req, reserved = picked
+            del self.queue[idx]
             slot = free_slots.pop(0)
             p1 = len(req.prompt) - 1          # bonus token excluded
             st = RequestState(
                 req=req, slot=slot, pos=0,
                 chunks=plan_chunks(p1, self.chunk_buckets),
                 next_input=int(req.prompt[-1]), admitted_at=now)
+            if reserved is not None:
+                chain, private, table = reserved
+                ps = allocator.page_size
+                span = len(chain) * ps        # prefix-cache hit span
+                st.page_table = table
+                st.owned_pages = chain + private
+                st.cached_tokens = span
+                st.published_pages = len(chain)
+                st.publish_parent = chain[-1] if chain else -1
+                st.pos = span                 # prefill starts past the hits
+                st.chunks = plan_chunks(p1, self.chunk_buckets,
+                                        start=span)
             self.active.append(st)
             out.append(st)
         return out

@@ -1,4 +1,4 @@
-"""Slot bookkeeping for the fixed-shape serving cache.
+"""Slot and page bookkeeping for the fixed-shape serving cache.
 
 The device cache is [SLOTS, KV, L, D] per layer (transformer.py
 decode_slots mode) and NEVER changes shape: requests come and go by
@@ -8,16 +8,237 @@ attends only positions <= its own cursor, and a new occupant rewrites
 [0, len) before its cursor gets there). That is the whole trick that
 makes admission/retirement free of recompiles.
 
+In paged mode (EngineConfig.paged) the cache is instead a global pool of
+fixed-size pages (transformer.py decode_page_size) and `PageAllocator`
+here owns the physical pages: a free list, per-page refcounts, and the
+prefix cache that lets requests sharing a prompt prefix resolve to the
+SAME physical pages and skip prefilling them. The same junk-write
+argument carries over page-by-page: a page's stale content is
+unreachable until a new owner's cursor crosses it, and the owner rewrites
+each position before the cursor does.
+
 This module owns which row belongs to which request and builds the
 per-step cursor/token/sampling arrays the compiled decode step consumes.
 """
 from __future__ import annotations
 
-from typing import List, Optional
+from bisect import insort
+from collections import OrderedDict
+from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
 from .scheduler import RequestState
+
+
+#: chained prefix-cache key: (parent physical page id, the page's token
+#: window). Chaining matters because K/V at position j depends on the
+#: WHOLE token prefix (layers > 0 attend backwards), so two pages holding
+#: identical tokens are interchangeable only when everything before them
+#: matched too — which the parent link encodes transitively. Exact tuple
+#: equality (dict keys), never a lossy hash: a collision would silently
+#: serve another prompt's K/V.
+PrefixKey = Tuple[int, Tuple[int, ...]]
+
+
+class PageAllocator:
+    """Physical KV pages for the paged serving cache: a free list,
+    per-page refcounts, and the prompt-prefix cache.
+
+    Page 0 is the reserved TRASH page — unallocated page-table entries
+    point at it so the fixed-shape decode/prefill programs always have a
+    legal write target for masked rows; it is never handed out.
+
+    Lifecycle of a page:
+      free list ──alloc()──▶ live (ref 1) ──release()──▶
+        · uncached page: straight back to the free list;
+        · cached page (published prompt prefix): into the EVICTABLE LRU —
+          still matchable by future lookups (pin() revives it, ref 0→1),
+          reclaimed oldest-first only when alloc() finds the free list
+          empty. Evicting a cached page cascades over its descendants in
+          the prefix chain (they are unreachable without it) — and the
+          cascade is also what keeps a recycled page id from falsely
+          matching stale child keys.
+
+    Sharing: `lookup(prompt)` walks the chained keys and PINS every page
+    it matches (ref +1 per sharing request); `publish()` registers a
+    fully-prefilled prompt page under its chain key. Shared pages are
+    immutable by construction — only FULL prompt pages are ever
+    published, and the divergence/partial page of a new request is always
+    a freshly allocated private page (copy-on-write at page granularity).
+    """
+
+    TRASH = 0
+
+    def __init__(self, num_pages: int, page_size: int):
+        if num_pages < 2:
+            raise ValueError(f"num_pages={num_pages}: need >= 2 (page 0 "
+                             f"is the reserved trash sink)")
+        if page_size < 1:
+            raise ValueError(f"page_size must be >= 1, got {page_size}")
+        self.num_pages = num_pages
+        self.page_size = page_size
+        self.free: List[int] = list(range(1, num_pages))   # sorted
+        self.ref: List[int] = [0] * num_pages
+        self._cache: Dict[PrefixKey, int] = {}       # key → physical page
+        self._key_of: Dict[int, PrefixKey] = {}      # published page → key
+        self._children: Dict[int, set] = {}          # parent → child pages
+        self._lru: "OrderedDict[int, None]" = OrderedDict()  # ref-0 cached
+        self.hits = 0          # prompt pages served from the prefix cache
+        self.misses = 0        # prompt pages that had to prefill cold
+        self.evictions = 0
+
+    # -- capacity ---------------------------------------------------------
+
+    @property
+    def usable(self) -> int:
+        """Pages a single request could ever hold (pool minus trash)."""
+        return self.num_pages - 1
+
+    @property
+    def available(self) -> int:
+        """Pages alloc() can currently produce: truly free + evictable."""
+        return len(self.free) + len(self._lru)
+
+    @property
+    def in_use(self) -> int:
+        """Pages referenced by live requests (pinned shared + private)."""
+        return self.usable - self.available
+
+    @property
+    def cached_pages(self) -> int:
+        """Ref-0 prefix-cache pages retained for future lookups."""
+        return len(self._lru)
+
+    # -- alloc / release --------------------------------------------------
+
+    def alloc(self) -> int:
+        """Hand out one private page (ref 1), evicting the oldest idle
+        prefix-cache page if the free list is dry. Raises when nothing is
+        free OR evictable — callers must check `available` first (the
+        scheduler reserves a request's whole worst-case page span at
+        admission, so allocation never fails mid-flight)."""
+        if self.free:
+            p = self.free.pop(0)        # lowest-first, like slot rows
+        elif self._lru:
+            p, _ = self._lru.popitem(last=False)
+            self._evict(p)
+        else:
+            raise RuntimeError("out of KV pages (none free or evictable)")
+        self.ref[p] = 1
+        return p
+
+    def release(self, p: int) -> None:
+        """Drop one reference. At ref 0 a published page parks in the
+        evictable LRU (still matchable); an unpublished one returns to
+        the free list."""
+        if p == self.TRASH:
+            raise ValueError("released the trash page")
+        if self.ref[p] <= 0:
+            raise RuntimeError(f"double-free of page {p}")
+        self.ref[p] -= 1
+        if self.ref[p] == 0:
+            if p in self._key_of:
+                self._lru[p] = None     # most-recently-used end
+            else:
+                insort(self.free, p)
+
+    def _evict(self, p: int) -> None:
+        """Remove page p's prefix-cache entry and cascade over its
+        descendants (all ref 0 — a pinned child implies a pinned parent,
+        because lookups pin whole chains and publishers hold their own
+        chain). Descendants go straight to the free list."""
+        key = self._key_of.pop(p)
+        del self._cache[key]
+        self._children.get(key[0], set()).discard(p)
+        self.evictions += 1
+        self._cascade_children(p)
+
+    def _cascade_children(self, p: int) -> None:
+        for child in sorted(self._children.pop(p, ())):
+            assert self.ref[child] == 0, \
+                f"evicting page {p} with referenced child {child}"
+            del self._lru[child]
+            del self._cache[self._key_of.pop(child)]
+            self.evictions += 1
+            self._cascade_children(child)
+            insort(self.free, child)
+
+    # -- prefix cache -----------------------------------------------------
+
+    def pin(self, p: int) -> None:
+        """Take a reference on a page (reviving it from the evictable
+        LRU when idle)."""
+        if self.ref[p] == 0:
+            del self._lru[p]
+        self.ref[p] += 1
+
+    def lookup(self, prompt: Sequence[int], full_pages: int) -> List[int]:
+        """Walk the prefix chain for `prompt`'s first `full_pages`
+        complete pages and PIN every match. Returns the matched chain
+        (physical page ids, possibly empty); callers release() each page
+        if they end up not admitting."""
+        ps = self.page_size
+        chain: List[int] = []
+        parent = -1
+        for k in range(full_pages):
+            key = (parent, tuple(int(t) for t in
+                                 prompt[k * ps:(k + 1) * ps]))
+            p = self._cache.get(key)
+            if p is None:
+                break
+            self.pin(p)
+            chain.append(p)
+            parent = p
+        self.hits += len(chain)
+        self.misses += full_pages - len(chain)
+        return chain
+
+    def publish(self, page: int, parent: int,
+                tokens: Sequence[int]) -> bool:
+        """Register a fully-prefilled prompt page under its chain key.
+        Returns False when the key is already cached (another request
+        prefilled the identical prefix concurrently) — the caller's page
+        stays private and the caller must stop publishing descendants
+        (they would be unreachable through the cached chain)."""
+        key: PrefixKey = (parent, tuple(int(t) for t in tokens))
+        if key in self._cache:
+            return False
+        if page in self._key_of:
+            raise RuntimeError(f"page {page} published twice")
+        self._cache[key] = page
+        self._key_of[page] = key
+        self._children.setdefault(parent, set()).add(page)
+        return True
+
+    def reset(self) -> None:
+        """Rewind to the freshly-constructed state: every page free, no
+        refcounts, no cached prefixes (ServingEngine.reset)."""
+        self.free = list(range(1, self.num_pages))
+        self.ref = [0] * self.num_pages
+        self._cache.clear()
+        self._key_of.clear()
+        self._children.clear()
+        self._lru.clear()
+        self.hits = self.misses = self.evictions = 0
+
+    def check(self) -> None:
+        """Invariant audit (tests): {free} ⊔ {evictable} ⊔ {ref>0}
+        partitions pages 1..N-1; cache maps are mutually consistent."""
+        free, lru = set(self.free), set(self._lru)
+        live = {p for p in range(1, self.num_pages) if self.ref[p] > 0}
+        assert not (free & lru) and not (free & live) and not (lru & live)
+        assert free | lru | live == set(range(1, self.num_pages))
+        assert self.ref[self.TRASH] == 0
+        assert all(r >= 0 for r in self.ref)
+        vals = list(self._cache.values())
+        assert len(vals) == len(set(vals)), "one page under two keys"
+        assert set(vals) == set(self._key_of)
+        assert all(self._cache[self._key_of[p]] == p for p in self._key_of)
+        assert lru <= set(self._key_of), "evictable page not published"
+        for parent, kids in self._children.items():
+            for c in kids:
+                assert self._key_of[c][0] == parent
 
 
 class SlotManager:
@@ -90,4 +311,4 @@ class SlotManager:
         return toks, pos, use_prev, temps, top_ks, top_ps, consumers
 
 
-__all__ = ["SlotManager"]
+__all__ = ["PageAllocator", "SlotManager"]

@@ -40,6 +40,14 @@ Serve series (ServingEngine):
   prefill_compiles        gauge     — prefill compile count
   requests_total          counter   — requests retired
   tokens_total            counter   — new tokens emitted
+  kv_pages_total          gauge     — usable KV pages (paged mode;
+                                      pool minus the trash page)
+  kv_pages_in_use         gauge     — pages referenced by live requests
+  kv_pages_cached         gauge     — idle prefix-cache pages retained
+                                      for future lookups (evictable)
+  prefix_hit_pages_total  counter   — prompt pages served from the
+                                      prefix cache at admission
+  prefix_miss_pages_total counter   — prompt pages prefilled cold
 """
 from __future__ import annotations
 
@@ -195,6 +203,21 @@ class ServeTelemetry:
             "tpu_worker_requests_total", "requests retired")
         self.tokens_total = reg.counter(
             "tpu_worker_tokens_total", "new tokens emitted")
+        self.pages_total = reg.gauge(
+            "tpu_worker_kv_pages_total",
+            "usable KV pages (paged mode; pool minus the trash page)")
+        self.pages_in_use = reg.gauge(
+            "tpu_worker_kv_pages_in_use",
+            "KV pages referenced by live requests")
+        self.pages_cached = reg.gauge(
+            "tpu_worker_kv_pages_cached",
+            "idle prefix-cache pages retained for future lookups")
+        self.prefix_hit_pages = reg.counter(
+            "tpu_worker_prefix_hit_pages_total",
+            "prompt pages served from the prefix cache at admission")
+        self.prefix_miss_pages = reg.counter(
+            "tpu_worker_prefix_miss_pages_total",
+            "prompt pages prefilled cold")
 
 
 class WorkerTelemetry:

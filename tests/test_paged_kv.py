@@ -1,0 +1,511 @@
+"""Paged KV cache tests: the block-table serving layout + prefix cache.
+
+Three layers, each pinned against the layer below it:
+
+- `PageAllocator` (serve/slots.py): free-list accounting, refcounted
+  prefix chains, LRU eviction with descendant cascade — unit tests plus
+  a randomized admit/publish/retire fuzz with the invariant audit
+  (`check()`) after every operation.
+- `paged_decode_attention` (ops/attention.py): the Pallas kernel over a
+  page pool must match the gathered dense oracle, with POISON in every
+  page slot past each row's cursor so any stray read is loud.
+- The paged `ServingEngine` (`EngineConfig.paged`): token-exact against
+  the CONTIGUOUS engine — same model, same trace, both attention paths —
+  including prefix-cache hits, slot reuse, int8 caches, and the capacity
+  claim (more concurrent requests than contiguous under the same cache
+  byte budget).
+"""
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from flax.core import meta
+
+from mpi_operator_tpu.models import CausalLM, gpt2_config
+from mpi_operator_tpu.ops.attention import paged_decode_attention
+from mpi_operator_tpu.serve import (
+    EngineConfig, PageAllocator, Request, Scheduler, ServingEngine,
+    plan_chunks,
+)
+
+pytestmark = pytest.mark.serving
+
+POISON = 1e4
+
+
+# ---------------------------------------------------------------------------
+# PageAllocator (no jax)
+# ---------------------------------------------------------------------------
+
+def test_page_allocator_lifecycle_and_errors():
+    with pytest.raises(ValueError, match="trash"):
+        PageAllocator(1, 4)
+    a = PageAllocator(5, 4)                  # pages 1..4 usable
+    assert a.usable == 4 and a.available == 4 and a.in_use == 0
+    p1, p2 = a.alloc(), a.alloc()
+    assert p1 != p2 and a.in_use == 2
+    a.release(p1)
+    assert a.available == 3
+    with pytest.raises(RuntimeError, match="double-free"):
+        a.release(p1)
+    with pytest.raises(ValueError, match="trash"):
+        a.release(a.TRASH)
+    a.alloc(), a.alloc(), a.alloc()
+    with pytest.raises(RuntimeError, match="out of KV pages"):
+        a.alloc()                            # 4 live, nothing evictable
+    a.check()
+
+
+def test_page_allocator_prefix_chain_and_eviction():
+    a = PageAllocator(6, 2)                  # 5 usable pages
+    # request A: prompt pages (1,2) and (3,4), published as a chain
+    pa, pb = a.alloc(), a.alloc()
+    assert a.publish(pa, -1, (1, 2))
+    assert a.publish(pb, pa, (3, 4))
+    # a second publisher of the same key loses and keeps its page private
+    pc = a.alloc()
+    assert not a.publish(pc, -1, (1, 2))
+    a.release(pc)                            # unpublished -> free list
+    # lookup pins the whole chain; a diverging prompt stops at the match
+    chain = a.lookup([1, 2, 3, 4, 9, 9], 3)
+    assert chain == [pa, pb] and a.ref[pa] == 2 and a.ref[pb] == 2
+    assert a.lookup([1, 2, 9, 9], 2) == [pa]     # second page diverges
+    assert a.lookup([7, 7], 1) == []
+    assert a.hits == 3 and a.misses == 3
+    for p in (pa, pa, pb):                   # drop the lookup pins
+        a.release(p)
+    # publishers retire: ref-0 published pages park in the evictable LRU
+    a.release(pa), a.release(pb)
+    assert a.in_use == 0 and a.cached_pages == 2
+    a.check()
+    # exhaust the free list; the next allocs evict pa oldest-first, and
+    # evicting pa CASCADES over pb (a child is unreachable without its
+    # parent, and a recycled parent id must not match stale child keys)
+    got = {a.alloc() for _ in range(5)}
+    assert got == {1, 2, 3, 4, 5} and a.evictions == 2
+    assert a.lookup([1, 2, 3, 4], 2) == []   # cache fully gone
+    a.check()
+
+
+def test_page_allocator_pin_revives_from_lru():
+    a = PageAllocator(4, 2)
+    p = a.alloc()
+    assert a.publish(p, -1, (5, 6))
+    a.release(p)
+    assert a.cached_pages == 1
+    assert a.lookup([5, 6, 7], 1) == [p]     # pin: LRU -> ref 1
+    assert a.ref[p] == 1 and a.cached_pages == 0
+    a.release(p)
+    assert a.cached_pages == 1               # still published
+    a.check()
+
+
+def test_page_allocator_reset_rewinds_everything():
+    a = PageAllocator(6, 2)
+    p = a.alloc()
+    a.publish(p, -1, (1, 2))
+    a.alloc()
+    a.reset()
+    assert a.available == a.usable == 5 and a.in_use == 0
+    assert a.cached_pages == 0 and a.hits == a.misses == 0
+    assert a.lookup([1, 2], 1) == []
+    a.check()
+
+
+def test_page_allocator_fuzz_no_leaks_no_aliasing():
+    """Randomized admit / publish / retire against the invariant audit:
+    after every operation the free/evictable/live sets must partition
+    the pool, no private page may be held by two requests, and draining
+    all requests must return every reference."""
+    rs = np.random.RandomState(0)
+    ps = 4
+    for trial in range(3):
+        a = PageAllocator(num_pages=13, page_size=ps)
+        # a small prompt universe so prefix collisions actually happen
+        prompts = [tuple(rs.randint(0, 3, (ps * rs.randint(1, 4),)))
+                   for _ in range(8)]
+        live = []          # (chain pages, private pages, prompt, pub state)
+        for _ in range(400):
+            op = rs.rand()
+            if op < 0.45:                                # admit
+                prompt = prompts[rs.randint(len(prompts))]
+                full = len(prompt) // ps
+                need = full + 1                          # one decode page
+                chain = a.lookup(prompt, full)
+                if a.available < need - len(chain):
+                    for p in reversed(chain):
+                        a.release(p)
+                else:
+                    priv = [a.alloc() for _ in range(need - len(chain))]
+                    live.append({"chain": chain, "priv": priv,
+                                 "prompt": prompt, "pub": len(chain),
+                                 "parent": chain[-1] if chain else -1})
+            elif op < 0.65 and live:                     # publish one page
+                st = live[rs.randint(len(live))]
+                full = len(st["prompt"]) // ps
+                k = st["pub"]
+                if k < full:
+                    page = (st["chain"] + st["priv"])[k]
+                    tok = st["prompt"][k * ps:(k + 1) * ps]
+                    if a.publish(page, st["parent"], tok):
+                        st["pub"] = k + 1
+                        st["parent"] = page
+                    else:
+                        st["pub"] = full     # lost the race: stop
+            elif live:                                   # retire
+                st = live.pop(rs.randint(len(live)))
+                for p in st["chain"] + st["priv"]:
+                    a.release(p)
+            a.check()
+            # no private page aliased between two live requests
+            privs = [p for st in live for p in st["priv"]]
+            assert len(privs) == len(set(privs))
+            held = sum(len(st["chain"]) + len(st["priv"]) for st in live)
+            assert a.in_use <= held          # shared pages count once
+        while live:
+            st = live.pop()
+            for p in st["chain"] + st["priv"]:
+                a.release(p)
+            a.check()
+        assert a.in_use == 0                 # no leaks after full drain
+
+
+# ---------------------------------------------------------------------------
+# chunk planning from a cached span + packing admission (no jax)
+# ---------------------------------------------------------------------------
+
+def test_plan_chunks_start_left_aligned_tail():
+    # start at a cached span: windows begin there, never reach backwards
+    assert plan_chunks(20, (4, 16), start=16) == [(16, 4)]
+    # ragged tail LEFT-aligned with padding (right-aligning would rewrite
+    # shared pages another request may be attending)
+    assert plan_chunks(21, (4, 16), start=16) == [(16, 16)]
+    assert plan_chunks(50, (4, 16), start=16) == [(16, 16), (32, 16),
+                                                  (48, 4)]
+    assert plan_chunks(16, (4, 16), start=16) == []
+    with pytest.raises(ValueError, match="outside"):
+        plan_chunks(8, (4, 16), start=9)
+    for n in range(1, 60):
+        for start in range(0, n + 1, 4):
+            covered = set()
+            for w, size in plan_chunks(n, (4, 16), start=start):
+                assert w >= start            # never rewrites cached pages
+                covered.update(range(w, w + size))
+            assert covered.issuperset(range(start, n))
+
+
+def test_pages_needed_and_packing_admission():
+    ps = 4
+    # span = prompt-1 prefill positions + max_new decode writes
+    assert Scheduler.pages_needed(Request(0, [1] * 5, 4), ps) == 2
+    assert Scheduler.pages_needed(Request(0, [1] * 5, 6), ps) == 3
+    assert Scheduler.pages_needed(Request(0, [1], 1), ps) == 1
+    a = PageAllocator(6, ps)                 # 5 usable
+    s = Scheduler((4,), max_len=32, admit_lookahead=4)
+    s.submit(Request(0, [1] * 5, 6))         # 3 pages
+    [st0] = s.admit([0, 1], now=0.0, allocator=a)
+    assert st0.req.id == 0 and a.in_use == 3
+    s.submit(Request(1, [1] * 9, 8))         # 4 pages: does NOT fit
+    s.submit(Request(2, [2] * 3, 4))         # 2 pages: fits
+    admitted = s.admit([1], now=0.0, allocator=a)
+    # packing: the short request behind the too-big head rides along
+    assert [st.req.id for st in admitted] == [2]
+    assert s.queue[0].id == 1                # FCFS head preserved
+    # head fits again once the first request's pages release
+    for st in (st0, admitted[0]):
+        s.retire(st)
+        for p in st.owned_pages:
+            a.release(p)
+    assert [st.req.id for st in s.admit([0, 1], now=0.0, allocator=a)] \
+        == [1]
+    a.check()
+
+
+def test_admission_reserves_worst_case_and_rejects_when_full():
+    ps = 4
+    a = PageAllocator(5, ps)                 # 4 usable
+    s = Scheduler((4,), max_len=32, admit_lookahead=2)
+    s.submit(Request(0, [1] * 5, 6))         # 3 pages -> fits
+    s.submit(Request(1, [1] * 5, 6))         # 3 pages -> must wait
+    admitted = s.admit([0, 1], now=0.0, allocator=a)
+    assert [st.req.id for st in admitted] == [0]
+    assert admitted[0].page_table[:3] != [0, 0, 0]
+    assert len(s.queue) == 1                 # no partial reservation
+    assert a.in_use == 3                     # nothing leaked by the miss
+    a.check()
+
+
+# ---------------------------------------------------------------------------
+# the paged Pallas kernel vs the gathered dense oracle
+# ---------------------------------------------------------------------------
+
+def _dense_ref(q, k, v, curs, k_scale=None, v_scale=None):
+    if k_scale is not None:
+        k = k.astype(jnp.float32) * k_scale[..., None]
+        v = v.astype(jnp.float32) * v_scale[..., None]
+    B, KV, L, D = k.shape
+    H = q.shape[1]
+    k = jnp.repeat(k, H // KV, axis=1)
+    v = jnp.repeat(v, H // KV, axis=1)
+    s = jnp.einsum("bhd,bhld->bhl", q.astype(jnp.float32),
+                   k.astype(jnp.float32)) / (D ** 0.5)
+    mask = jnp.arange(L)[None, None] <= curs[:, None, None]
+    s = jnp.where(mask, s, -1e30)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhl,bhld->bhd", p, v.astype(jnp.float32))
+
+
+def _scatter_pages(contig, pt, NP, ps):
+    """[B, KV, L, *] logical rows -> [NP, KV, ps, *] pool via the page
+    table, POISON in every pool slot no table entry maps (incl. trash)."""
+    B, KV, L = contig.shape[:3]
+    pool = np.full((NP, KV, ps) + contig.shape[3:], POISON,
+                   contig.dtype if contig.dtype != np.int8 else np.float32)
+    pool = pool.astype(contig.dtype)
+    if contig.dtype == np.int8:
+        pool[:] = 127
+    for b in range(B):
+        for j in range(L // ps):
+            pool[pt[b, j]] = contig[b, :, j * ps:(j + 1) * ps]
+    return pool
+
+
+@pytest.mark.parametrize("H,KV", [(4, 4), (4, 2)])
+@pytest.mark.parametrize("quantized", [False, True])
+def test_paged_kernel_matches_dense(H, KV, quantized):
+    """Per-row cursors at block starts/interiors/ends over a shuffled
+    page table; beyond-cursor pool content is poisoned so a wrong page
+    resolution or missing mask shows up as a huge error."""
+    B, D, ps, nblk = 4, 16, 16, 4
+    L = ps * nblk
+    NP = B * nblk + 2                        # trash + one never-mapped
+    curs = np.array([0, 17, 31, 63], np.int32)
+    rs = np.random.RandomState(5)
+    # distinct physical pages per logical block, shuffled across the pool
+    perm = rs.permutation(np.arange(1, NP - 1)).reshape(B, nblk)
+    pt = perm.astype(np.int32)
+    q = jax.random.normal(jax.random.PRNGKey(0), (B, H, D), jnp.float32)
+    k = rs.randn(B, KV, L, D).astype(np.float32)
+    v = rs.randn(B, KV, L, D).astype(np.float32)
+    dead = np.arange(L)[None, None, :, None] > curs[:, None, None, None]
+    ks = vs = ksp = vsp = None
+    if quantized:
+        ks = np.maximum(np.abs(k).max(-1) / 127.0, 1e-8).astype(np.float32)
+        vs = np.maximum(np.abs(v).max(-1) / 127.0, 1e-8).astype(np.float32)
+        k = np.clip(np.round(k / ks[..., None]), -127, 127)
+        v = np.clip(np.round(v / vs[..., None]), -127, 127)
+        k = np.where(dead, 127, k).astype(np.int8)
+        v = np.where(dead, 127, v).astype(np.int8)
+        ks = np.where(dead[..., 0], POISON, ks)
+        vs = np.where(dead[..., 0], POISON, vs)
+        ksp = jnp.asarray(_scatter_pages(ks[..., None], pt, NP, ps)[..., 0])
+        vsp = jnp.asarray(_scatter_pages(vs[..., None], pt, NP, ps)[..., 0])
+    else:
+        k = np.where(dead, POISON, k)
+        v = np.where(dead, POISON, v)
+    kp = jnp.asarray(_scatter_pages(k, pt, NP, ps))
+    vp = jnp.asarray(_scatter_pages(v, pt, NP, ps))
+    ref = _dense_ref(q, jnp.asarray(k), jnp.asarray(v),
+                     jnp.asarray(curs),
+                     None if ks is None else jnp.asarray(ks),
+                     None if vs is None else jnp.asarray(vs))
+    out = paged_decode_attention(q, kp, vp, jnp.asarray(curs),
+                                 jnp.asarray(pt), k_scale=ksp,
+                                 v_scale=vsp, interpret=True)
+    np.testing.assert_allclose(np.asarray(ref), np.asarray(out), atol=2e-5)
+
+
+def test_paged_kernel_shared_pages_between_rows():
+    """Two rows whose tables alias the SAME physical prefix page (the
+    prefix-cache layout) read identical K/V through it."""
+    B, H, KV, D, ps, nblk = 2, 4, 2, 16, 16, 2
+    NP = 4
+    pt = np.array([[1, 2], [1, 3]], np.int32)    # page 1 shared
+    curs = np.array([ps + 3, ps + 7], np.int32)
+    rs = np.random.RandomState(9)
+    pool_k = rs.randn(NP, KV, ps, D).astype(np.float32)
+    pool_v = rs.randn(NP, KV, ps, D).astype(np.float32)
+    q = jax.random.normal(jax.random.PRNGKey(1), (B, H, D), jnp.float32)
+    # gather the logical view per row, then dense-reference it
+    gk = np.stack([np.concatenate([pool_k[p] for p in pt[b]], axis=1)
+                   for b in range(B)])
+    gv = np.stack([np.concatenate([pool_v[p] for p in pt[b]], axis=1)
+                   for b in range(B)])
+    ref = _dense_ref(q, jnp.asarray(gk), jnp.asarray(gv),
+                     jnp.asarray(curs))
+    out = paged_decode_attention(q, jnp.asarray(pool_k),
+                                 jnp.asarray(pool_v), jnp.asarray(curs),
+                                 jnp.asarray(pt), interpret=True)
+    np.testing.assert_allclose(np.asarray(ref), np.asarray(out), atol=2e-5)
+
+
+# ---------------------------------------------------------------------------
+# the paged engine vs the contiguous oracle
+# ---------------------------------------------------------------------------
+
+def _setup(decode_kernel=False, kv_cache_dtype=None, slots=4,
+           page_size=8, num_pages=None, max_len=64):
+    cfg = gpt2_config("test", attention="dense", dtype=jnp.float32,
+                      vocab_size=64, max_len=max_len,
+                      kv_cache_dtype=kv_cache_dtype)
+    model = CausalLM(cfg)
+    probe = jnp.zeros((1, 4), jnp.int32)
+    params = meta.unbox(model.init(jax.random.PRNGKey(0), probe))["params"]
+    contiguous = ServingEngine(model, params, EngineConfig(
+        slots=slots, chunk_buckets=(4, 8), decode_kernel=decode_kernel))
+    paged = ServingEngine(model, params, EngineConfig(
+        slots=slots, chunk_buckets=(4, 8), decode_kernel=decode_kernel,
+        paged=True, page_size=page_size, num_pages=num_pages))
+    return contiguous, paged
+
+
+def _mixed_trace(n=8, seed=7, eos=None):
+    rs = np.random.RandomState(seed)
+    lens = [(1, 6), (3, 9), (9, 4), (14, 7), (5, 5), (7, 8), (12, 6),
+            (2, 7)]
+    return [Request(i, list(rs.randint(0, 64, (p,))), max_new_tokens=m,
+                    eos_id=eos)
+            for i, (p, m) in enumerate(lens[:n])]
+
+
+@pytest.mark.parametrize("decode_kernel", [False, True])
+def test_paged_engine_token_exact_vs_contiguous(decode_kernel):
+    """The acceptance gate: greedy decode through the paged cache is
+    token-for-token identical to the contiguous engine on the same
+    trace — mixed prompt lengths, more requests than slots (slot AND
+    page reuse across retire/admit), dense and kernel paths."""
+    contiguous, paged = _setup(decode_kernel)
+    trace = _mixed_trace()
+    want = contiguous.run(trace)
+    got = paged.run(trace)
+    for r in trace:
+        assert got[r.id].tokens == want[r.id].tokens, \
+            f"request {r.id} diverged"
+        assert got[r.id].finish_reason == want[r.id].finish_reason
+    alloc = paged.page_allocator
+    alloc.check()
+    assert alloc.in_use == 0                 # every page released
+    counts = paged.compile_counts()
+    assert counts["step"] == 1 and counts["prefill"] <= 2
+
+
+def test_paged_engine_int8_cache_token_exact():
+    """The quantized cache pages ([NP, KV, ps] scale planes) through the
+    same oracle: int8 contiguous vs int8 paged, dense path."""
+    contiguous, paged = _setup(kv_cache_dtype="int8")
+    trace = _mixed_trace(n=5)
+    want = contiguous.run(trace)
+    got = paged.run(trace)
+    for r in trace:
+        assert got[r.id].tokens == want[r.id].tokens, \
+            f"request {r.id} diverged"
+
+
+def test_paged_engine_eos_retirement_reuses_pages():
+    """EOS mid-flight: retired requests release pages that later
+    arrivals re-allocate; tokens still match the contiguous engine."""
+    contiguous, paged = _setup()
+    probe = contiguous.run(_mixed_trace(n=1))
+    eos = probe[0].tokens[2]
+    contiguous.reset()
+    trace = _mixed_trace(eos=eos)            # 8 requests over 4 slots
+    want = contiguous.run(trace)
+    got = paged.run(trace)
+    assert any(r.finish_reason == "eos" for r in got.values())
+    for r in trace:
+        assert got[r.id].tokens == want[r.id].tokens
+    assert paged.page_allocator.in_use == 0
+
+
+@pytest.mark.parametrize("decode_kernel", [False, True])
+def test_prefix_hit_token_exact_and_skips_prefill(decode_kernel):
+    """A request sharing a cached prompt prefix admits with
+    cached_tokens > 0, runs FEWER prefill chunks, produces the exact
+    contiguous tokens, and reaches its first token faster from admission
+    (the queue-independent TTFT the bench reports)."""
+    contiguous, paged = _setup(decode_kernel)
+    rs = np.random.RandomState(3)
+    shared = list(rs.randint(0, 64, (40,)))      # 5 full pages of 8
+    cold = Request(0, shared + list(rs.randint(0, 64, (3,))), 6)
+    hot = Request(1, shared + list(rs.randint(0, 64, (3,))), 6)
+    want0 = contiguous.run([cold])
+    want1 = contiguous.run([hot])
+    got0 = paged.run([cold])                 # publishes the 5 pages
+    got1 = paged.run([hot])                  # pins them
+    assert got0[0].tokens == want0[0].tokens
+    assert got1[1].tokens == want1[1].tokens
+    assert got0[0].cached_tokens == 0
+    assert got1[1].cached_tokens == 40
+    # the hit skipped the shared prefill: first token comes faster from
+    # admission (5 chunk programs of work it never ran)
+    t_cold = got0[0].token_times[0] - got0[0].admitted_at
+    t_hot = got1[1].token_times[0] - got1[1].admitted_at
+    assert t_hot < t_cold
+    alloc = paged.page_allocator
+    assert alloc.hits == 5 and alloc.cached_pages == 5
+    alloc.check()
+
+
+def test_prefix_divergence_is_copy_on_write():
+    """Two prompts equal through page 2 then diverging INSIDE page 3:
+    the hit stops at the divergence page, which stays private — the
+    original's cached page is untouched and both match the oracle."""
+    contiguous, paged = _setup()
+    rs = np.random.RandomState(13)
+    head = list(rs.randint(0, 64, (16,)))        # 2 full pages of 8
+    a = Request(0, head + list(rs.randint(0, 64, (7,))), 5)
+    b = Request(1, head + list(rs.randint(0, 64, (7,))), 5)
+    want_a = contiguous.run([a])
+    want_b = contiguous.run([b])
+    got_a = paged.run([a])
+    got_b = paged.run([b])
+    assert got_a[0].tokens == want_a[0].tokens
+    assert got_b[1].tokens == want_b[1].tokens
+    assert got_b[1].cached_tokens == 16          # only the shared pages
+    # replaying A must still hit ITS chain exactly (page 3 not clobbered)
+    want_a2 = contiguous.run([a])
+    got_a2 = paged.run([a])
+    assert got_a2[0].tokens == want_a2[0].tokens
+    paged.page_allocator.check()
+
+
+def test_paged_capacity_beats_contiguous_at_equal_bytes():
+    """The tentpole's capacity claim: under the SAME cache byte budget
+    (2 contiguous rows of max_len=64 vs 16+1 pages of 8), the paged
+    engine sustains strictly more concurrent requests because short
+    requests reserve their actual worst case, not a whole row."""
+    budget_rows = 2
+    contiguous, paged = _setup(
+        slots=budget_rows, page_size=8,
+        num_pages=budget_rows * (64 // 8) + 1)   # byte parity + trash
+    # 6 short requests: each needs (6-2+6)//8+1 = 2 pages — the pool
+    # fits 6 concurrently (12 of 16 pages), contiguous caps at 2 rows
+    reqs = [Request(i, [int(t) for t in
+                        np.random.RandomState(i).randint(0, 64, (6,))],
+                    max_new_tokens=6) for i in range(6)]
+    want = contiguous.run(reqs)
+    assert contiguous.occupancy_peak == budget_rows
+    # a paged engine with MORE slots over the SAME pool bytes
+    cfg = gpt2_config("test", attention="dense", dtype=jnp.float32,
+                      vocab_size=64, max_len=64)
+    model = CausalLM(cfg)
+    probe = jnp.zeros((1, 4), jnp.int32)
+    params = meta.unbox(model.init(jax.random.PRNGKey(0), probe))["params"]
+    paged_wide = ServingEngine(model, params, EngineConfig(
+        slots=6, chunk_buckets=(4, 8), paged=True, page_size=8,
+        num_pages=budget_rows * (64 // 8) + 1))
+    got = paged_wide.run(reqs)
+    for r in reqs:
+        assert got[r.id].tokens == want[r.id].tokens
+    assert paged_wide.occupancy_peak > budget_rows
+    assert paged_wide.pages_in_use_peak <= budget_rows * (64 // 8)
+
+
+def test_paged_engine_rejects_unservable_request():
+    """A request whose worst-case span exceeds the whole pool can never
+    admit — run() rejects it up front instead of livelocking."""
+    _, paged = _setup(num_pages=4, page_size=8)  # 3 usable pages
+    with pytest.raises(ValueError, match="KV pages"):
+        paged.run([Request(0, [1] * 20, max_new_tokens=20)])

@@ -173,14 +173,15 @@ def test_sample_slots_mixed_rows_independent():
 # engine vs generate() (the oracle)
 # ---------------------------------------------------------------------------
 
-def _setup(decode_kernel=False, vocab=64, max_len=64):
+def _setup(decode_kernel=False, vocab=64, max_len=64, **cfg_kw):
     cfg = gpt2_config("test", attention="dense", dtype=jnp.float32,
                       vocab_size=vocab, max_len=max_len)
     model = CausalLM(cfg)
     probe = jnp.zeros((1, 4), jnp.int32)
     params = meta.unbox(model.init(jax.random.PRNGKey(0), probe))["params"]
     engine = ServingEngine(model, params, EngineConfig(
-        slots=4, chunk_buckets=(4, 8), decode_kernel=decode_kernel))
+        slots=4, chunk_buckets=(4, 8), decode_kernel=decode_kernel,
+        **cfg_kw))
     return model, params, engine
 
 
@@ -243,11 +244,16 @@ def test_engine_eos_retirement_and_slot_reuse():
             assert results[req.id].tokens[-1] == eos
 
 
-def test_engine_compile_counts_stay_fixed():
+@pytest.mark.parametrize("paged", [False, True])
+def test_engine_compile_counts_stay_fixed(paged):
     """The no-recompile contract: after a mixed greedy+sampling trace, a
     reset, and a second different-shape trace, the step has at most one
-    program per sample_slots mode and prefill one per bucket."""
-    _, _, engine = _setup()
+    program per sample_slots mode and prefill one per bucket. In paged
+    mode the reset must ALSO rewind the page allocator and prefix cache
+    — a replay of the same trace admits with zero carried-over state
+    (and identical tokens), still without recompiling."""
+    _, _, engine = _setup(**({"paged": True, "page_size": 8}
+                             if paged else {}))
     rs = np.random.RandomState(13)
 
     def trace(base):
@@ -257,15 +263,30 @@ def test_engine_compile_counts_stay_fixed():
                         top_k=5 if i % 2 else 0)
                 for i, p in enumerate([2, 6, 9, 13, 4])]
 
-    engine.run(trace(0))
+    t0 = trace(0)
+    a = engine.run(t0)
     first = engine.compile_counts()
     engine.reset()
+    if paged:
+        # the allocator rewound with the rest of the serving state:
+        # every page free, no refcounts, no cached prefixes (stale K/V
+        # must not survive into the zeroed cache)
+        alloc = engine.page_allocator
+        assert alloc.in_use == 0 and alloc.cached_pages == 0
+        assert alloc.available == alloc.usable
+        assert alloc.hits == alloc.misses == 0
+        alloc.check()
     engine.run(trace(100))
     second = engine.compile_counts()
     assert first == second                    # reset must not recompile
     assert second["step"] <= 3
     assert second["prefill"] <= len(engine.config.chunk_buckets)
     assert second["init_cache"] == 1 and second["cast"] == 1
+    engine.reset()
+    b = engine.run(t0)                        # identical replay post-reset
+    assert engine.compile_counts() == second
+    for r in t0:
+        assert b[r.id].tokens == a[r.id].tokens
 
 
 def test_engine_streams_tokens_in_order():
