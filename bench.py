@@ -174,7 +174,7 @@ def main() -> None:
                              "gpt2/bert/llama/vit/moe/long-seq/decode/"
                              "serving legs; individual names run one leg; "
                              "allreduce = the scaling-efficiency "
-                             "microbenchmark (BASELINE ≥90% 4→32); "
+                             "microbenchmark (BASELINE ≥90%% 4→32); "
                              "generate = KV-cache decode throughput; "
                              "serving = continuous batching vs sequential "
                              "generate() over a mixed-length trace")
@@ -735,6 +735,54 @@ def main() -> None:
                warmup=warm, batch=4, seq=2048)
         lm_leg("gpt2_seq4096", workload="gpt2", steps=min(args.steps, 10),
                warmup=warm, batch=2, seq=4096)
+        # Horizontally fused job packing (train/hfta.py): K=8 sweep
+        # replicas vmap-stacked into ONE jitted step, vs the SAME
+        # per-replica config run solo. K sequential sweep members
+        # process aggregate tokens at exactly the solo rate, so
+        # fused_speedup = fused aggregate tokens/sec ÷ solo tokens/sec
+        # IS the job-packing win. Both runs share size/batch/seq —
+        # nothing else differs.
+        if not over_budget("gpt2_hfta8"):
+            try:
+                clear_residue()
+                from mpi_operator_tpu.examples.lm_benchmark import (
+                    run_hfta_benchmark)
+                hfta_k = 8
+                hsize = "test" if args.smoke else "small"
+                hbatch = 2 if args.smoke else 8
+                hseq = 32 if args.smoke else 512
+                hsteps = min(args.steps, 10)
+                seqm = run_lm("gpt2", hsteps, warm, batch=hbatch,
+                              seq=hseq, size=hsize)
+                clear_residue()
+                _hs, hm = retry_infra_once(lambda: run_hfta_benchmark(
+                    workload="gpt2", size=hsize, batch_per_device=hbatch,
+                    seq_len=hseq, num_steps=hsteps, warmup_steps=warm,
+                    dtype_name=args.dtype, k=hfta_k,
+                    log=lambda s: print(s, file=sys.stderr)))
+                del _hs
+                fused = hm["tokens_per_sec"]
+                solo = seqm["tokens_per_sec"]
+                fields = {
+                    "gpt2_hfta8_tokens_per_sec": round(fused, 0),
+                    "sequential_tokens_per_sec": round(solo, 0),
+                    "fused_speedup": round(fused / max(solo, 1e-9), 3),
+                    "per_replica_mfu": hm["per_replica"]["mfu"],
+                    "per_replica_goodput": hm["per_replica"]["goodput"],
+                }
+                if hm.get("mfu") is not None:
+                    fields["gpt2_hfta8_mfu"] = round(hm["mfu"], 4)
+                line.update(fields)
+                emit_leg("gpt2_hfta8", fields)
+            except Exception as exc:  # noqa: BLE001
+                from mpi_operator_tpu.train.resilience import Preempted
+                if isinstance(exc, Preempted):
+                    raise
+                print(f"# gpt2_hfta8 bench leg failed: {exc!r}",
+                      file=sys.stderr)
+                line["gpt2_hfta8_error"] = type(exc).__name__
+                emit_leg("gpt2_hfta8",
+                         {"gpt2_hfta8_error": type(exc).__name__})
         # the SAME decode suite as --workload generate — the driver
         # records only this default run, so a leg measured in one mode
         # but not here would be effectively unmeasured. Primary MBU

@@ -225,6 +225,13 @@ class TPUJobSpec:
     # valid v5e size down to 1 chip)
     min_tpus: Optional[int] = None
 
+    # Job packing opt-in (controller/packing.py): jobs sharing a
+    # (namespace, pack_group) whose resource shape matches are fused onto
+    # ONE shared worker gang — the oldest member leads and owns the pods;
+    # the rest get a "Packed" condition naming the leader. None (default)
+    # keeps the ordinary one-job-one-gang behavior.
+    pack_group: Optional[str] = None
+
 
 # ---------------------------------------------------------------------------
 # Status — v1alpha2 condition model (ref common_types.go:23-156)

@@ -59,6 +59,7 @@ from ..cluster.resources import (
     StatefulSetSpec,
 )
 from ..cluster.workqueue import RateLimitingQueue, meta_namespace_key, split_key
+from .packing import COND_PACKED, PackPlan, plan_packing
 
 logger = logging.getLogger("tpujob-controller")
 
@@ -356,10 +357,14 @@ class TPUJobController:
         self.pdb_lister = self.pdb_informer.lister()
         self.service_lister = self.service_informer.lister()
 
-        # TPUJob events: enqueue the job itself (ref :204-209)
+        # TPUJob events: enqueue the job itself (ref :204-209); a packed
+        # job's events additionally fan out to its pack peers — the
+        # leader's gang must absorb membership changes, including member
+        # DELETION (which the per-job key alone would never resync)
         self.job_informer.add_event_handler(
-            on_add=self.enqueue_tpu_job,
-            on_update=lambda old, new: self.enqueue_tpu_job(new),
+            on_add=self._enqueue_job_event,
+            on_update=lambda old, new: self._enqueue_job_event(new),
+            on_delete=self._enqueue_job_event,
         )
         # dependent kinds: map back to owning TPUJob (ref :210-321)
         for informer in (
@@ -383,6 +388,20 @@ class TPUJobController:
     def enqueue_tpu_job(self, obj) -> None:
         """ref: enqueueMPIJob (:796-804)."""
         self.queue.add(meta_namespace_key(obj))
+
+    def _enqueue_job_event(self, obj) -> None:
+        """TPUJob informer event: enqueue the job, plus its pack peers
+        when it opts into packing (controller/packing.py) — the peers'
+        plans all depend on this job's existence and shape."""
+        self.enqueue_tpu_job(obj)
+        group = getattr(obj.spec, "pack_group", None)
+        if not group:
+            return
+        for peer in self.job_lister.list():
+            if (peer.spec.pack_group == group
+                    and peer.metadata.namespace == obj.metadata.namespace
+                    and peer.metadata.name != obj.metadata.name):
+                self.enqueue_tpu_job(peer)
 
     def handle_object(self, obj) -> None:
         """ref: handleObject (:811-844) — owner lookup → enqueue TPUJob."""
@@ -494,6 +513,18 @@ class TPUJobController:
                 and not invalid_spec)
         )
 
+        # job packing (controller/packing.py): resolve this job's pack
+        # from the informer view. A non-leader member short-circuits —
+        # it creates NO pods; the leader's gang is its data plane.
+        pack: Optional[PackPlan] = None
+        if job.spec.pack_group and not terminal:
+            pack = plan_packing(job, self.job_lister.list())
+            if pack is not None and not pack.is_leader(job.metadata.name):
+                self._sync_packed_member(job, pack, launcher)
+                return
+            if pack is not None and pack.k > 1:
+                job = self._note_pack_leader(job, pack)
+
         # gang restart (v1alpha2 RestartPolicy, common_types.go:131-156):
         # a failed launcher is recreated when the policy allows it and the
         # backoff budget isn't exhausted; workers stay up (kubelet restarts
@@ -571,7 +602,7 @@ class TPUJobController:
                 self.get_or_create_pdb(job, alloc.worker_replicas)  # ref :490-494
 
         workers, resized = self.get_or_create_worker_statefulsets(
-            job, alloc)                                            # ref :497
+            job, alloc, pack=pack)                                 # ref :497
 
         if resized and launcher is not None and not done:
             # the running launcher carries the OLD topology env (batch Job
@@ -612,8 +643,8 @@ class TPUJobController:
         # against a gang that was just deleted. The next sync sees the
         # true readiness and recreates it with the new env.
         if not done and workers_ready and launcher is None and not resized:
-            launcher, _ = self._create_or_get(self.new_launcher(job, alloc),
-                                              job)
+            launcher, _ = self._create_or_get(
+                self.new_launcher(job, alloc, pack=pack), job)
 
         self.update_tpu_job_status(job, launcher, workers)     # ref :513, :761-791
 
@@ -627,6 +658,59 @@ class TPUJobController:
                             launcher.metadata.name)
 
         self.recorder.event(job, "Normal", "Synced", "TPUJob synced successfully")
+
+    def _sync_packed_member(self, job: TPUJob, pack: PackPlan,
+                            launcher: Optional[Job]) -> None:
+        """A packed non-leader's whole reconcile: own NOTHING, say where
+        the work actually runs. Any resources from a pre-packing life
+        (the job ran standalone before an older peer appeared) are torn
+        down; the Packed condition names the leader and the job's replica
+        index inside the fused gang; the leader is re-queued so its
+        worker template absorbs the membership."""
+        member = job.metadata.name
+        msg = (f"packed into the gang of leader {pack.leader!r} as "
+               f"replica {pack.index(member)} of {pack.k} "
+               f"(group {pack.group!r})")
+        if launcher is not None:
+            try:
+                self.api.delete("Job", launcher.metadata.namespace,
+                                launcher.metadata.name)
+            except NotFoundError:
+                pass
+        for sts in self.statefulset_lister.list(job.metadata.namespace):
+            if (is_controlled_by(sts.metadata, job.metadata)
+                    and sts.metadata.labels.get(LABEL_GROUP) == member):
+                try:
+                    self.api.delete("StatefulSet", sts.metadata.namespace,
+                                    sts.metadata.name)
+                except NotFoundError:
+                    pass
+        cond = job.status.get_condition(COND_PACKED)
+        if not (cond is not None and cond.status == "True"
+                and cond.message == msg):
+            job.status.set_condition(api.JobCondition(
+                COND_PACKED, "True", "PackedWithLeader", msg))
+            job = self.api.update_status(job)
+            self.recorder.event(job, "Normal", "Packed", msg)
+        leader = self.job_lister.try_get(job.metadata.namespace, pack.leader)
+        if leader is not None:
+            self.enqueue_tpu_job(leader)
+
+    def _note_pack_leader(self, job: TPUJob, pack: PackPlan) -> TPUJob:
+        """Record pack leadership in status (idempotent per membership);
+        returns the fresh object so later status PUTs in the same sync
+        carry the right resourceVersion."""
+        msg = (f"leading a packed gang of {pack.k} jobs: "
+               f"{','.join(pack.members)}")
+        cond = job.status.get_condition(COND_PACKED)
+        if (cond is not None and cond.status == "True"
+                and cond.message == msg):
+            return job
+        job.status.set_condition(api.JobCondition(
+            COND_PACKED, "True", "PackLeader", msg))
+        job = self.api.update_status(job)
+        self.recorder.event(job, "Normal", "PackLeader", msg)
+        return job
 
     def _fail_invalid_spec(self, job: TPUJob, message: str,
                            launcher: Optional[Job] = None) -> None:
@@ -1045,7 +1129,8 @@ class TPUJobController:
         return existing
 
     def get_or_create_worker_statefulsets(
-        self, job: TPUJob, alloc: AllocationResult
+        self, job: TPUJob, alloc: AllocationResult,
+        pack: Optional[PackPlan] = None,
     ) -> Tuple[List[Optional[StatefulSet]], bool]:
         """ref: getOrCreateWorkerStatefulSet (:726-759): create if missing and
         workers>0; update on replica drift (incl. scale-down-to-0 on done).
@@ -1068,7 +1153,8 @@ class TPUJobController:
                     out.append(None)
                     continue
                 existing, created = self._create_or_get(
-                    self.new_worker(job, alloc, slice_id=slice_id), job)
+                    self.new_worker(job, alloc, slice_id=slice_id,
+                                    pack=pack), job)
                 if created:
                     out.append(existing)
                     continue
@@ -1086,7 +1172,11 @@ class TPUJobController:
             # fields the controller OWNS (a real API server defaults
             # extra fields; whole-object equality would churn forever).
             if per_group > 0:
-                desired = self.new_worker(job, alloc, slice_id=slice_id)
+                # pack env rides in the template, so the template hash —
+                # and with it the level-triggered gang restart below —
+                # covers pack MEMBERSHIP changes too
+                desired = self.new_worker(job, alloc, slice_id=slice_id,
+                                          pack=pack)
                 if _worker_template_drifted(existing.spec.template,
                                             desired.spec.template):
                     existing.spec.template = desired.spec.template
@@ -1327,7 +1417,8 @@ class TPUJobController:
         return env
 
     def new_worker(self, job: TPUJob, alloc: AllocationResult,
-                   slice_id: int = 0) -> StatefulSet:
+                   slice_id: int = 0,
+                   pack: Optional[PackPlan] = None) -> StatefulSet:
         """ref: newWorker (:1004-1083). Differences by design (SURVEY §7):
         workers run the actual training process (not `sleep 365d`), carry
         `google.com/tpu` limits + slice node selectors, and get the bootstrap
@@ -1343,6 +1434,7 @@ class TPUJobController:
         container.env = {
             **container.env,
             **self._discovery_env(job, alloc, is_launcher=False),
+            **(pack.env() if pack is not None else {}),
         }
         if alloc.num_slices > 1:
             container.env["TPU_SLICE_ID"] = str(slice_id)
@@ -1494,7 +1586,8 @@ class TPUJobController:
                             "mountPath": CONFIG_MOUNT_PATH}],
         )
 
-    def new_launcher(self, job: TPUJob, alloc: AllocationResult) -> Job:
+    def new_launcher(self, job: TPUJob, alloc: AllocationResult,
+                     pack: Optional[PackPlan] = None) -> Job:
         """ref: newLauncher (:1088-1236). No kubectl-delivery init container
         (ref :1106-1121) and no OMPI_MCA_* env (ref :1123-1131): the launcher
         is a thin coordinator / rank-0 process bootstrapped by the same env
@@ -1505,6 +1598,7 @@ class TPUJobController:
         container.env = {
             **container.env,
             **self._discovery_env(job, alloc, is_launcher=True),
+            **(pack.env() if pack is not None else {}),
         }
         container.volume_mounts = container.volume_mounts + [
             {"name": CONFIG_VOLUME_NAME, "mountPath": CONFIG_MOUNT_PATH}

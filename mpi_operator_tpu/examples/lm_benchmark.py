@@ -502,6 +502,108 @@ def run_lm_benchmark(
     return state, metrics
 
 
+def run_hfta_benchmark(
+    workload: str = "gpt2",
+    size: Optional[str] = None,
+    batch_per_device: int = 8,
+    seq_len: int = 512,
+    num_steps: int = 50,
+    warmup_steps: int = 5,
+    dtype_name: str = "bfloat16",
+    k: int = 8,
+    learning_rates=None,
+    seeds=None,
+    num_layers: Optional[int] = None,
+    train_dir: Optional[str] = None,
+    lr_schedule: str = "linear",
+    decay_steps: int = 10_000,
+    lr: Optional[float] = None,
+    lr_warmup_steps: Optional[int] = None,
+    metrics_port: Optional[int] = None,
+    event_log: Optional[str] = None,
+    events=None,
+    log: Callable[[str], None] = print,
+) -> Tuple[object, Dict[str, float]]:
+    """Horizontally fused sweep benchmark: K model replicas vmap-stacked
+    into ONE jitted step (train/hfta.py). Each replica trains on its own
+    batch_per_device × device_count batch, so the fused run does K× the
+    token work of the solo benchmark per step — the aggregate tokens/sec
+    it reports is directly comparable to K sequential solo runs.
+
+    The token stream stays STEP-KEYED like the solo path (replica r's
+    batch at global step i is fold_in(fold_in(PRNGKey(1), i), r)), so a
+    restarted fused run replays the same per-replica tokens."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..data.synthetic import synthetic_token_batch
+    from ..models.transformer import create_lm
+    from ..parallel import MeshConfig, make_mesh
+    from ..train.checkpoint import (maybe_resume, maybe_save,
+                                    wait_for_checkpoints)
+    from ..train.hfta import HFTAHyperparams, HFTATrainer
+    from ..train.lm_trainer import LMTrainerConfig
+
+    if workload not in ("gpt2", "llama"):
+        raise ValueError(f"--hfta fuses causal-LM workloads only "
+                         f"(got {workload!r})")
+    n = jax.device_count()
+    mesh = make_mesh(MeshConfig(dp=n))   # pure data-parallel gang
+    dtype = jnp.bfloat16 if dtype_name == "bfloat16" else jnp.float32
+
+    name = f"{workload}-{size}" if size else workload
+    overrides = {"num_layers": num_layers} if num_layers else {}
+    model = create_lm(name, dtype=dtype, max_len=max(seq_len, 32),
+                      **overrides)
+    vocab = model.config.vocab_size
+
+    global_batch = batch_per_device * n        # PER-REPLICA batch
+    opt_overrides = {}
+    if lr is not None:
+        opt_overrides["learning_rate"] = lr
+    if lr_warmup_steps is not None:
+        opt_overrides["warmup_steps"] = lr_warmup_steps
+    tcfg = LMTrainerConfig(global_batch_size=global_batch, seq_len=seq_len,
+                           lr_schedule=lr_schedule, decay_steps=decay_steps,
+                           **opt_overrides)
+    hp = HFTAHyperparams.sweep(k, tcfg, learning_rates=learning_rates,
+                               seeds=seeds)
+    trainer = HFTATrainer(model, mesh, tcfg, hp)
+    log(f"hfta: fusing K={k} × {name} replicas, "
+        f"lrs={list(hp.learning_rates)} seeds={list(hp.seeds)}")
+
+    wtel, owns_events = _worker_telemetry(metrics_port, event_log,
+                                          train_dir, events, log)
+    try:
+        state = trainer.init_state()
+        state = maybe_resume(train_dir, state, log)
+
+        @jax.jit
+        def fused_batch(i):
+            step_key = jax.random.fold_in(jax.random.PRNGKey(1), i)
+            keys = jax.vmap(
+                lambda r: jax.random.fold_in(step_key, r))(jnp.arange(k))
+            return jax.vmap(lambda key: synthetic_token_batch(
+                key, global_batch, seq_len, vocab))(keys)
+
+        def stream(start):
+            i = start
+            while True:
+                yield fused_batch(i)
+                i += 1
+
+        state, metrics = trainer.benchmark(
+            state, stream(int(state.step)), num_steps=num_steps,
+            warmup_steps=warmup_steps, log=log, registry=wtel.registry)
+        maybe_save(train_dir, state, log, block=False)
+    finally:
+        wtel.close(close_events=owns_events)
+    wait_for_checkpoints()
+    metrics["replica_learning_rates"] = list(hp.learning_rates)
+    metrics["replica_seeds"] = list(hp.seeds)
+    return state, metrics
+
+
 def run_generate_benchmark(
     size: Optional[str] = None,
     batch: int = 8,
@@ -763,6 +865,17 @@ def main(argv=None) -> int:
                              "opposite directions — half the bytes per "
                              "hop on a bidirectional ICI torus (needs "
                              "--tp-overlap)")
+    parser.add_argument("--hfta", type=int, default=0,
+                        help="fuse K sweep replicas into one vmap-stacked "
+                             "train step (train/hfta.py): K× the token "
+                             "work per step, aggregate tokens/sec "
+                             "reported; causal LM only")
+    parser.add_argument("--hfta-lrs", default=None,
+                        help="comma-separated per-replica learning rates "
+                             "(K values; default: config lr broadcast)")
+    parser.add_argument("--hfta-seeds", default=None,
+                        help="comma-separated per-replica init seeds "
+                             "(K values; default: all 0)")
     parser.add_argument("--fused-xent", action="store_true",
                         help="chunked tied-head cross-entropy: the full "
                              "[B*S, vocab] logits never hit HBM - slower "
@@ -875,6 +988,28 @@ def main(argv=None) -> int:
             headline = {"metric": "vit_images_per_sec",
                         "value": round(metrics["images_per_sec"], 2),
                         "unit": "images/sec"}
+        elif args.hfta:
+            _state, metrics = run_hfta_benchmark(
+                workload=args.workload, size=args.size,
+                batch_per_device=args.batch_per_device or 8,
+                seq_len=args.seq_len, num_steps=args.num_steps,
+                warmup_steps=args.warmup_steps, dtype_name=args.dtype,
+                k=args.hfta,
+                learning_rates=[float(x) for x in args.hfta_lrs.split(",")]
+                if args.hfta_lrs else None,
+                seeds=[int(x) for x in args.hfta_seeds.split(",")]
+                if args.hfta_seeds else None,
+                num_layers=args.num_layers or None,
+                train_dir=args.train_dir,
+                lr_schedule=args.lr_schedule,
+                decay_steps=args.decay_steps, lr=args.lr,
+                lr_warmup_steps=args.lr_warmup_steps,
+                metrics_port=args.metrics_port, events=events,
+                log=log)
+            headline = {"metric":
+                        f"{args.workload}_hfta{args.hfta}_tokens_per_sec",
+                        "value": round(metrics["tokens_per_sec"], 0),
+                        "unit": "tokens/sec (aggregate)"}
         else:
             _state, metrics = run_lm_benchmark(
                 workload=args.workload, size=args.size,

@@ -16,7 +16,7 @@ from __future__ import annotations
 
 import dataclasses
 from collections import deque
-from typing import List, Optional, Sequence, Tuple
+from typing import Dict, List, Optional, Sequence, Tuple
 
 
 @dataclasses.dataclass
@@ -170,6 +170,11 @@ class Scheduler:
         self.admit_lookahead = admit_lookahead
         self.queue: deque[Request] = deque()
         self.active: List[RequestState] = []
+        # slot-aware reserve-ahead (paged mode): page reservations made
+        # while NO slot was free, keyed by request id — see admit().
+        # Dies with the scheduler (engine reset() also resets the
+        # allocator, so no pins leak).
+        self.staged: Dict[int, Tuple[List[int], List[int], List[int]]] = {}
 
     def submit(self, req: Request) -> None:
         p = len(req.prompt)
@@ -228,7 +233,15 @@ class Scheduler:
         PageAllocator, a request is admitted only when its page span
         reserves (see `_reserve_pages`); a head that doesn't fit lets up
         to `admit_lookahead` arrived requests behind it try (packing).
-        Returns the new RequestStates (also tracked in self.active)."""
+        Returns the new RequestStates (also tracked in self.active).
+
+        Slot-aware reserve-ahead (the dual of the lookahead above): when
+        pages FIT but no slot is free, up to `admit_lookahead` arrived
+        requests reserve their page spans NOW and park them in
+        `self.staged`. Two wins: the reservation pins their cached
+        prefix chains before decode-side allocations can evict them, and
+        the moment a slot frees the head admits instantly — no
+        reservation work on that step's critical path."""
         out = []
         while free_slots and self.queue and self.queue[0].arrival <= now:
             picked = None
@@ -238,7 +251,9 @@ class Scheduler:
                 if allocator is None:
                     picked = (idx, req, None)
                     break
-                reserved = self._reserve_pages(req, allocator)
+                reserved = self.staged.pop(req.id, None)
+                if reserved is None:
+                    reserved = self._reserve_pages(req, allocator)
                 if reserved is not None:
                     picked = (idx, req, reserved)
                     break
@@ -266,6 +281,15 @@ class Scheduler:
                                         start=span)
             self.active.append(st)
             out.append(st)
+        if allocator is not None and not free_slots:
+            for idx, req in enumerate(self.queue):
+                if idx >= self.admit_lookahead or req.arrival > now:
+                    break
+                if req.id in self.staged:
+                    continue
+                reserved = self._reserve_pages(req, allocator)
+                if reserved is not None:
+                    self.staged[req.id] = reserved
         return out
 
     def next_prefill(self) -> Optional[RequestState]:

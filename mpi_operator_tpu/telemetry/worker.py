@@ -52,7 +52,7 @@ Serve series (ServingEngine):
 from __future__ import annotations
 
 import threading
-from typing import Optional
+from typing import Dict, Optional
 
 from .core import Registry
 from .events import EventLog
@@ -60,33 +60,51 @@ from .prometheus import TelemetryServer
 
 
 class TrainTelemetry:
-    """Train-loop instruments over a shared registry."""
+    """Train-loop instruments over a shared registry.
 
-    def __init__(self, registry: Optional[Registry] = None):
+    ``labels`` stamps every instrument in the bundle with the same label
+    set, so several bundles can share one registry and render as distinct
+    series under the same names — the HFTA fused trainer creates one
+    bundle per packed replica (``labels={"replica": "3"}``) and the
+    controller packing path one per job (``labels={"job": name}``).
+    """
+
+    def __init__(self, registry: Optional[Registry] = None,
+                 labels: Optional[Dict[str, str]] = None):
         reg = registry if registry is not None else Registry()
         self.registry = reg
+        self.labels = dict(labels) if labels else None
+        labels = self.labels
         self.step_seconds = reg.histogram(
-            "tpu_worker_step_seconds", "per-step wall time (seconds)")
+            "tpu_worker_step_seconds", "per-step wall time (seconds)",
+            labels=labels)
         self.host_gap_seconds = reg.histogram(
             "tpu_worker_host_gap_seconds",
             "host blocked-on-device time at window fetches",
-            lo=1e-5, hi=1e3)
+            lo=1e-5, hi=1e3, labels=labels)
         self.tokens_per_sec = reg.gauge(
-            "tpu_worker_tokens_per_sec", "last-window LM tokens/sec")
+            "tpu_worker_tokens_per_sec", "last-window LM tokens/sec",
+            labels=labels)
         self.examples_per_sec = reg.gauge(
-            "tpu_worker_examples_per_sec", "last-window examples/sec")
+            "tpu_worker_examples_per_sec", "last-window examples/sec",
+            labels=labels)
         self.mfu = reg.gauge(
-            "tpu_worker_mfu", "model FLOPs utilization (0-1)")
+            "tpu_worker_mfu", "model FLOPs utilization (0-1)",
+            labels=labels)
         self.goodput = reg.gauge(
-            "tpu_worker_goodput", "productive steps / total steps (0-1)")
+            "tpu_worker_goodput", "productive steps / total steps (0-1)",
+            labels=labels)
         self.steps_total = reg.counter(
-            "tpu_worker_steps_total", "train steps executed")
+            "tpu_worker_steps_total", "train steps executed",
+            labels=labels)
         self.skipped_steps_total = reg.counter(
             "tpu_worker_skipped_steps_total",
-            "divergence-guard skipped steps (lower bound)")
+            "divergence-guard skipped steps (lower bound)",
+            labels=labels)
         self.rollback_steps_total = reg.counter(
             "tpu_worker_rollback_steps_total",
-            "steps rewound by divergence rollbacks")
+            "steps rewound by divergence rollbacks",
+            labels=labels)
         self._lock = threading.Lock()
         self._last_streak = 0
         self.goodput.set(1.0)

@@ -279,6 +279,10 @@ class FaultInjector:
       corrupt-latest-checkpoint scribble the newest step_N before resume
       delay-coordinator:K       first K jax.distributed.initialize
                                 attempts fail (exercises init retry)
+      nan-replica:K@N           poison fused-trainer replica K's params
+                                with NaN at step N (HFTA divergence-
+                                isolation drill; '@' because ':' starts
+                                the arg and ';'/',' separate directives)
 
     Unknown directives raise at parse time — a typo'd fault spec that
     silently injects nothing would green a test that proved nothing."""
@@ -288,6 +292,8 @@ class FaultInjector:
         self.sigterm_at_step: Optional[int] = None
         self.corrupt_latest = False
         self.delay_coordinator = 0
+        self.nan_replica: Optional[int] = None
+        self.nan_replica_step: Optional[int] = None
         self._injected_init_failures = 0
         for raw in re.split(r"[;,]", spec or ""):
             part = raw.strip()
@@ -302,11 +308,16 @@ class FaultInjector:
                 self.corrupt_latest = True
             elif name == "delay-coordinator":
                 self.delay_coordinator = int(arg)
+            elif name == "nan-replica":
+                replica, _, at = arg.partition("@")
+                self.nan_replica = int(replica)
+                self.nan_replica_step = int(at)
             else:
                 raise ValueError(
                     f"unknown {ENV_FAULT_INJECT} directive {part!r}; known: "
                     f"die-at-step:N, sigterm-at-step:N, "
-                    f"corrupt-latest-checkpoint, delay-coordinator:K")
+                    f"corrupt-latest-checkpoint, delay-coordinator:K, "
+                    f"nan-replica:K@N")
 
     @classmethod
     def from_env(cls, env=None) -> Optional["FaultInjector"]:
@@ -326,6 +337,16 @@ class FaultInjector:
             os.kill(os.getpid(), signal.SIGTERM)
             return True
         return False
+
+    def check_nan_replica(self, step: int) -> Optional[int]:
+        """One-shot nan-replica:K@N probe — returns the replica index to
+        poison when `step` has reached the trigger, else None. The HFTA
+        benchmark loop consults this before dispatching each step."""
+        if (self.nan_replica_step is not None
+                and step >= self.nan_replica_step):
+            self.nan_replica_step = None       # one shot
+            return self.nan_replica
+        return None
 
     def maybe_corrupt_checkpoint(self, train_dir: Optional[str],
                                  log: Callable[[str], None] = print

@@ -9,10 +9,12 @@
 #     (real XLA collectives, no TPUs needed).
 #   - -m 'not slow' excludes the multi-second compile variants; the
 #     `multichip` marker (tests/conftest.py) stays INCLUDED here because
-#     the virtual-device mesh satisfies it.
-#   - timeout -k 10 1500: the whole suite must land in ~25 min (870,
-#     then 1140, then 1320, until 2026-08-05 — see the budget history
-#     note in ROADMAP.md).
+#     the virtual-device mesh satisfies it, and so do the `serving` and
+#     `hfta` markers (run `pytest -m hfta` to gate the fused-trainer
+#     surface alone).
+#   - timeout -k 10 1860: the whole suite must land in ~31 min (870,
+#     then 1140, then 1320, then 1500 until 2026-08-05 — see the budget
+#     history note in ROADMAP.md).
 #   - DOTS_PASSED counts progress dots from the captured log so the
 #     driver can read a pass-count even when pytest's summary line is
 #     cut off by the timeout.
@@ -76,4 +78,4 @@ if [ "${1:-}" = "--resilience" ]; then
   exit 0
 fi
 
-set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 1500 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --durations=15 --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=${PIPESTATUS[0]}; echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c); exit $rc
+set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 1860 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --durations=15 --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=${PIPESTATUS[0]}; echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c); exit $rc

@@ -51,3 +51,7 @@ def pytest_configure(config):
         "markers",
         "serving: continuous-batching engine tests (serve/); select with "
         "-m serving to gate the serving surface alone")
+    config.addinivalue_line(
+        "markers",
+        "hfta: horizontally fused trainer tests (train/hfta.py); select "
+        "with -m hfta to gate the job-packing data plane alone")

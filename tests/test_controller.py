@@ -1717,3 +1717,110 @@ def test_failed_gang_deletion_is_retried():
     f.run("default/test")                    # retried and succeeds
     assert f.api.list("Pod", "default",
                       label_selector="tpu_job_name=test") == []
+
+
+# ---------------------------------------------------------------------------
+# job packing (controller/packing.py: shared gang for compatible jobs)
+# ---------------------------------------------------------------------------
+
+def _pack_job(name, ts, tpus=8, group="sweep"):
+    job = new_job(name=name, tpus=tpus, pack_group=group)
+    job.metadata.creation_timestamp = ts
+    return job
+
+
+def test_pack_leader_gang_carries_membership_env():
+    """Oldest compatible member leads: its worker gang (and launcher)
+    carry the TPU_PACK_* identity env naming every packed job."""
+    f = Fixture()
+    f.seed(_pack_job("a", 100.0))
+    f.seed(_pack_job("b", 200.0))
+    actions = f.run("default/a")
+    assert ("create", "StatefulSet") in verbs(actions)
+    sts = f.api.get("StatefulSet", "default", "a" + WORKER_SUFFIX)
+    env = sts.spec.template.main_container().env
+    assert env["TPU_PACK_GROUP"] == "sweep"
+    assert env["TPU_PACK_JOBS"] == "a,b"      # leader first = replica 0
+    assert env["TPU_PACK_K"] == "2"
+    job = f.api.get(api.KIND, "default", "a")
+    cond = job.status.get_condition("Packed")
+    assert cond is not None and cond.reason == "PackLeader"
+    # launcher (gated on Ready workers) inherits the same identity env
+    _seed_ready_workers(f, "a" + WORKER_SUFFIX, 2)
+    f.run("default/a")
+    launcher = f.api.get("Job", "default", "a" + LAUNCHER_SUFFIX)
+    assert launcher.spec.template.main_container().env[
+        "TPU_PACK_JOBS"] == "a,b"
+
+
+def test_packed_member_owns_nothing():
+    """A non-leader's sync short-circuits: no gang, no launcher — only a
+    Packed condition naming the leader and its replica index."""
+    f = Fixture()
+    f.seed(_pack_job("a", 100.0))
+    f.seed(_pack_job("b", 200.0))
+    actions = f.run("default/b")
+    assert verbs(actions) == [("update-status", "TPUJob")]
+    job = f.api.get(api.KIND, "default", "b")
+    cond = job.status.get_condition("Packed")
+    assert cond is not None and cond.reason == "PackedWithLeader"
+    assert "'a'" in cond.message and "replica 1 of 2" in cond.message
+    # idempotent: a second sync emits nothing
+    assert f.run("default/b") == []
+
+
+def test_pack_requires_identical_resource_shape():
+    """Same group, different shape (tpus=16): NOT forced into the pack —
+    it leads its own shape-class with no pack env (a gang of one)."""
+    f = Fixture()
+    f.seed(_pack_job("a", 100.0))
+    f.seed(_pack_job("b", 200.0))
+    f.seed(_pack_job("big", 50.0, tpus=16))   # oldest overall, wrong shape
+    f.run("default/a")
+    env = f.api.get("StatefulSet", "default",
+                    "a" + WORKER_SUFFIX).spec.template.main_container().env
+    assert env["TPU_PACK_JOBS"] == "a,b"      # big excluded despite age
+    f.run("default/big")
+    env = f.api.get("StatefulSet", "default",
+                    "big" + WORKER_SUFFIX).spec.template.main_container().env
+    assert "TPU_PACK_GROUP" not in env        # solo leader: template as-is
+
+
+def test_pack_membership_change_is_a_template_edit():
+    """Adding a member to a running solo leader rewrites the worker env —
+    an ordinary level-triggered template drift, so the gang restarts on
+    the new member list. A member finishing shrinks it back."""
+    f = Fixture()
+    f.seed(_pack_job("a", 100.0))
+    f.run("default/a")
+    env = f.api.get("StatefulSet", "default",
+                    "a" + WORKER_SUFFIX).spec.template.main_container().env
+    assert "TPU_PACK_GROUP" not in env        # pack of one: no env at all
+    f.seed(_pack_job("b", 200.0))
+    f.run("default/a")
+    env = f.api.get("StatefulSet", "default",
+                    "a" + WORKER_SUFFIX).spec.template.main_container().env
+    assert env["TPU_PACK_JOBS"] == "a,b"
+    # b finishes: it drops out of the plan and the env shrinks again
+    b = f.api.get(api.KIND, "default", "b")
+    b.status.set_condition(api.JobCondition(
+        api.COND_SUCCEEDED, "True", "Done", "done"))
+    f.api.update_status(b)
+    f.run("default/a")
+    env = f.api.get("StatefulSet", "default",
+                    "a" + WORKER_SUFFIX).spec.template.main_container().env
+    assert "TPU_PACK_GROUP" not in env
+
+
+def test_packed_member_tears_down_pre_packing_resources():
+    """b ran standalone first (created its own gang), THEN an older peer
+    appeared (lister lag): b's next sync deletes its launcher/workers and
+    defers to the leader."""
+    f = Fixture()
+    f.seed(_pack_job("b", 200.0))
+    f.run("default/b")                        # standalone life: owns a gang
+    assert f.api.get("StatefulSet", "default", "b" + WORKER_SUFFIX)
+    f.seed(_pack_job("a", 100.0))             # older peer appears
+    actions = f.run("default/b")
+    assert ("delete", "StatefulSet") in verbs(actions)
+    assert ("update-status", "TPUJob") in verbs(actions)

@@ -1,0 +1,269 @@
+"""HFTA fused-trainer tests: exactness pins, divergence isolation,
+per-replica checkpoints, and labeled telemetry.
+
+The load-bearing pins:
+
+  - K=1 fused is BITWISE the solo LMTrainer — same loss, same params,
+    step after step. This holds on a single-device mesh only: the solo
+    trainer's compiled step is SPMD-partitioned over the dp mesh while
+    the fused step is unpartitioned, and the different reduction
+    schedules genuinely change the gradients (~1e-3 after clipping
+    amplification). The 1-device mesh removes the partitioning delta and
+    leaves only the fusion math, which must be exact.
+  - K identical replicas produce K identical curves — the vmap stacking
+    itself adds nothing.
+  - one diverging replica freezes alone: its K-1 siblings' params stay
+    bitwise equal to an unfaulted control run.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from mpi_operator_tpu.models.transformer import CausalLM, gpt2_config
+from mpi_operator_tpu.parallel import MeshConfig, make_mesh
+from mpi_operator_tpu.telemetry import render_registry
+from mpi_operator_tpu.telemetry.core import Registry
+from mpi_operator_tpu.train.checkpoint import (restore_checkpoint,
+                                               save_checkpoint)
+from mpi_operator_tpu.train.hfta import (HFTAHyperparams, HFTATrainer,
+                                         poison_replica)
+from mpi_operator_tpu.train.lm_trainer import LMTrainer, LMTrainerConfig
+from mpi_operator_tpu.train.resilience import FaultInjector
+
+pytestmark = pytest.mark.hfta
+
+VOCAB = 128
+
+
+def _model():
+    cfg = gpt2_config("test", attention="dense", dtype=jnp.float32,
+                      vocab_size=VOCAB, max_len=64)
+    return CausalLM(cfg)
+
+
+def _batch(i, batch=8, seq=16):
+    toks = jax.random.randint(jax.random.fold_in(jax.random.PRNGKey(1), i),
+                              (batch, seq), 0, VOCAB)
+    return toks, jnp.roll(toks, -1, axis=1)
+
+
+def _stacked(i, k, batch=8, seq=16):
+    """K identical copies of the step-i batch, stacked to [K, B, S]."""
+    toks, tgts = _batch(i, batch, seq)
+    return (jnp.broadcast_to(toks, (k,) + toks.shape),
+            jnp.broadcast_to(tgts, (k,) + tgts.shape))
+
+
+def _leaves_equal(a, b):
+    for x, y in zip(jax.tree.leaves(a), jax.tree.leaves(b)):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+
+def test_k1_fused_bitwise_matches_solo_lm_trainer():
+    """The exactness pin: on a 1-device mesh the K=1 fused step IS the
+    solo step — loss and params bitwise equal for several steps (warmup
+    crossover at step 2 included)."""
+    mesh = make_mesh(MeshConfig(), devices=jax.devices()[:1])
+    model = _model()
+    tcfg = LMTrainerConfig(global_batch_size=4, seq_len=16, warmup_steps=2)
+    solo = LMTrainer(model, mesh, tcfg)
+    fused = HFTATrainer(model, mesh, tcfg, HFTAHyperparams.sweep(1, tcfg))
+    s_state = solo.init_state(jax.random.PRNGKey(0))
+    f_state = fused.init_state()
+    _leaves_equal(jax.tree.map(lambda x: x[0], f_state.params),
+                  s_state.params)
+    for i in range(4):
+        toks, tgts = _batch(i, batch=4)
+        s_state, sm = solo.train_step(s_state, toks, tgts)
+        f_state, fm = fused.train_step(f_state, toks[None], tgts[None])
+        assert float(fm["loss"][0]) == float(sm["loss"]), f"step {i}"
+    _leaves_equal(jax.tree.map(lambda x: x[0], f_state.params),
+                  s_state.params)
+
+
+def test_k3_identical_hparams_identical_curves():
+    """vmap stacking adds nothing: 3 replicas with identical seed/lr fed
+    identical batches stay bitwise identical to each other."""
+    mesh = make_mesh(MeshConfig(dp=8))
+    tcfg = LMTrainerConfig(global_batch_size=8, seq_len=16, warmup_steps=2)
+    tr = HFTATrainer(_model(), mesh, tcfg, HFTAHyperparams.sweep(3, tcfg))
+    state = tr.init_state()
+    for i in range(3):
+        state, m = tr.train_step(state, *_stacked(i, 3))
+        loss = np.asarray(m["loss"])
+        assert loss[0] == loss[1] == loss[2], f"step {i}"
+    for leaf in jax.tree.leaves(state.params):
+        a = np.asarray(leaf)
+        np.testing.assert_array_equal(a[0], a[1])
+        np.testing.assert_array_equal(a[0], a[2])
+
+
+def test_sweep_axes_validated_and_broadcast():
+    tcfg = LMTrainerConfig(global_batch_size=8, seq_len=16,
+                           learning_rate=3e-4, weight_decay=0.1)
+    with pytest.raises(ValueError, match="sweep axis"):
+        HFTAHyperparams.sweep(3, tcfg, learning_rates=[1e-3])
+    hp = HFTAHyperparams.sweep(2, tcfg, learning_rates=[1e-3, 2e-3])
+    assert hp.k == 2
+    assert hp.weight_decays == (0.1, 0.1)           # broadcast from config
+    cfg1 = hp.replica_config(tcfg, 1)
+    assert cfg1.learning_rate == 2e-3
+    assert cfg1.weight_decay == 0.1
+
+
+def test_unsupported_configs_rejected():
+    mesh = make_mesh(MeshConfig(dp=8))
+    with pytest.raises(ValueError, match="causal"):
+        HFTATrainer(_model(), mesh,
+                    LMTrainerConfig(global_batch_size=8, seq_len=16,
+                                    masked_lm=True))
+    with pytest.raises(ValueError, match="accumulation"):
+        HFTATrainer(_model(), mesh,
+                    LMTrainerConfig(global_batch_size=8, seq_len=16,
+                                    accum_steps=2))
+
+
+def test_poisoned_replica_freezes_siblings_bitwise_unaffected():
+    """Divergence isolation: NaN-poison replica 1 mid-run. It must freeze
+    (after freeze_after consecutive bad steps) while replicas 0/2 stay
+    bitwise equal to an unfaulted control run — and the fused step never
+    stalls (the step counter keeps advancing)."""
+    mesh = make_mesh(MeshConfig(dp=8))
+    tcfg = LMTrainerConfig(global_batch_size=8, seq_len=16, warmup_steps=2)
+    tr = HFTATrainer(_model(), mesh, tcfg, HFTAHyperparams.sweep(3, tcfg),
+                     freeze_after=2)
+    ctrl = tr.init_state()
+    fault = tr.init_state()
+    for i in range(5):
+        toks, tgts = _stacked(i, 3)
+        ctrl, _ = tr.train_step(ctrl, toks, tgts)
+        if i == 2:
+            fault = poison_replica(fault, 1)
+        fault, fm = tr.train_step(fault, toks, tgts)
+    assert int(fault.step) == 5                       # never stalled
+    frozen = np.asarray(fault.frozen)
+    assert frozen.tolist() == [False, True, False]
+    assert int(np.asarray(fault.nonfinite_streak)[1]) >= 2
+    # siblings: every leaf bitwise equal to the control run
+    for f, c in zip(jax.tree.leaves(fault.params),
+                    jax.tree.leaves(ctrl.params)):
+        f, c = np.asarray(f), np.asarray(c)
+        np.testing.assert_array_equal(f[0], c[0])
+        np.testing.assert_array_equal(f[2], c[2])
+    # the poisoned replica is NaN and parked, its loss isolated to lane 1
+    assert np.isnan(np.asarray(jax.tree.leaves(fault.params)[0])[1]).all()
+    assert np.isnan(np.asarray(fm["loss"])[1])
+    assert np.isfinite(np.asarray(fm["loss"])[[0, 2]]).all()
+
+
+def test_fault_injector_nan_replica_directive():
+    faults = FaultInjector("nan-replica:1@3")
+    assert faults.check_nan_replica(2) is None
+    assert faults.check_nan_replica(3) == 1
+    assert faults.check_nan_replica(4) is None        # one-shot
+    with pytest.raises(ValueError):
+        FaultInjector("nan-replica:nope")
+
+
+def test_stacked_checkpoint_roundtrip(tmp_path):
+    mesh = make_mesh(MeshConfig(dp=8))
+    tcfg = LMTrainerConfig(global_batch_size=8, seq_len=16, warmup_steps=2)
+    tr = HFTATrainer(_model(), mesh, tcfg,
+                     HFTAHyperparams.sweep(2, tcfg, seeds=[0, 7]))
+    state = tr.init_state()
+    for i in range(2):
+        state, _ = tr.train_step(state, *_stacked(i, 2))
+    save_checkpoint(str(tmp_path), state)
+    restored = restore_checkpoint(str(tmp_path), tr.init_state())
+    assert int(restored.step) == 2
+    _leaves_equal(restored.params, state.params)
+    _leaves_equal(restored.opt_state, state.opt_state)
+    # and the restored state steps
+    restored, m = tr.train_step(restored, *_stacked(2, 2))
+    assert np.isfinite(np.asarray(m["loss"])).all()
+
+
+def test_k8_slice_sharing_shards_replicas_over_mesh(tmp_path):
+    """When K divides the mesh batch-axis extent, whole replicas shard
+    over the devices (controller-side slice sharing at the data plane):
+    the [K,...] state leaves carry a K-axis sharding, the step runs
+    without cross-replica coupling, and extract/checkpoint still work."""
+    mesh = make_mesh(MeshConfig(dp=8))
+    tcfg = LMTrainerConfig(global_batch_size=8, seq_len=16, warmup_steps=2)
+    tr = HFTATrainer(_model(), mesh, tcfg,
+                     HFTAHyperparams.sweep(8, tcfg, seeds=list(range(8))))
+    assert tr._replica_sharding is not None
+    state = tr.init_state()
+    leaf = jax.tree.leaves(state.params)[0]
+    assert leaf.sharding.spec[0] is not None        # K axis is sharded
+    for i in range(2):
+        state, m = tr.train_step(state, *_stacked(i, 8))
+    assert np.isfinite(np.asarray(m["loss"])).all()
+    # the step output keeps the K-axis sharding — no silent fallback to
+    # replicated params (that would re-run every adam update per device)
+    out_leaf = jax.tree.leaves(state.params)[0]
+    assert out_leaf.sharding.spec[0] is not None
+    # replica extraction gathers across devices
+    rep = tr.extract_replica(state, 5)
+    _leaves_equal(rep.params,
+                  jax.tree.map(lambda x: x[5], state.params))
+    # sharded stacked checkpoint roundtrips through the same template
+    save_checkpoint(str(tmp_path), state)
+    restored = restore_checkpoint(str(tmp_path), tr.init_state())
+    _leaves_equal(restored.params, state.params)
+    restored, m = tr.train_step(restored, *_stacked(2, 8))
+    assert np.isfinite(np.asarray(m["loss"])).all()
+
+
+def test_export_replica_checkpoint_restores_into_solo_trainer(tmp_path):
+    """A finished sweep member exports a NORMAL single-model checkpoint:
+    restore it into a plain LMTrainer and keep training."""
+    mesh = make_mesh(MeshConfig(dp=8))
+    model = _model()
+    tcfg = LMTrainerConfig(global_batch_size=8, seq_len=16, warmup_steps=2)
+    tr = HFTATrainer(model, mesh, tcfg,
+                     HFTAHyperparams.sweep(2, tcfg,
+                                           learning_rates=[1e-3, 2e-3],
+                                           seeds=[0, 7]))
+    state = tr.init_state()
+    for i in range(2):
+        state, _ = tr.train_step(state, *_stacked(i, 2))
+    tr.export_replica_checkpoint(str(tmp_path), state, 1)
+    solo = LMTrainer(model, mesh, tr.hparams.replica_config(tcfg, 1))
+    restored = restore_checkpoint(str(tmp_path),
+                                  solo.init_state(jax.random.PRNGKey(7)))
+    assert int(restored.step) == 2
+    _leaves_equal(restored.params,
+                  jax.tree.map(lambda x: x[1], state.params))
+    toks, tgts = _batch(2)
+    restored, m = solo.train_step(restored, toks, tgts)
+    assert bool(np.isfinite(np.asarray(m["loss"])))
+
+
+def test_benchmark_emits_per_replica_labeled_series():
+    """One registry scrape carries each packed replica's own labeled
+    tpu_worker_* series — the per-job view under controller packing."""
+    mesh = make_mesh(MeshConfig(dp=8))
+    tcfg = LMTrainerConfig(global_batch_size=8, seq_len=16, warmup_steps=2,
+                           log_every=1)
+    tr = HFTATrainer(_model(), mesh, tcfg, HFTAHyperparams.sweep(2, tcfg))
+
+    def stream():
+        i = 0
+        while True:
+            yield _stacked(i, 2)
+            i += 1
+
+    reg = Registry()
+    state, metrics = tr.benchmark(tr.init_state(), stream(), num_steps=2,
+                                  warmup_steps=1, log=lambda s: None,
+                                  registry=reg, faults=FaultInjector(""))
+    assert metrics["k"] == 2
+    assert metrics["tokens_per_sec"] > 0
+    assert metrics["per_replica"]["goodput"] == [1.0, 1.0]
+    assert len(metrics["per_replica"]["tokens_per_sec"]) == 2
+    text = render_registry(reg)
+    assert 'tpu_worker_tokens_per_sec{replica="0"}' in text
+    assert 'tpu_worker_tokens_per_sec{replica="1"}' in text
+    assert 'tpu_worker_goodput{replica="1"}' in text

@@ -400,3 +400,56 @@ def test_engine_with_sharded_params_matches_oracle():
     results = engine.run(reqs)
     for req in reqs:
         assert results[req.id].tokens == _oracle(model, params, req)
+
+
+def test_paged_admission_stages_reservations_when_no_slot_free():
+    """Slot-aware reserve-ahead: when pages fit but no SLOT is free,
+    queued requests park their page reservations in `staged` — the pins
+    land before decode churn can evict their prefixes, and when a slot
+    frees the head admits off its parked reservation instead of paying
+    reservation work on the critical path."""
+    from mpi_operator_tpu.serve import PageAllocator
+
+    s = Scheduler((4,), max_len=16)
+    a = PageAllocator(20, 4)                  # 19 usable pages
+    for i in range(3):
+        s.submit(Request(i, [1, 2, 3, 4, 5], 8, arrival=0.0))
+    need = Scheduler.pages_needed(s.queue[0], a.page_size)
+
+    avail0 = a.available
+    st0, = s.admit([0], now=1.0, allocator=a)
+    assert st0.req.id == 0
+    # the head consumed the only slot — the SAME admit call already
+    # stages the two queued spans behind it
+    assert set(s.staged) == {1, 2}
+    assert a.available == avail0 - 3 * need
+    # idempotent: a slotless pass admits nothing and stages nothing twice
+    assert s.admit([], now=1.0, allocator=a) == []
+    assert set(s.staged) == {1, 2}
+    assert a.available == avail0 - 3 * need
+
+    # a slot frees: the staged head admits, CONSUMING its reservation
+    st1, = s.admit([1], now=1.0, allocator=a)
+    assert st1.req.id == 1 and 1 not in s.staged
+    assert a.available == avail0 - 3 * need       # no double reserve
+    assert st1.page_table is not None
+    a.check()
+
+
+def test_reserve_ahead_respects_future_arrivals_and_pool_limits():
+    """Staging follows the same gates as admission: requests that have
+    not arrived yet are never staged, and a span the pool can't cover
+    stays unstaged (no partial pins left behind)."""
+    from mpi_operator_tpu.serve import PageAllocator
+
+    s = Scheduler((4,), max_len=16)
+    a = PageAllocator(5, 4)                   # 4 usable pages
+    s.submit(Request(0, [1, 2, 3, 4, 5], 8, arrival=0.0))   # needs 3 pages
+    s.submit(Request(1, [1, 2, 3, 4, 5], 8, arrival=0.0))   # won't fit too
+    s.submit(Request(2, [1, 2], 2, arrival=99.0))           # future
+    assert s.admit([], now=1.0, allocator=a) == []
+    assert set(s.staged) == {0}               # 1 doesn't fit, 2 not arrived
+    free_before = a.available
+    assert s.admit([], now=1.0, allocator=a) == []
+    assert a.available == free_before         # failed fits leak nothing
+    a.check()

@@ -32,7 +32,8 @@ import pytest
 
 from mpi_operator_tpu.telemetry import (
     CONTENT_TYPE, Counter, EventLog, Histogram, Registry, TelemetryServer,
-    WorkerTelemetry, escape_label_value, read_events, render_registry,
+    TrainTelemetry, WorkerTelemetry, escape_label_value, read_events,
+    render_registry,
 )
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -463,3 +464,89 @@ def test_resilience_context_flushes_events_on_exit(tmp_path):
         ev.emit("preemption_drain", step=1)
     assert read_events(path, kind="preemption_drain")
     ev.close()
+
+
+# ---------------------------------------------------------------------------
+# labeled series (the per-replica/job view under HFTA packing)
+# ---------------------------------------------------------------------------
+
+def test_labeled_series_are_isolated_per_label_set():
+    """Same metric NAME, different label sets → independent instruments;
+    same (name, labels) → the same instrument back (accumulation, not
+    collision)."""
+    reg = Registry()
+    c0 = reg.counter("tpu_worker_steps_total", labels={"replica": "0"})
+    c1 = reg.counter("tpu_worker_steps_total", labels={"replica": "1"})
+    bare = reg.counter("tpu_worker_steps_total")
+    assert c0 is not c1 and c0 is not bare
+    assert reg.counter("tpu_worker_steps_total",
+                       labels={"replica": "0"}) is c0
+    c0.inc(3)
+    c1.inc(5)
+    assert (c0.value, c1.value, bare.value) == (3, 5, 0)
+    text = render_registry(reg)
+    assert 'tpu_worker_steps_total{replica="0"} 3' in text
+    assert 'tpu_worker_steps_total{replica="1"} 5' in text
+    # HELP/TYPE once per NAME even with several label sets
+    assert text.count("# TYPE tpu_worker_steps_total counter") == 1
+    # kind conflicts stay conflicts per label set
+    with pytest.raises(ValueError, match="already registered"):
+        reg.gauge("tpu_worker_steps_total", labels={"replica": "0"})
+
+
+def test_labeled_histogram_cumulative_and_inf_per_series():
+    """Every labeled histogram series is independently cumulative with
+    its own +Inf bucket == its own _count."""
+    reg = Registry()
+    h0 = reg.histogram("tpu_worker_step_seconds", lo=0.01, hi=10.0,
+                       labels={"replica": "0"})
+    h1 = reg.histogram("tpu_worker_step_seconds", lo=0.01, hi=10.0,
+                       labels={"replica": "1"})
+    for v in (0.02, 0.2, 2.0):
+        h0.observe(v)
+    h1.observe(0.5)
+    text = render_registry(reg)
+    assert ('tpu_worker_step_seconds_bucket{replica="0",le="+Inf"} 3'
+            in text)
+    assert ('tpu_worker_step_seconds_bucket{replica="1",le="+Inf"} 1'
+            in text)
+    assert 'tpu_worker_step_seconds_count{replica="0"} 3' in text
+    assert 'tpu_worker_step_seconds_count{replica="1"} 1' in text
+    # per-series cumulative monotonicity
+    for rep, total in (("0", 3), ("1", 1)):
+        cums = [int(line.rsplit(" ", 1)[1]) for line in text.splitlines()
+                if line.startswith("tpu_worker_step_seconds_bucket")
+                and f'replica="{rep}"' in line]
+        assert cums == sorted(cums) and cums[-1] == total
+
+
+def test_label_values_escaped_in_render():
+    reg = Registry()
+    g = reg.gauge("tpu_worker_goodput",
+                  labels={"job": 'swe"ep\\1\nx'})
+    g.set(1.0)
+    text = render_registry(reg)
+    assert 'job="swe\\"ep\\\\1\\nx"' in text
+    # the escape helper round-trips the canonical cases
+    assert escape_label_value('a"b') == 'a\\"b'
+    assert escape_label_value("a\\b") == "a\\\\b"
+    assert escape_label_value("a\nb") == "a\\nb"
+
+
+def test_train_telemetry_labels_flow_to_all_instruments():
+    """TrainTelemetry(labels=...) threads the label set onto every
+    instrument it owns — two replica bundles on ONE registry scrape as
+    disjoint labeled series."""
+    reg = Registry()
+    t0 = TrainTelemetry(reg, labels={"replica": "0"})
+    t1 = TrainTelemetry(reg, labels={"replica": "1"})
+    t0.observe_steps(0.1, 2)
+    t1.observe_steps(0.2, 4)
+    t0.update_window(tokens_per_sec=100.0)
+    t1.update_window(tokens_per_sec=50.0)
+    assert t0.steps_total.value == 2 and t1.steps_total.value == 4
+    text = render_registry(reg)
+    assert 'tpu_worker_steps_total{replica="0"} 2' in text
+    assert 'tpu_worker_steps_total{replica="1"} 4' in text
+    assert 'tpu_worker_tokens_per_sec{replica="0"} 100' in text
+    assert 'tpu_worker_tokens_per_sec{replica="1"} 50' in text
