@@ -208,6 +208,14 @@ def main() -> None:
                         help="comma-separated decode-leg prefixes to run "
                              "(default: all); the mid-kill harness test "
                              "uses this to shrink the ladder")
+    parser.add_argument("--events-log", default="",
+                        help="route every leg's worker event records "
+                             "(drains, checkpoints, restores, faults) "
+                             "into ONE shared events.jsonl; the summary "
+                             "line then carries the restart-aware goodput "
+                             "ledger over it, and the file feeds "
+                             "python -m mpi_operator_tpu.postmortem "
+                             "('' disables — the default)")
     parser.add_argument("--dtype", default="bfloat16",
                         choices=["bfloat16", "float32"])
     parser.add_argument("--smoke", action="store_true",
@@ -263,6 +271,19 @@ def main() -> None:
         _SUMMARY_STATE["done"] = True
         if _legs_written[0]:
             line["jsonl_path"] = os.path.abspath(args.jsonl)
+        # restart-aware goodput over the shared event log: all legs fed
+        # one file, so the ledger sees any drain→restore re-execution a
+        # preempted/retried run cost the ladder (1.0 on a clean pass)
+        if args.events_log and os.path.exists(args.events_log):
+            try:
+                from mpi_operator_tpu.telemetry import (goodput_ledger,
+                                                        read_events)
+                ledger = goodput_ledger(read_events(args.events_log))
+                line["events_log"] = os.path.abspath(args.events_log)
+                line["steps_lost"] = ledger["lost_steps"]
+                line["restart_goodput"] = round(ledger["goodput"], 4)
+            except Exception as exc:
+                print(f"# goodput ledger failed: {exc!r}", file=sys.stderr)
         print(json.dumps(line))
 
     _SUMMARY_STATE["finish"] = finish
@@ -306,7 +327,7 @@ def main() -> None:
             batch_per_device=2 if args.smoke else (batch or 16),
             seq_len=32 if args.smoke else (seq or 512),
             num_steps=steps, warmup_steps=warmup,
-            remat=False,
+            remat=False, event_log=args.events_log or None,
             dtype_name=args.dtype, log=lambda s: print(s, file=sys.stderr),
             **kw))
         del _state

@@ -56,6 +56,19 @@ def build_parser() -> argparse.ArgumentParser:
                    help="serve /metrics (Prometheus) and /healthz on this "
                         "port (0 = disabled; the shipped Deployment sets "
                         "8080 and probes /healthz)")
+    p.add_argument("--worker-metrics-port", type=int, default=0,
+                   help="scrape each worker pod's /metrics + /events on "
+                        "this port and re-export federated tpu_job_* "
+                        "series on --metrics-port (0 = disabled); also "
+                        "injects TPU_METRICS_PORT into worker env so the "
+                        "benchmarks serve it without per-job flags")
+    p.add_argument("--events-dir", default=None,
+                   help="directory for the controller's own event log and "
+                        "per-job merged timeline.jsonl files (feeds "
+                        "python -m mpi_operator_tpu.postmortem)")
+    p.add_argument("--scrape-interval", type=float, default=10.0,
+                   help="seconds between worker /metrics federation "
+                        "scrapes per job")
     p.add_argument("--demo", action="store_true",
                    help="run against the in-memory API server with a sample "
                         "TPUJob and simulated kubelet")
@@ -113,6 +126,9 @@ def main(argv=None, stop_event=None) -> int:
         namespace=args.namespace,
         discovery_image=args.discovery_image,
         discovery_timeout_seconds=args.discovery_timeout,
+        worker_metrics_port=args.worker_metrics_port or None,
+        events_dir=args.events_dir,
+        scrape_interval=args.scrape_interval,
     )
 
     stop = stop_event or threading.Event()

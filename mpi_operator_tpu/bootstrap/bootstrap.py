@@ -372,6 +372,17 @@ def initialize(env: Optional[Mapping[str, str]] = None,
     """
     info = process_info(env, hostname)
     resolved_env = dict(os.environ if env is None else env)
+    if events is not None and not info.is_launcher:
+        # clock anchor for the controller-side timeline merge: a fresh
+        # boot_id marks a new process incarnation, so the collector
+        # (re)pins this host's clock offset exactly once per boot —
+        # emitted FIRST so even a bootstrap that never converges leaves
+        # the anchor a postmortem needs to place its init_retry records
+        import uuid
+        from ..telemetry import events as ev
+        events.emit(ev.CLOCK_ANCHOR, boot_id=uuid.uuid4().hex[:12],
+                    process_id=info.process_id,
+                    num_processes=info.num_processes)
     if not info.is_launcher and info.num_processes > 1:
         _initialize_distributed(info, resolved_env, events=events)
     elif not info.is_launcher:

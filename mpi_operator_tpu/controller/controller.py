@@ -271,6 +271,15 @@ class ControllerConfig:
     # shrunken job runs before the full spec size is retried
     elastic_degraded_seconds: int = 300
     elastic_recovery_seconds: int = 1800
+    # job-level observability (telemetry/collector.py): when
+    # worker_metrics_port is set the controller injects TPU_METRICS_PORT
+    # into workers, scrapes each pod's /metrics + /events every
+    # scrape_interval seconds, and re-exports federated tpu_job_* series
+    # on its own MetricsServer. events_dir roots the controller's own
+    # event log and the per-job timeline.jsonl files.
+    worker_metrics_port: Optional[int] = None
+    events_dir: Optional[str] = None
+    scrape_interval: float = 10.0
 
 
 @dataclass
@@ -300,9 +309,21 @@ class TPUJobController:
         factory: Optional[InformerFactory] = None,
         config: Optional[ControllerConfig] = None,
         recorder: Optional[EventRecorder] = None,
+        observatory=None,
     ):
         self.api = api_server
         self.config = config or ControllerConfig()
+        # job-level observability: controller event log + metrics
+        # federation + timeline merge (telemetry/collector.py). Built
+        # when the config asks for it; tests inject their own with a
+        # fake clock/fetcher. None disables every hook.
+        if observatory is None and (self.config.events_dir
+                                    or self.config.worker_metrics_port):
+            from ..telemetry.collector import JobObservatory
+            observatory = JobObservatory(
+                events_dir=self.config.events_dir,
+                scrape_interval=self.config.scrape_interval)
+        self.observatory = observatory
         # default recorder posts real core-v1 Events through the same API
         # server the reconciler writes to (ref StartRecordingToSink,
         # mpi_job_controller.go:165-172)
@@ -547,6 +568,14 @@ class TPUJobController:
             self.recorder.event(
                 job, "Normal", "TPUJobRestarting",
                 f"gang restart {job.status.restart_count}")
+            if self.observatory is not None:
+                # the timeline record carries the launcher exit code AND
+                # the last step frontier this controller observed — the
+                # goodput ledger charges restart-lost steps against it
+                self.observatory.note_restart(
+                    job.metadata.name,
+                    exit_code=launcher.status.exit_code,
+                    restart=job.status.restart_count)
             launcher = None
 
         done = terminal or (launcher is not None and (
@@ -642,6 +671,12 @@ class TPUJobController:
         # edits included) — creating a launcher now would rendezvous
         # against a gang that was just deleted. The next sync sees the
         # true readiness and recreates it with the new env.
+        if (self.observatory is not None and not done and workers_ready
+                and not resized and alloc.worker_replicas > 0):
+            self.observatory.note_pods_ready(
+                job.metadata.name, replicas=alloc.worker_replicas)
+            self._observe_job(job, alloc)
+
         if not done and workers_ready and launcher is None and not resized:
             launcher, _ = self._create_or_get(
                 self.new_launcher(job, alloc, pack=pack), job)
@@ -710,7 +745,24 @@ class TPUJobController:
             COND_PACKED, "True", "PackLeader", msg))
         job = self.api.update_status(job)
         self.recorder.event(job, "Normal", "PackLeader", msg)
+        if self.observatory is not None:
+            self.observatory.note_packed(job.metadata.name,
+                                         group=pack.group,
+                                         members=list(pack.members),
+                                         k=pack.k, labels=pack.labels())
         return job
+
+    def _observe_job(self, job: TPUJob, alloc: AllocationResult) -> None:
+        """One federation pass: scrape every worker pod's /metrics and
+        /events through the observatory (rate-limited there). Targets
+        come from the same slice-major hostname order as the discovery
+        data, so replica_rank labels match TPU_PROCESS_ID."""
+        if self.observatory is None or not self.config.worker_metrics_port:
+            return
+        targets = {
+            rank: f"http://{host}:{self.config.worker_metrics_port}"
+            for rank, host in enumerate(self.worker_hostnames(job, alloc))}
+        self.observatory.observe(job.metadata.name, targets)
 
     def _fail_invalid_spec(self, job: TPUJob, message: str,
                            launcher: Optional[Job] = None) -> None:
@@ -1229,6 +1281,11 @@ class TPUJobController:
                     job, "Normal", "TPUJobResized",
                     "worker topology changed; gang restarted on the new "
                     "template")
+                if self.observatory is not None:
+                    self.observatory.note_resize(
+                        job.metadata.name,
+                        replicas=alloc.worker_replicas,
+                        num_slices=alloc.num_slices)
             else:
                 # the restart did NOT happen this sync — the stale hash
                 # annotations make the next sync retry; say so instead of
@@ -1403,6 +1460,11 @@ class TPUJobController:
             "TPU_NUM_SLICES": str(job.spec.num_slices),
             "TPU_WORKERS_PER_SLICE": str(alloc.workers_per_slice),
         }
+        if self.config.worker_metrics_port:
+            # federation contract: workers serve /metrics + /events here
+            # (lm_benchmark defaults --metrics-port from this env), and
+            # the controller scrapes the same port (_observe_job)
+            env["TPU_METRICS_PORT"] = str(self.config.worker_metrics_port)
         if alloc.num_slices > 1:
             # megascale-style coordinator config (SURVEY §7 "Multi-slice
             # (DCN) bootstrap"): the libtpu multislice runtime reads
@@ -1739,16 +1801,27 @@ class TPUJobController:
                     job.status.set_condition(api.JobCondition(
                         COND_SUCCEEDED, "True", "TPUJobSucceeded",
                         f"launcher {launcher.metadata.name} completed"))
+                    if self.observatory is not None:
+                        self.observatory.note_terminal(
+                            job.metadata.name, succeeded=True)
                 elif new == LAUNCHER_FAILED:
                     job.status.completion_time = (
                         launcher.status.completion_time or now)
                     job.status.set_condition(api.JobCondition(
                         COND_FAILED, "True", "TPUJobFailed",
                         f"launcher {launcher.metadata.name} failed"))
+                    if self.observatory is not None:
+                        self.observatory.note_terminal(
+                            job.metadata.name, succeeded=False,
+                            exit_code=launcher.status.exit_code)
         if job.status.get_condition(COND_CREATED) is None:
             job.status.set_condition(api.JobCondition(
                 COND_CREATED, "True", "TPUJobCreated", "TPUJob resources created"))
             changed = True
+            if self.observatory is not None:
+                self.observatory.note_created(
+                    job.metadata.name, namespace=job.metadata.namespace,
+                    tpus=job.spec.tpus)
 
         ready = sum(w.status.ready_replicas for w in workers if w is not None)
         if ready != job.status.worker_replicas:       # ref :780-786

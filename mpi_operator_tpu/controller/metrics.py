@@ -137,6 +137,13 @@ def render_metrics(controller) -> str:
         "# TYPE tpu_operator_job_restarts gauge",
         f"tpu_operator_job_restarts {restarts}",
     ]
+    # job-level federation (telemetry/collector.py): the observatory's
+    # aggregated tpu_job_* series ride the SAME scrape as the operator's
+    # own — one endpoint, both planes. Absent observatory → absent
+    # section, not empty series.
+    observatory = getattr(controller, "observatory", None)
+    if observatory is not None:
+        lines += observatory.render_lines()
     return "\n".join(lines) + "\n"
 
 

@@ -114,6 +114,25 @@ class PackPlan:
     def index(self, name: str) -> int:
         return self.members.index(name)
 
+    def labels(self) -> Dict[str, str]:
+        """Observability labels for the pack: stamped onto federated
+        tpu_job_* series and controller timeline events so a packed
+        job's telemetry is attributable to its physical gang. Empty for
+        a pack of one — a solo leader's series stay label-identical to
+        the unpacked job's (same reasoning as env())."""
+        if self.k <= 1:
+            return {}
+        return {"pack_group": self.group}
+
+    def member_labels(self, name: str) -> Dict[str, str]:
+        """Per-member variant: pack labels + the member's replica index
+        inside the fused program — matches the worker-side
+        TrainTelemetry(labels={"replica": k}) convention, so federated
+        series and worker series join on the same label."""
+        if self.k <= 1:
+            return {}
+        return {**self.labels(), "replica": str(self.index(name))}
+
     def env(self) -> Dict[str, str]:
         """Pack-identity env for the LEADER's pods. A pack of one adds
         nothing — a solo leader's template stays bit-identical to the

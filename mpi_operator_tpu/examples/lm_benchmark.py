@@ -45,6 +45,11 @@ def _worker_telemetry(metrics_port, event_log, train_dir, events, log):
         path = event_log or (os.path.join(train_dir, "events.jsonl")
                              if train_dir else None)
         events = EventLog(path) if path else None
+    if events is not None and os.environ.get("TPU_PACK_GROUP"):
+        # packed jobs share one worker process (and one event file);
+        # stamp the pack group into every record, mirroring the
+        # labeled-metrics contract (bind delegates close to the owner)
+        events = events.bind(pack_group=os.environ["TPU_PACK_GROUP"])
     wtel = WorkerTelemetry(events=events)
     if metrics_port is not None:
         log(f"worker /metrics listening on port "
@@ -256,6 +261,7 @@ def run_lm_benchmark(
             maybe_resume(train_dir, pp_trainer.canonical_state(pp_state),
                          log))
         pp_resumed_step = int(pp_state.step)
+        pp_resilience.record_restore(pp_resumed_step)
         if stop_at_step is not None:
             remaining = (stop_at_step - pp_resumed_step
                          - max(1, warmup_steps))
@@ -329,7 +335,8 @@ def run_lm_benchmark(
             pp_stream = RawStream(start=pp_resumed_step)
         from ..train.checkpoint import periodic_saver
         saver = periodic_saver(train_dir, ckpt_every, log,
-                               keep_last=ckpt_keep)
+                               keep_last=ckpt_keep,
+                               resilience=pp_resilience)
         canonical_hook = (None if saver is None else (
             lambda st, step: saver(pp_trainer.canonical_state(st), step)))
         try:
@@ -347,6 +354,10 @@ def run_lm_benchmark(
                 log(f"val_loss: {ev['val_loss']:.3f}  "
                     f"perplexity: {ev['perplexity']:.1f}  "
                     f"({eval_steps} batches)")
+            if wtel.events is not None:
+                from ..telemetry import events as tev
+                wtel.events.emit(tev.RUN_COMPLETE,
+                                 step=int(pp_state.step))
         finally:
             pp_stream.close()
             pp_resilience.__exit__(None, None, None)
@@ -375,6 +386,7 @@ def run_lm_benchmark(
     try:
         state = maybe_resume(train_dir, state, log)
         resumed_step = int(state.step)
+        resilience.record_restore(resumed_step)
         if stop_at_step is not None:
             # finish at the same GLOBAL step the uninterrupted run would
             # have: warmup batches advance the step counter too
@@ -453,7 +465,8 @@ def run_lm_benchmark(
                 warmup_steps=warmup_steps, log=log,
                 profile_dir=profile_dir,
                 step_hook=periodic_saver(train_dir, ckpt_every, log,
-                                         keep_last=ckpt_keep),
+                                         keep_last=ckpt_keep,
+                                         resilience=resilience),
                 resilience=resilience, telemetry=wtel.train)
             if eval_steps:
                 # evaluation continues the stream past the trained
@@ -472,6 +485,12 @@ def run_lm_benchmark(
         # telemetry teardown (and the moe diagnostics probe below); the
         # join at the end makes it durable before return
         maybe_save(train_dir, state, log, block=False)
+        if wtel.events is not None:
+            # the terminal frontier marker: without it a timeline ends at
+            # the last window fetch and the goodput ledger undercounts
+            # the useful column
+            from ..telemetry import events as tev
+            wtel.events.emit(tev.RUN_COMPLETE, step=int(state.step))
     finally:
         resilience.__exit__(None, None, None)
         wtel.close(close_events=owns_events)
@@ -594,8 +613,12 @@ def run_hfta_benchmark(
 
         state, metrics = trainer.benchmark(
             state, stream(int(state.step)), num_steps=num_steps,
-            warmup_steps=warmup_steps, log=log, registry=wtel.registry)
+            warmup_steps=warmup_steps, log=log, registry=wtel.registry,
+            events=wtel.events)
         maybe_save(train_dir, state, log, block=False)
+        if wtel.events is not None:
+            from ..telemetry import events as tev
+            wtel.events.emit(tev.RUN_COMPLETE, step=int(state.step))
     finally:
         wtel.close(close_events=owns_events)
     wait_for_checkpoints()
@@ -769,6 +792,7 @@ def run_vit_benchmark(
     resilience.__enter__()
     try:
         state = maybe_resume(train_dir, state, log)
+        resilience.record_restore(int(state.step))
         if data_dir is not None:
             from ..data.imagefolder import NpyImageDataset
             dataset = NpyImageDataset(
@@ -784,12 +808,16 @@ def run_vit_benchmark(
                 state, dataset, num_steps=num_steps,
                 warmup_steps=warmup_steps, log=log,
                 step_hook=periodic_saver(train_dir, ckpt_every, log,
-                                         keep_last=ckpt_keep),
+                                         keep_last=ckpt_keep,
+                                         resilience=resilience),
                 resilience=resilience, telemetry=wtel.train)
         finally:
             if hasattr(dataset, "close"):
                 dataset.close()
         maybe_save(train_dir, state, log, block=False)
+        if wtel.events is not None:
+            from ..telemetry import events as tev
+            wtel.events.emit(tev.RUN_COMPLETE, step=int(state.step))
     finally:
         resilience.__exit__(None, None, None)
         wtel.close(close_events=owns_events)
@@ -936,10 +964,15 @@ def main(argv=None) -> int:
     parser.add_argument("--profile-dir", default=None,
                         help="write a jax.profiler trace of the first "
                              "measurement window here (XProf format)")
-    parser.add_argument("--metrics-port", type=int, default=None,
+    parser.add_argument("--metrics-port", type=int,
+                        default=(int(os.environ["TPU_METRICS_PORT"])
+                                 if os.environ.get("TPU_METRICS_PORT")
+                                 else None),
                         help="serve worker /metrics (Prometheus text) + "
-                             "/healthz on this port (0 = pick a free "
-                             "port; omit to disable)")
+                             "/healthz + /events on this port (0 = pick "
+                             "a free port; omit to disable; defaults to "
+                             "$TPU_METRICS_PORT, which the controller "
+                             "injects so it can federate job metrics)")
     parser.add_argument("--event-log", default=None,
                         help="fsync'd JSONL event log path (preemption "
                              "drain, emergency checkpoint, rollback, init "

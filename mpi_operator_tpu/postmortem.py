@@ -1,0 +1,203 @@
+"""Postmortem CLI: turn a merged job timeline into a gang-lifecycle report.
+
+    python -m mpi_operator_tpu.postmortem <timeline.jsonl> [--json]
+
+The input is the ``timeline.jsonl`` a JobObservatory writes (or the
+``telemetry.collector merge`` subcommand): controller + worker event
+records, clock-corrected and sorted by ``ts``, each carrying a ``host``
+field. This tool answers the question a human asks AFTER a job died or
+ran slow — "what happened, in order, and where did the time and the
+steps go?" — without Prometheus or kubectl access, from the one file the
+operator leaves behind:
+
+  - the **lifecycle** section lists every milestone (created, pods
+    ready, first step, restarts, resizes, terminal) with the duration of
+    the phase each one closes — so "4 min stuck between pods_ready and
+    first_step_observed" (compile or rendezvous hang) is one glance;
+  - the **incidents** section lists the resilience events between the
+    milestones (preemption drains, emergency checkpoints, restores,
+    rollbacks, injected faults) with their step numbers;
+  - the **goodput ledger** replays the same arithmetic the controller's
+    federated ``tpu_job_goodput`` gauge uses (telemetry/collector.py
+    goodput_ledger — ONE implementation, so the postmortem never
+    disagrees with the live metric): every executed step is either
+    useful or lost to a restart/rollback re-execution.
+
+Exit status: 0 on a rendered report, 2 when the timeline is missing,
+empty, or contains no parseable record — a smoke test can assert "the
+run left a usable postmortem" with plain ``&&``.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Dict, List, Optional, Sequence, TextIO
+
+from .telemetry import events as ev
+from .telemetry.collector import goodput_ledger
+
+#: milestone kinds, i.e. records that OPEN a new lifecycle phase; every
+#: other record is an incident inside the current phase
+MILESTONES = (
+    ev.JOB_CREATED, ev.PODS_READY, ev.FIRST_STEP_OBSERVED,
+    ev.JOB_PACKED, ev.JOB_RESIZED, ev.GANG_RESTART,
+    ev.RUN_COMPLETE, ev.JOB_SUCCEEDED, ev.JOB_FAILED,
+)
+
+#: incident kinds worth a line of their own (everything else — window
+#: stats, slot churn — is summarized as a count)
+INCIDENTS = (
+    ev.PREEMPTION_DRAIN, ev.EMERGENCY_CHECKPOINT, ev.CHECKPOINT_RESTORE,
+    ev.CHECKPOINT_SAVED, ev.DIVERGENCE_ROLLBACK, ev.FAULT_INJECTED,
+    ev.REPLICA_FROZEN, ev.INIT_RETRY, ev.CLOCK_ANCHOR,
+)
+
+_DETAIL_FIELDS = ("step", "from_step", "to_step", "last_observed_step",
+                  "exit_code", "restart", "replicas", "num_slices", "tpus",
+                  "k", "fault", "signal", "path", "boot_id")
+
+
+def read_timeline(path: str) -> List[Dict]:
+    """Parse a timeline.jsonl tolerantly: undecodable lines are skipped
+    (a postmortem must survive the torn tail of a crashed writer), but
+    ZERO parseable records is an error the caller turns into exit 2."""
+    records: List[Dict] = []
+    try:
+        with open(path, "r") as fh:
+            for line in fh:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                except ValueError:
+                    continue
+                if isinstance(rec, dict) and "ts" in rec and "event" in rec:
+                    records.append(rec)
+    except OSError:
+        return []
+    records.sort(key=lambda r: r.get("ts", 0.0))
+    return records
+
+
+def _fmt_duration(seconds: float) -> str:
+    if seconds >= 3600:
+        return f"{seconds / 3600:.1f}h"
+    if seconds >= 60:
+        return f"{seconds / 60:.1f}m"
+    return f"{seconds:.1f}s"
+
+
+def _fmt_detail(rec: Dict) -> str:
+    parts = [f"{k}={rec[k]}" for k in _DETAIL_FIELDS if k in rec]
+    return "  ".join(parts)
+
+
+def summarize(records: Sequence[Dict]) -> Dict:
+    """Machine-readable report: milestones with per-phase durations,
+    incident list, other-event counts, and the goodput ledger."""
+    t0 = records[0].get("ts", 0.0)
+    hosts = sorted({str(r.get("host", "?")) for r in records})
+    milestones: List[Dict] = []
+    incidents: List[Dict] = []
+    other: Dict[str, int] = {}
+    last_milestone_ts = t0
+    for rec in records:
+        kind = rec.get("event")
+        entry = {
+            "t": round(rec.get("ts", t0) - t0, 3),
+            "host": str(rec.get("host", "?")),
+            "event": kind,
+            "detail": _fmt_detail(rec),
+        }
+        if kind in MILESTONES:
+            # the duration of the phase this milestone CLOSES
+            entry["phase_seconds"] = round(rec.get("ts", t0)
+                                           - last_milestone_ts, 3)
+            last_milestone_ts = rec.get("ts", t0)
+            milestones.append(entry)
+        elif kind in INCIDENTS:
+            incidents.append(entry)
+        else:
+            other[str(kind)] = other.get(str(kind), 0) + 1
+    return {
+        "records": len(records),
+        "span_seconds": round(records[-1].get("ts", t0) - t0, 3),
+        "hosts": hosts,
+        "job": next((r["job"] for r in records if "job" in r), None),
+        "milestones": milestones,
+        "incidents": incidents,
+        "other_events": other,
+        "ledger": goodput_ledger(records),
+    }
+
+
+def render(summary: Dict, out: TextIO) -> None:
+    job = summary["job"] or "<unknown>"
+    out.write(f"postmortem: job {job} — {summary['records']} records over "
+              f"{_fmt_duration(summary['span_seconds'])} from "
+              f"{len(summary['hosts'])} host(s)\n")
+    out.write(f"hosts: {', '.join(summary['hosts'])}\n\n")
+
+    out.write("lifecycle:\n")
+    if not summary["milestones"]:
+        out.write("  (no milestone events — timeline has worker records "
+                  "only)\n")
+    for m in summary["milestones"]:
+        phase = (f"  (+{_fmt_duration(m['phase_seconds'])})"
+                 if m["phase_seconds"] > 0 else "")
+        detail = f"  {m['detail']}" if m["detail"] else ""
+        out.write(f"  {m['t']:>9.3f}s  {m['host']:<12} "
+                  f"{m['event']:<22}{detail}{phase}\n")
+
+    if summary["incidents"]:
+        out.write("\nincidents:\n")
+        for i in summary["incidents"]:
+            detail = f"  {i['detail']}" if i["detail"] else ""
+            out.write(f"  {i['t']:>9.3f}s  {i['host']:<12} "
+                      f"{i['event']:<22}{detail}\n")
+
+    if summary["other_events"]:
+        pairs = ", ".join(f"{k}×{v}"
+                          for k, v in sorted(summary["other_events"].items()))
+        out.write(f"\nother events: {pairs}\n")
+
+    led = summary["ledger"]
+    out.write("\ngoodput ledger:\n")
+    out.write(f"  useful steps   {led['useful_steps']}\n")
+    out.write(f"  lost steps     {led['lost_steps']}"
+              f"  (re-executed after restart/rollback)\n")
+    out.write(f"  restarts       {led['restarts']}"
+              f"    restores {led['restores']}"
+              f"    rollbacks {led['rollbacks']}\n")
+    out.write(f"  goodput        {led['goodput']:.4f}\n")
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m mpi_operator_tpu.postmortem",
+        description="Render a merged job timeline (timeline.jsonl) as a "
+                    "gang-lifecycle report with a goodput ledger.")
+    parser.add_argument("timeline", help="path to timeline.jsonl")
+    parser.add_argument("--json", action="store_true",
+                        help="emit the machine-readable summary instead "
+                             "of the human report")
+    args = parser.parse_args(argv)
+
+    records = read_timeline(args.timeline)
+    if not records:
+        print(f"postmortem: no parseable event records in "
+              f"{args.timeline}", file=sys.stderr)
+        return 2
+    summary = summarize(records)
+    if args.json:
+        json.dump(summary, sys.stdout, indent=2, sort_keys=True)
+        sys.stdout.write("\n")
+    else:
+        render(summary, sys.stdout)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,18 +1,33 @@
 """Data-plane telemetry: metrics core, Prometheus /metrics, event log,
-and XProf span annotations. See core.py for the design constraints."""
+job-level collector, and XProf span annotations. See core.py for the
+design constraints and collector.py for the operator-side job view."""
+from .collector import (ClockSync, JobObservatory, MetricsFederation,
+                        goodput_ledger, merge_timeline, parse_prometheus)
 from .core import Counter, Gauge, Histogram, Registry
-from .events import (EventLog, read_events, PREEMPTION_DRAIN,
-                     EMERGENCY_CHECKPOINT, DIVERGENCE_ROLLBACK, INIT_RETRY,
-                     SLOT_ADMIT, SLOT_RETIRE)
+from .events import (BoundEventLog, EventLog, read_events,
+                     PREEMPTION_DRAIN, EMERGENCY_CHECKPOINT,
+                     DIVERGENCE_ROLLBACK, INIT_RETRY, SLOT_ADMIT,
+                     SLOT_RETIRE, CHECKPOINT_RESTORE, CHECKPOINT_SAVED,
+                     CLOCK_ANCHOR, FAULT_INJECTED, REPLICA_FROZEN,
+                     RUN_COMPLETE, JOB_CREATED, GANG_RESTART, PODS_READY,
+                     FIRST_STEP_OBSERVED, JOB_PACKED, JOB_RESIZED,
+                     JOB_SUCCEEDED, JOB_FAILED)
 from .prometheus import (CONTENT_TYPE, TelemetryServer, escape_label_value,
                          format_value, histogram_lines, render_registry)
 from .spans import span
 from .worker import ServeTelemetry, TrainTelemetry, WorkerTelemetry
 
 __all__ = [
+    "ClockSync", "JobObservatory", "MetricsFederation", "goodput_ledger",
+    "merge_timeline", "parse_prometheus",
     "Counter", "Gauge", "Histogram", "Registry",
-    "EventLog", "read_events", "PREEMPTION_DRAIN", "EMERGENCY_CHECKPOINT",
+    "BoundEventLog", "EventLog", "read_events",
+    "PREEMPTION_DRAIN", "EMERGENCY_CHECKPOINT",
     "DIVERGENCE_ROLLBACK", "INIT_RETRY", "SLOT_ADMIT", "SLOT_RETIRE",
+    "CHECKPOINT_RESTORE", "CHECKPOINT_SAVED", "CLOCK_ANCHOR",
+    "FAULT_INJECTED", "REPLICA_FROZEN", "RUN_COMPLETE", "JOB_CREATED",
+    "GANG_RESTART", "PODS_READY", "FIRST_STEP_OBSERVED", "JOB_PACKED",
+    "JOB_RESIZED", "JOB_SUCCEEDED", "JOB_FAILED",
     "CONTENT_TYPE", "TelemetryServer", "escape_label_value", "format_value",
     "histogram_lines", "render_registry",
     "span",

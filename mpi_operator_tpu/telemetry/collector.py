@@ -1,0 +1,716 @@
+"""Controller-side observability collector: metrics federation, merged
+event timelines, and restart-aware goodput — the job-level view.
+
+PR 5 gave every *process* a `/metrics` endpoint and an fsync'd
+events.jsonl; a gang is N pods plus a controller. This module is the
+operator-side half that turns N per-process views into one per-job
+view:
+
+* `parse_prometheus` / `MetricsFederation` — scrape each worker pod's
+  exposition text and re-export aggregated ``tpu_job_*`` series
+  (counters summed, gauges max'd or summed by semantics, histograms
+  bucket-merged at the shared log-spaced edges) with ``job`` labels,
+  plus per-pod ``tpu_job_up`` / ``tpu_job_scrape_staleness_seconds`` /
+  ``tpu_job_scrape_failures_total`` meta-series so a dead worker is
+  visible, not invisible.
+
+* `ClockSync` / `merge_timeline` — merge controller + worker event
+  records by ``ts`` with per-host clock-offset correction. The offset
+  is anchored at bootstrap: each worker emits a `clock_anchor` event
+  with a fresh ``boot_id``, and the /events pull ships a server-side
+  ``now`` stamp; offset = controller_now − worker_now is pinned once
+  per boot_id so a mid-run scrape hiccup cannot re-skew history.
+
+* `goodput_ledger` — every executed step is either useful or lost.
+  A `checkpoint_restore` after which work had already advanced past
+  the restored step charges ``last observed step − restore step`` to
+  the lost column (the gang re-executes them); divergence rollbacks
+  charge ``from_step − to_step`` (same rule, intra-process). Goodput
+  is useful / (useful + lost).
+
+* `JobObservatory` — the stateful controller attachment: its own
+  EventLog (job_created, gang_restart, pods_ready, packed/resize,
+  first_step_observed, terminal), the scrape loop, and
+  ``<job>/timeline.jsonl`` writing.
+
+Also a CLI for harness use (scripts/tier1.sh --resilience plays the
+controller's role out-of-process):
+
+    python -m mpi_operator_tpu.telemetry.collector emit  --log L --job J EVENT [k=v ...]
+    python -m mpi_operator_tpu.telemetry.collector merge --job J --controller L \
+        [--worker HOST=PATH ...] [--offset HOST=SECS ...] \
+        --out timeline.jsonl [--metrics-out federated.prom]
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import logging
+import os
+import re
+import sys
+import time
+import urllib.parse
+import urllib.request
+from typing import Callable, Dict, Iterable, List, Optional, Tuple
+
+from . import events as ev
+from .events import EventLog, read_events
+from .prometheus import escape_label_value, format_value
+
+logger = logging.getLogger("mpi_operator_tpu.telemetry.collector")
+
+WORKER_PREFIX = "tpu_worker_"
+JOB_PREFIX = "tpu_job_"
+
+# Fields that carry a global-step position; the running max across a
+# merged timeline is "the furthest the gang has ever trained" — the
+# useful-step frontier the goodput ledger charges restores against.
+STEP_FIELDS = ("step", "from_step", "to_step", "last_observed_step")
+
+
+# ---------------------------------------------------------------------------
+# exposition-format parsing
+# ---------------------------------------------------------------------------
+
+_SAMPLE_RE = re.compile(
+    r'^([a-zA-Z_:][a-zA-Z0-9_:]*)(\{.*\})?\s+(\S+)\s*$')
+_LABEL_RE = re.compile(r'([a-zA-Z_][a-zA-Z0-9_]*)="((?:[^"\\]|\\.)*)"')
+
+
+def _unescape(v: str) -> str:
+    return v.replace('\\"', '"').replace("\\n", "\n").replace("\\\\", "\\")
+
+
+def parse_prometheus(text: str) -> Tuple[List[Tuple[str, Dict[str, str],
+                                                    float]],
+                                         Dict[str, str]]:
+    """Parse exposition 0.0.4 text into (samples, types).
+
+    samples: [(name, labels, value)]; types: metric name -> kind from
+    the ``# TYPE`` comments (histogram base names, not _bucket/_sum).
+    Unparseable lines are skipped — federation of a half-written scrape
+    should degrade, not abort."""
+    samples: List[Tuple[str, Dict[str, str], float]] = []
+    types: Dict[str, str] = {}
+    for line in text.splitlines():
+        line = line.strip()
+        if not line:
+            continue
+        if line.startswith("#"):
+            parts = line.split(None, 3)
+            if len(parts) >= 4 and parts[1] == "TYPE":
+                types[parts[2]] = parts[3]
+            continue
+        m = _SAMPLE_RE.match(line)
+        if not m:
+            continue
+        name, labelblob, raw = m.groups()
+        labels = ({k: _unescape(v) for k, v in _LABEL_RE.findall(labelblob)}
+                  if labelblob else {})
+        try:
+            value = float(raw)
+        except ValueError:
+            continue
+        samples.append((name, labels, value))
+    return samples, types
+
+
+# ---------------------------------------------------------------------------
+# federation
+# ---------------------------------------------------------------------------
+
+# Gauges whose job-level meaning is a SUM across pods (rates, occupancy);
+# everything else federates as MAX (steps, ratios, watermarks).
+_SUM_GAUGE_SUFFIXES = ("_per_sec",)
+_SUM_GAUGE_MARKERS = ("queue_depth", "slot", "kv_pages", "batch_size")
+
+
+def _gauge_is_summed(name: str) -> bool:
+    return (name.endswith(_SUM_GAUGE_SUFFIXES)
+            or any(m in name for m in _SUM_GAUGE_MARKERS))
+
+
+def _lkey(labels: Dict[str, str]) -> Tuple[Tuple[str, str], ...]:
+    return tuple(sorted((k, v) for k, v in labels.items() if k != "le"))
+
+
+def _hist_base(name: str, types: Dict[str, str]) -> Optional[str]:
+    for suffix in ("_bucket", "_sum", "_count"):
+        if name.endswith(suffix):
+            base = name[:-len(suffix)]
+            if types.get(base) == "histogram":
+                return base
+    return None
+
+
+class MetricsFederation:
+    """Aggregate per-pod scrapes of one job into ``tpu_job_*`` series.
+
+    Feed the latest scrape per replica_rank via ingest(); render() emits
+    the aggregate plus per-pod scrape-health meta-series. Only
+    ``tpu_worker_*`` names federate — operator and meta series are not
+    re-aggregated."""
+
+    def __init__(self, job: str, clock: Callable[[], float] = time.time,
+                 extra_labels: Optional[Dict[str, str]] = None):
+        self.job = job
+        self.clock = clock
+        self.extra_labels = dict(extra_labels or {})
+        # rank -> {"samples", "types", "last_success", "first_attempt",
+        #          "failures", "ok"}
+        self.pods: Dict[int, Dict] = {}
+
+    def _pod(self, rank: int) -> Dict:
+        return self.pods.setdefault(rank, {
+            "samples": [], "types": {}, "last_success": None,
+            "first_attempt": self.clock(), "failures": 0, "ok": False})
+
+    def ingest(self, rank: int, text: str) -> None:
+        pod = self._pod(rank)
+        samples, types = parse_prometheus(text)
+        pod["samples"], pod["types"] = samples, types
+        pod["last_success"] = self.clock()
+        pod["ok"] = True
+
+    def scrape_failed(self, rank: int) -> None:
+        pod = self._pod(rank)
+        pod["failures"] += 1
+        pod["ok"] = False
+
+    def observed_step(self) -> int:
+        """Max step frontier visible in the latest scrapes (live step
+        gauge or last checkpointed step, whichever is further)."""
+        best = 0
+        for pod in self.pods.values():
+            for name, _labels, value in pod["samples"]:
+                if name in (WORKER_PREFIX + "step",
+                            WORKER_PREFIX + "last_checkpoint_step"):
+                    best = max(best, int(value))
+        return best
+
+    def _aggregate(self):
+        counters: Dict[Tuple, float] = {}
+        gauges: Dict[Tuple, float] = {}
+        hists: Dict[Tuple, Dict] = {}
+        kinds: Dict[str, str] = {}
+        for pod in self.pods.values():
+            types = pod["types"]
+            for name, labels, value in pod["samples"]:
+                base = _hist_base(name, types)
+                if base is not None:
+                    if not base.startswith(WORKER_PREFIX):
+                        continue
+                    key = (base, _lkey(labels))
+                    h = hists.setdefault(key, {"buckets": {}, "sum": 0.0,
+                                               "count": 0.0})
+                    if name.endswith("_bucket"):
+                        le = labels.get("le", "+Inf")
+                        h["buckets"][le] = h["buckets"].get(le, 0.0) + value
+                    elif name.endswith("_sum"):
+                        h["sum"] += value
+                    else:
+                        h["count"] += value
+                    kinds[base] = "histogram"
+                    continue
+                if not name.startswith(WORKER_PREFIX):
+                    continue
+                kind = types.get(name, "gauge")
+                key = (name, _lkey(labels))
+                if kind == "counter":
+                    counters[key] = counters.get(key, 0.0) + value
+                    kinds[name] = "counter"
+                else:
+                    if _gauge_is_summed(name):
+                        gauges[key] = gauges.get(key, 0.0) + value
+                    else:
+                        gauges[key] = max(gauges.get(key, float("-inf")),
+                                          value)
+                    kinds[name] = "gauge"
+        return counters, gauges, hists, kinds
+
+    def _out_labels(self, lkey: Tuple,
+                    extra: Optional[Dict] = None) -> str:
+        merged = {"job": self.job, **self.extra_labels, **dict(lkey)}
+        if extra:
+            merged.update(extra)
+        inner = ",".join(f'{k}="{escape_label_value(v)}"'
+                         for k, v in merged.items())
+        return "{" + inner + "}"
+
+    @staticmethod
+    def _le_sort_key(le: str) -> float:
+        return float("inf") if le == "+Inf" else float(le)
+
+    def render_lines(self) -> List[str]:
+        counters, gauges, hists, kinds = self._aggregate()
+        lines: List[str] = []
+        seen = set()
+
+        def head(out_name: str, kind: str):
+            if out_name not in seen:
+                seen.add(out_name)
+                lines.append(f"# HELP {out_name} federated from "
+                             f"{WORKER_PREFIX}{out_name[len(JOB_PREFIX):]}"
+                             f" across the gang")
+                lines.append(f"# TYPE {out_name} {kind}")
+
+        for (name, lkey), value in sorted(counters.items()):
+            out = JOB_PREFIX + name[len(WORKER_PREFIX):]
+            head(out, "counter")
+            lines.append(f"{out}{self._out_labels(lkey)} "
+                         f"{format_value(value)}")
+        for (name, lkey), value in sorted(gauges.items()):
+            out = JOB_PREFIX + name[len(WORKER_PREFIX):]
+            head(out, "gauge")
+            lines.append(f"{out}{self._out_labels(lkey)} "
+                         f"{format_value(value)}")
+        for (base, lkey), h in sorted(hists.items()):
+            out = JOB_PREFIX + base[len(WORKER_PREFIX):]
+            head(out, "histogram")
+            for le in sorted(h["buckets"], key=self._le_sort_key):
+                lines.append(f"{out}_bucket"
+                             f"{self._out_labels(lkey, {'le': le})} "
+                             f"{format_value(h['buckets'][le])}")
+            lines.append(f"{out}_sum{self._out_labels(lkey)} "
+                         f"{format_value(h['sum'])}")
+            lines.append(f"{out}_count{self._out_labels(lkey)} "
+                         f"{format_value(h['count'])}")
+
+        # per-pod scrape health: a dead worker must be VISIBLE
+        meta = [("tpu_job_up",
+                 "gauge", "last scrape of this pod succeeded",
+                 lambda p: 1 if p["ok"] else 0),
+                ("tpu_job_scrape_staleness_seconds",
+                 "gauge", "seconds since this pod was last scraped ok",
+                 lambda p: round(self.clock() - (p["last_success"]
+                                                 or p["first_attempt"]), 3)),
+                ("tpu_job_scrape_failures_total",
+                 "counter", "failed scrapes of this pod",
+                 lambda p: p["failures"])]
+        for name, kind, help_text, fn in meta:
+            if not self.pods:
+                continue
+            lines.append(f"# HELP {name} {help_text}")
+            lines.append(f"# TYPE {name} {kind}")
+            for rank in sorted(self.pods):
+                lines.append(
+                    f"{name}"
+                    f"{self._out_labels((), {'replica_rank': str(rank)})}"
+                    f" {format_value(fn(self.pods[rank]))}")
+        return lines
+
+
+# ---------------------------------------------------------------------------
+# clock-offset correction + timeline merge
+# ---------------------------------------------------------------------------
+
+class ClockSync:
+    """Per-host clock offsets, pinned once per worker boot.
+
+    note() is called on every successful /events pull with the pull's
+    local receive time, the worker's self-reported ``now``, and the
+    boot_id of the newest `clock_anchor` record in the payload. The
+    offset (local − remote) is (re)pinned only when the boot_id changes
+    — a restarted pod gets a fresh anchor; a jittery scrape does not
+    re-skew already-merged history."""
+
+    def __init__(self):
+        self.offsets: Dict[str, float] = {}
+        self.boot_ids: Dict[str, Optional[str]] = {}
+
+    def note(self, host: str, local_now: float, remote_now: float,
+             boot_id: Optional[str] = None) -> float:
+        if host not in self.offsets or self.boot_ids.get(host) != boot_id:
+            self.offsets[host] = local_now - remote_now
+            self.boot_ids[host] = boot_id
+        return self.offsets[host]
+
+    def offset(self, host: str) -> float:
+        return self.offsets.get(host, 0.0)
+
+
+def latest_boot_id(records: Iterable[Dict]) -> Optional[str]:
+    boot = None
+    for rec in records:
+        if rec.get("event") == ev.CLOCK_ANCHOR and "boot_id" in rec:
+            boot = rec["boot_id"]
+    return boot
+
+
+def merge_timeline(sources: List[Tuple[Optional[str], List[Dict]]],
+                   offsets: Optional[Dict[str, float]] = None,
+                   out_path: Optional[str] = None) -> List[Dict]:
+    """Merge per-source event records into one ts-ordered timeline.
+
+    ``sources`` is [(host, records)]; host None/"controller" records are
+    the reference clock and pass through unshifted. Worker records get
+    their host's offset added; the original stamp is preserved as
+    ``ts_raw`` (plus ``clock_offset``) so a postmortem can always see
+    what the host itself believed. Every record gains a ``host`` field.
+    Returns the merged list; optionally writes it as JSONL."""
+    offsets = offsets or {}
+    merged: List[Dict] = []
+    for host, records in sources:
+        off = offsets.get(host, 0.0) if host else 0.0
+        for rec in records:
+            out = dict(rec)
+            out["host"] = host or "controller"
+            if off and "ts" in out:
+                out["ts_raw"] = out["ts"]
+                out["clock_offset"] = round(off, 3)
+                out["ts"] = round(out["ts"] + off, 3)
+            merged.append(out)
+    merged.sort(key=lambda r: (r.get("ts", 0.0)))
+    if out_path:
+        parent = os.path.dirname(out_path)
+        if parent:
+            os.makedirs(parent, exist_ok=True)
+        tmp = out_path + ".tmp"
+        with open(tmp, "w", encoding="utf-8") as fh:
+            for rec in merged:
+                fh.write(json.dumps(rec) + "\n")
+        os.replace(tmp, out_path)
+    return merged
+
+
+# ---------------------------------------------------------------------------
+# restart-aware goodput
+# ---------------------------------------------------------------------------
+
+def goodput_ledger(records: Iterable[Dict]) -> Dict:
+    """Fold a (merged) timeline into the job goodput ledger.
+
+    Every executed step is useful or lost. The useful frontier is the
+    running max over step-carrying fields; a `checkpoint_restore` to a
+    step behind that frontier charges the gap to the lost column (the
+    gang re-executes those steps), and a `divergence_rollback` charges
+    from_step − to_step. goodput = useful / (useful + lost)."""
+    observed = 0
+    lost = 0
+    restarts = 0
+    restores = 0
+    rollbacks = 0
+    for rec in sorted(records, key=lambda r: r.get("ts", 0.0)):
+        kind = rec.get("event")
+        if kind == ev.CHECKPOINT_RESTORE:
+            restores += 1
+            try:
+                lost += max(0, observed - int(rec.get("step", 0)))
+            except (TypeError, ValueError):
+                pass
+        elif kind == ev.DIVERGENCE_ROLLBACK:
+            rollbacks += 1
+            try:
+                lost += max(0, int(rec.get("from_step", 0))
+                            - int(rec.get("to_step", 0)))
+            except (TypeError, ValueError):
+                pass
+        elif kind == ev.GANG_RESTART:
+            restarts += 1
+        for field in STEP_FIELDS:
+            if field in rec:
+                try:
+                    observed = max(observed, int(rec[field]))
+                except (TypeError, ValueError):
+                    pass
+    total = observed + lost
+    return {"useful_steps": observed, "lost_steps": lost,
+            "total_steps": total,
+            "goodput": (observed / total) if total else 1.0,
+            "restarts": restarts, "restores": restores,
+            "rollbacks": rollbacks}
+
+
+def ledger_lines(job: str, ledger: Dict,
+                 extra_labels: Optional[Dict[str, str]] = None) -> List[str]:
+    labels = {"job": job, **(extra_labels or {})}
+    inner = ",".join(f'{k}="{escape_label_value(v)}"'
+                     for k, v in labels.items())
+    ls = "{" + inner + "}"
+    return [
+        "# HELP tpu_job_goodput useful steps over total steps "
+        "including restart- and rollback-lost work",
+        "# TYPE tpu_job_goodput gauge",
+        f"tpu_job_goodput{ls} {format_value(round(ledger['goodput'], 6))}",
+        "# HELP tpu_job_steps_lost_total steps re-executed after gang "
+        "restarts and rollbacks",
+        "# TYPE tpu_job_steps_lost_total counter",
+        f"tpu_job_steps_lost_total{ls} {ledger['lost_steps']}",
+        "# HELP tpu_job_useful_steps furthest step frontier reached",
+        "# TYPE tpu_job_useful_steps gauge",
+        f"tpu_job_useful_steps{ls} {ledger['useful_steps']}",
+        "# HELP tpu_job_restarts_total gang restarts observed",
+        "# TYPE tpu_job_restarts_total counter",
+        f"tpu_job_restarts_total{ls} {ledger['restarts']}",
+    ]
+
+
+# ---------------------------------------------------------------------------
+# the controller attachment
+# ---------------------------------------------------------------------------
+
+def _http_get(url: str, timeout: float = 2.0) -> str:
+    with urllib.request.urlopen(url, timeout=timeout) as resp:
+        return resp.read().decode("utf-8", "replace")
+
+
+class JobObservatory:
+    """Per-job observability state the controller carries.
+
+    One controller-side EventLog (each record stamped with its ``job``),
+    one MetricsFederation + ClockSync + worker-record cache per job, and
+    the scrape loop. All note_* methods are idempotent where the event
+    is once-per-lifecycle (created, pods_ready per incarnation,
+    first_step, terminal)."""
+
+    def __init__(self, events_dir: Optional[str] = None,
+                 events: Optional[EventLog] = None,
+                 clock: Callable[[], float] = time.time,
+                 fetch: Callable[[str], str] = _http_get,
+                 scrape_interval: float = 10.0):
+        self.events_dir = events_dir
+        if events is None and events_dir:
+            events = EventLog(os.path.join(events_dir,
+                                           "controller-events.jsonl"),
+                              clock=clock)
+        self.events = events
+        self.clock = clock
+        self.fetch = fetch
+        self.scrape_interval = scrape_interval
+        self.jobs: Dict[str, Dict] = {}
+
+    def view(self, job: str) -> Dict:
+        return self.jobs.setdefault(job, {
+            "created": False, "pods_ready": False, "first_step": False,
+            "terminal": False, "labels": {},
+            "federation": MetricsFederation(job, clock=self.clock),
+            "clock_sync": ClockSync(),
+            "controller_records": [], "worker_records": {},
+            "last_scrape": 0.0})
+
+    # -- controller lifecycle events ------------------------------------
+    def record(self, job: str, event: str, **fields) -> Dict:
+        view = self.view(job)
+        fields = {**view["labels"], **fields}
+        if self.events is not None:
+            rec = self.events.emit(event, job=job, **fields)
+        else:
+            rec = {"ts": round(self.clock(), 3), "event": event,
+                   "job": job, **fields}
+        view["controller_records"].append(rec)
+        return rec
+
+    def note_created(self, job: str, **fields) -> None:
+        view = self.view(job)
+        if not view["created"]:
+            view["created"] = True
+            self.record(job, ev.JOB_CREATED, **fields)
+
+    def note_pods_ready(self, job: str, **fields) -> None:
+        view = self.view(job)
+        if not view["pods_ready"]:
+            view["pods_ready"] = True
+            self.record(job, ev.PODS_READY, **fields)
+
+    def note_restart(self, job: str, exit_code: Optional[int],
+                     restart: int) -> None:
+        view = self.view(job)
+        view["pods_ready"] = False      # next readiness is a new event
+        self.record(job, ev.GANG_RESTART, exit_code=exit_code,
+                    restart=restart,
+                    last_observed_step=view["federation"].observed_step())
+
+    def note_packed(self, job: str, group: str, members: List[str],
+                    k: int,
+                    labels: Optional[Dict[str, str]] = None) -> None:
+        view = self.view(job)
+        if view["labels"].get("pack_group") != group:
+            # PackPlan.labels() when the controller drives this; every
+            # later timeline record and federated series carries them
+            view["labels"].update(labels or {"pack_group": group})
+            view["federation"].extra_labels.update(view["labels"])
+            self.record(job, ev.JOB_PACKED, members=members, k=k)
+
+    def note_resize(self, job: str, **fields) -> None:
+        self.record(job, ev.JOB_RESIZED, **fields)
+
+    def note_terminal(self, job: str, succeeded: bool, **fields) -> None:
+        view = self.view(job)
+        if view["terminal"]:
+            return
+        view["terminal"] = True
+        self.record(job, ev.JOB_SUCCEEDED if succeeded else ev.JOB_FAILED,
+                    **fields)
+        try:
+            self.write_timeline(job)
+        except OSError:
+            logger.warning("timeline write failed for job %s", job,
+                           exc_info=True)
+
+    # -- scraping -------------------------------------------------------
+    def observe(self, job: str, targets: Dict[int, str],
+                force: bool = False) -> None:
+        """Scrape each pod's /metrics and /events. ``targets`` maps
+        replica_rank -> base URL (http://host:port). Rate-limited by
+        scrape_interval unless forced."""
+        view = self.view(job)
+        now = self.clock()
+        if not force and now - view["last_scrape"] < self.scrape_interval:
+            return
+        view["last_scrape"] = now
+        fed = view["federation"]
+        for rank, base in sorted(targets.items()):
+            # netloc, not hostname: local test gangs share an IP and
+            # differ only by port, and each listener is its own clock
+            host = urllib.parse.urlparse(base).netloc or str(rank)
+            try:
+                fed.ingest(rank, self.fetch(base + "/metrics"))
+            except Exception:
+                fed.scrape_failed(rank)
+                continue
+            try:
+                payload = json.loads(self.fetch(base + "/events"))
+            except Exception:
+                # metrics landed; treat the events pull as best-effort
+                continue
+            records = payload.get("records", [])
+            view["clock_sync"].note(host, self.clock(),
+                                    payload.get("now", self.clock()),
+                                    latest_boot_id(records))
+            view["worker_records"][host] = records
+        step = self._observed_step(view)
+        if step > 0 and not view["first_step"]:
+            view["first_step"] = True
+            self.record(job, ev.FIRST_STEP_OBSERVED, step=step)
+
+    def _observed_step(self, view: Dict) -> int:
+        best = view["federation"].observed_step()
+        for records in view["worker_records"].values():
+            for rec in records:
+                for field in STEP_FIELDS:
+                    if field in rec:
+                        try:
+                            best = max(best, int(rec[field]))
+                        except (TypeError, ValueError):
+                            pass
+        return best
+
+    # -- outputs --------------------------------------------------------
+    def merged_records(self, job: str) -> List[Dict]:
+        view = self.view(job)
+        sources: List[Tuple[Optional[str], List[Dict]]] = [
+            (None, view["controller_records"])]
+        sources += [(host, recs)
+                    for host, recs in sorted(view["worker_records"].items())]
+        return merge_timeline(sources, offsets=view["clock_sync"].offsets)
+
+    def write_timeline(self, job: str,
+                       out_path: Optional[str] = None) -> str:
+        if out_path is None:
+            root = self.events_dir or "."
+            out_path = os.path.join(root, job, "timeline.jsonl")
+        merge_timeline(
+            [(None, self.view(job)["controller_records"])] +
+            [(host, recs) for host, recs
+             in sorted(self.view(job)["worker_records"].items())],
+            offsets=self.view(job)["clock_sync"].offsets,
+            out_path=out_path)
+        return out_path
+
+    def render_lines(self) -> List[str]:
+        lines: List[str] = []
+        for job in sorted(self.jobs):
+            view = self.jobs[job]
+            lines += view["federation"].render_lines()
+            lines += ledger_lines(job,
+                                  goodput_ledger(self.merged_records(job)),
+                                  extra_labels=view["labels"])
+        return lines
+
+    def render(self) -> str:
+        lines = self.render_lines()
+        return ("\n".join(lines) + "\n") if lines else ""
+
+    def close(self) -> None:
+        if self.events is not None:
+            self.events.close()
+
+
+# ---------------------------------------------------------------------------
+# CLI — the harness-side controller stand-in
+# ---------------------------------------------------------------------------
+
+def _parse_kv(pairs: List[str]) -> Dict:
+    out = {}
+    for pair in pairs:
+        if "=" not in pair:
+            raise SystemExit(f"expected k=v, got {pair!r}")
+        k, v = pair.split("=", 1)
+        try:
+            out[k] = json.loads(v)
+        except json.JSONDecodeError:
+            out[k] = v
+    return out
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m mpi_operator_tpu.telemetry.collector",
+        description="job-level event collection: emit controller events, "
+                    "merge timelines, compute the goodput ledger")
+    sub = parser.add_subparsers(dest="cmd", required=True)
+
+    p_emit = sub.add_parser("emit", help="append one controller event")
+    p_emit.add_argument("--log", required=True)
+    p_emit.add_argument("--job", required=True)
+    p_emit.add_argument("event")
+    p_emit.add_argument("fields", nargs="*", help="k=v extra fields")
+
+    p_merge = sub.add_parser("merge", help="merge controller + worker "
+                             "event logs into one timeline")
+    p_merge.add_argument("--job", required=True)
+    p_merge.add_argument("--controller", required=True,
+                         help="controller events.jsonl")
+    p_merge.add_argument("--worker", action="append", default=[],
+                         metavar="HOST=PATH", help="worker event log")
+    p_merge.add_argument("--offset", action="append", default=[],
+                         metavar="HOST=SECONDS",
+                         help="clock offset to ADD to that host's ts")
+    p_merge.add_argument("--out", required=True, help="timeline.jsonl")
+    p_merge.add_argument("--metrics-out", default=None,
+                         help="write federated goodput series here")
+
+    args = parser.parse_args(argv)
+    if args.cmd == "emit":
+        with EventLog(args.log) as log:
+            log.emit(args.event, job=args.job, **_parse_kv(args.fields))
+        return 0
+
+    # merge
+    controller = [r for r in read_events(args.controller)
+                  if r.get("job", args.job) == args.job]
+    sources: List[Tuple[Optional[str], List[Dict]]] = [(None, controller)]
+    for spec in args.worker:
+        if "=" not in spec:
+            raise SystemExit(f"--worker expects HOST=PATH, got {spec!r}")
+        host, path = spec.split("=", 1)
+        sources.append((host, read_events(path)))
+    offsets = {k: float(v) for k, v in _parse_kv(args.offset).items()}
+    merged = merge_timeline(sources, offsets=offsets, out_path=args.out)
+    ledger = goodput_ledger(merged)
+    if args.metrics_out:
+        with open(args.metrics_out, "w", encoding="utf-8") as fh:
+            fh.write("\n".join(ledger_lines(args.job, ledger)) + "\n")
+    print(json.dumps({"job": args.job, "records": len(merged),
+                      "timeline": args.out, **ledger}))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
+
+
+__all__ = ["parse_prometheus", "MetricsFederation", "ClockSync",
+           "merge_timeline", "goodput_ledger", "ledger_lines",
+           "JobObservatory", "latest_boot_id", "main"]

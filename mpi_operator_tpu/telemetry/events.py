@@ -8,44 +8,93 @@ the records a post-mortem needs after the process is already dead.
 The record discipline is bench.py's mid-kill-survivable one: each event
 is a single JSON line written, flushed, AND os.fsync'd before emit()
 returns. A SIGKILL between two emits loses nothing; a SIGKILL in the
-middle of a write can at worst truncate the LAST line, which
-`read_events` tolerates by skipping a trailing partial record. This is
-what makes the resilience contract honest: the `preemption_drain` event
-is durable on disk BEFORE the emergency checkpoint starts, so even a
-save that dies mid-write leaves evidence of why.
+middle of a write can at worst truncate the LAST line. `read_events`
+skips any undecodable line (counting them in DECODE_ERRORS) so a torn
+tail — or a concurrent writer caught mid-record — never aborts a live
+postmortem read.
 
 Records: {"ts": <unix seconds>, "event": <kind>, ...fields}. One file
-per process — multi-host runs should point each worker at its own path
-(aggregation is a ROADMAP follow-up).
+per process; the controller-side collector (telemetry/collector.py)
+merges per-host files into a job timeline. Long-running sinks can cap
+growth with TPU_EVENTS_MAX_BYTES (size-based rotation to .1, .2, ...;
+off by default), and packed trainers stamp replica/pack_group into
+every record via bind().
 """
 from __future__ import annotations
 
 import json
+import logging
 import os
 import threading
 import time
 from typing import Dict, List, Optional
 
+logger = logging.getLogger("mpi_operator_tpu.telemetry.events")
+
 # Event kinds. Constants, not an enum: the log is a plain-text contract
 # read by shell greps (scripts/tier1.sh --resilience) and jq alike.
+#
+# Worker-side kinds (emitted under <train-dir>/events.jsonl):
 PREEMPTION_DRAIN = "preemption_drain"
 EMERGENCY_CHECKPOINT = "emergency_checkpoint"
 DIVERGENCE_ROLLBACK = "divergence_rollback"
 INIT_RETRY = "init_retry"
 SLOT_ADMIT = "slot_admit"
 SLOT_RETIRE = "slot_retire"
+CHECKPOINT_RESTORE = "checkpoint_restore"
+CHECKPOINT_SAVED = "checkpoint_saved"
+CLOCK_ANCHOR = "clock_anchor"
+FAULT_INJECTED = "fault_injected"
+REPLICA_FROZEN = "replica_frozen"
+RUN_COMPLETE = "run_complete"
+# Controller-side kinds (the operator's own EventLog; stamped with a
+# "job" field and merged with worker records into <job>/timeline.jsonl):
+JOB_CREATED = "job_created"
+GANG_RESTART = "gang_restart"
+PODS_READY = "pods_ready"
+FIRST_STEP_OBSERVED = "first_step_observed"
+JOB_PACKED = "packed"
+JOB_RESIZED = "resize"
+JOB_SUCCEEDED = "job_succeeded"
+JOB_FAILED = "job_failed"
+
+# Rotation knobs: TPU_EVENTS_MAX_BYTES caps the live file (0/unset =
+# rotation off, the historical behaviour); TPU_EVENTS_KEEP is how many
+# rotated generations (.1 oldest-kept ... highest newest) survive.
+ENV_MAX_BYTES = "TPU_EVENTS_MAX_BYTES"
+ENV_KEEP = "TPU_EVENTS_KEEP"
+
+# Module-level tally of undecodable lines skipped by read_events since
+# import — a warning counter, not an error channel: mid-file garbage is
+# logged and skipped so a live read never aborts on a concurrent write.
+DECODE_ERRORS = 0
+
+
+def _env_int(name: str, default: int) -> int:
+    raw = os.environ.get(name)
+    if not raw:
+        return default
+    try:
+        return int(raw)
+    except ValueError:
+        return default
 
 
 class EventLog:
     """Append-only JSONL event sink with per-record durability."""
 
-    def __init__(self, path: str, clock=time.time):
+    def __init__(self, path: str, clock=time.time,
+                 max_bytes: Optional[int] = None,
+                 keep: Optional[int] = None):
         self.path = path
         parent = os.path.dirname(path)
         if parent:
             os.makedirs(parent, exist_ok=True)
         self._clock = clock
         self._lock = threading.Lock()
+        self.max_bytes = _env_int(ENV_MAX_BYTES, 0) if max_bytes is None \
+            else max_bytes
+        self.keep = max(1, _env_int(ENV_KEEP, 1) if keep is None else keep)
         self._fh = open(path, "a", encoding="utf-8")
 
     def emit(self, event: str, **fields) -> Dict:
@@ -56,13 +105,45 @@ class EventLog:
         losing a post-close event beats crashing the drain.
         """
         rec = {"ts": round(self._clock(), 3), "event": event, **fields}
+        line = json.dumps(rec) + "\n"
         with self._lock:
             if self._fh.closed:
                 return rec
-            self._fh.write(json.dumps(rec) + "\n")
+            if self.max_bytes and self._fh.tell() + len(line) > self.max_bytes:
+                self._rotate_locked()
+            self._fh.write(line)
             self._fh.flush()
             os.fsync(self._fh.fileno())
         return rec
+
+    def _rotate_locked(self) -> None:
+        """Shift events.jsonl -> .1 -> .2 ... keeping the newest `keep`
+        rotated generations. Caller holds the lock; the live handle is
+        reopened on the (now empty) base path. Rotation is best-effort:
+        an OSError (read-only dir mid-teardown) falls back to appending
+        past the cap rather than dropping the record."""
+        try:
+            self._fh.flush()
+            os.fsync(self._fh.fileno())
+            self._fh.close()
+            oldest = self.path + ".%d" % self.keep
+            if os.path.exists(oldest):
+                os.remove(oldest)
+            for i in range(self.keep - 1, 0, -1):
+                src = self.path + ".%d" % i
+                if os.path.exists(src):
+                    os.replace(src, self.path + ".%d" % (i + 1))
+            os.replace(self.path, self.path + ".1")
+        except OSError:
+            logger.warning("event log rotation failed for %s", self.path,
+                           exc_info=True)
+        self._fh = open(self.path, "a", encoding="utf-8")
+
+    def bind(self, **fields) -> "BoundEventLog":
+        """A view of this log that stamps `fields` into every record —
+        how HFTA packed replicas get a `replica` (and `pack_group`)
+        field without threading labels through every emit site."""
+        return BoundEventLog(self, fields)
 
     def flush(self) -> None:
         """Force-durability barrier. emit() already fsyncs per record, so
@@ -90,30 +171,92 @@ class EventLog:
         self.close()
 
 
-def read_events(path: str, kind: Optional[str] = None) -> List[Dict]:
-    """Parse an event log, skipping a trailing partial record (the only
-    corruption a mid-write SIGKILL can produce). Optionally filter by
-    event kind."""
-    out: List[Dict] = []
-    try:
-        with open(path, "r", encoding="utf-8") as fh:
-            lines = fh.read().split("\n")
-    except FileNotFoundError:
-        return out
-    for i, line in enumerate(lines):
-        if not line.strip():
-            continue
-        try:
-            rec = json.loads(line)
-        except json.JSONDecodeError:
-            if i == len(lines) - 1:    # torn final write — expected
-                continue
-            raise
-        if kind is None or rec.get("event") == kind:
-            out.append(rec)
+class BoundEventLog:
+    """EventLog view with pre-bound fields (see EventLog.bind).
+
+    Duck-type compatible with EventLog at the emit/flush/close/path
+    surface; close() and flush() delegate to the SHARED underlying log,
+    so ownership stays with whoever opened it. Explicit emit() kwargs
+    win over bound fields."""
+
+    def __init__(self, log, fields: Dict):
+        self._log = log
+        self.fields = dict(fields)
+
+    @property
+    def path(self) -> str:
+        return self._log.path
+
+    def emit(self, event: str, **fields) -> Dict:
+        return self._log.emit(event, **{**self.fields, **fields})
+
+    def bind(self, **fields) -> "BoundEventLog":
+        return BoundEventLog(self._log, {**self.fields, **fields})
+
+    def flush(self) -> None:
+        self._log.flush()
+
+    def close(self) -> None:
+        self._log.close()
+
+
+def event_files(path: str) -> List[str]:
+    """The rotation chain for `path`, oldest first: highest-numbered
+    .N down to .1, then the live file. Only existing files returned."""
+    suffixes = []
+    for name in os.listdir(os.path.dirname(path) or "."):
+        full = os.path.join(os.path.dirname(path) or ".", name)
+        prefix = os.path.basename(path) + "."
+        if name.startswith(prefix):
+            tail = name[len(prefix):]
+            if tail.isdigit():
+                suffixes.append((int(tail), full))
+    out = [full for _, full in sorted(suffixes, reverse=True)]
+    if os.path.exists(path):
+        out.append(path)
     return out
 
 
-__all__ = ["EventLog", "read_events", "PREEMPTION_DRAIN",
+def read_events(path: str, kind: Optional[str] = None) -> List[Dict]:
+    """Parse an event log — including any rotated generations (.N files,
+    oldest first) — skipping ANY undecodable line. A mid-write SIGKILL
+    tears at most the final line; a concurrent writer can expose a
+    half-record anywhere a reader races it. Either way the skip is
+    counted in DECODE_ERRORS and logged, never raised, so a live
+    postmortem read cannot abort. Optionally filter by event kind."""
+    global DECODE_ERRORS
+    out: List[Dict] = []
+    try:
+        files = event_files(path)
+    except FileNotFoundError:
+        return out
+    for fname in files:
+        try:
+            with open(fname, "r", encoding="utf-8") as fh:
+                lines = fh.read().split("\n")
+        except FileNotFoundError:
+            continue
+        for line in lines:
+            if not line.strip():
+                continue
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError:
+                DECODE_ERRORS += 1
+                logger.warning("skipping undecodable event line in %s "
+                               "(%d skipped since import)",
+                               fname, DECODE_ERRORS)
+                continue
+            if kind is None or rec.get("event") == kind:
+                out.append(rec)
+    return out
+
+
+__all__ = ["EventLog", "BoundEventLog", "read_events", "event_files",
+           "DECODE_ERRORS", "PREEMPTION_DRAIN",
            "EMERGENCY_CHECKPOINT", "DIVERGENCE_ROLLBACK", "INIT_RETRY",
-           "SLOT_ADMIT", "SLOT_RETIRE"]
+           "SLOT_ADMIT", "SLOT_RETIRE", "CHECKPOINT_RESTORE",
+           "CHECKPOINT_SAVED", "CLOCK_ANCHOR", "FAULT_INJECTED",
+           "REPLICA_FROZEN", "RUN_COMPLETE", "JOB_CREATED",
+           "GANG_RESTART", "PODS_READY", "FIRST_STEP_OBSERVED",
+           "JOB_PACKED", "JOB_RESIZED", "JOB_SUCCEEDED", "JOB_FAILED"]

@@ -11,11 +11,14 @@ endpoints speak identical text format (one bug surface, not two).
 """
 from __future__ import annotations
 
+import json
 import threading
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Callable, Dict, List, Optional
 
 from .core import Histogram, Registry
+from .events import read_events
 
 CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
 
@@ -98,10 +101,19 @@ class TelemetryServer:
     port (tests), `.port` holds the bound value, close() is idempotent.
     `healthy` is an optional callable polled by /healthz — wire it to the
     training loop's liveness signal; default is always-ok.
+
+    `events_path` additionally serves GET /events: the process's event
+    log as JSON `{"now": <server unix time>, "records": [...]}`. The
+    `now` stamp is what the controller-side collector anchors per-host
+    clock-offset correction on (collector.py) — it is sampled in the
+    same request that ships the records, so offset = local_now - now
+    holds to within one round trip. read_events tolerates the live
+    writer, so a scrape never races a torn record into an error.
     """
 
     def __init__(self, registry: Registry, port: int = 0, host: str = "",
-                 healthy: Optional[Callable[[], bool]] = None):
+                 healthy: Optional[Callable[[], bool]] = None,
+                 events_path: Optional[str] = None):
         outer = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -110,6 +122,12 @@ class TelemetryServer:
                     body = render_registry(outer.registry).encode()
                     self.send_response(200)
                     self.send_header("Content-Type", CONTENT_TYPE)
+                elif self.path == "/events" and outer.events_path:
+                    payload = {"now": time.time(),
+                               "records": read_events(outer.events_path)}
+                    body = (json.dumps(payload) + "\n").encode()
+                    self.send_response(200)
+                    self.send_header("Content-Type", "application/json")
                 elif self.path == "/healthz":
                     ok = outer.healthy() if outer.healthy else True
                     body = b"ok\n" if ok else b"unhealthy\n"
@@ -128,6 +146,7 @@ class TelemetryServer:
 
         self.registry = registry
         self.healthy = healthy
+        self.events_path = events_path
         self._httpd = ThreadingHTTPServer((host, port), Handler)
         self.port = self._httpd.server_address[1]
         self._thread = threading.Thread(

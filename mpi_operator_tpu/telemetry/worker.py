@@ -15,6 +15,12 @@ Train series (LMTrainer / Trainer / PipelineLMTrainer benchmark loops):
   host_gap_seconds        histogram — host blocked-on-device time per
                                       window fetch (how much of the step
                                       the async dispatch did NOT hide)
+  step                    gauge     — last observed global step (the
+                                      controller's restart-aware
+                                      goodput reads this frontier)
+  last_checkpoint_step    gauge     — newest durable checkpoint step
+  restore_step            gauge     — step this incarnation restored
+                                      from (0 when fresh)
   steps_total             counter   — steps executed
   skipped_steps_total     counter   — divergence-guard skipped (lower
                                       bound: streaks are sampled at
@@ -94,6 +100,17 @@ class TrainTelemetry:
         self.goodput = reg.gauge(
             "tpu_worker_goodput", "productive steps / total steps (0-1)",
             labels=labels)
+        self.step = reg.gauge(
+            "tpu_worker_step", "last observed global step",
+            labels=labels)
+        self.last_checkpoint_step = reg.gauge(
+            "tpu_worker_last_checkpoint_step",
+            "newest durable checkpoint's global step",
+            labels=labels)
+        self.restore_step = reg.gauge(
+            "tpu_worker_restore_step",
+            "global step this incarnation restored from (0 = fresh)",
+            labels=labels)
         self.steps_total = reg.counter(
             "tpu_worker_steps_total", "train steps executed",
             labels=labels)
@@ -122,13 +139,16 @@ class TrainTelemetry:
 
     def update_window(self, tokens_per_sec: Optional[float] = None,
                       examples_per_sec: Optional[float] = None,
-                      mfu: Optional[float] = None) -> None:
+                      mfu: Optional[float] = None,
+                      step: Optional[int] = None) -> None:
         if tokens_per_sec is not None:
             self.tokens_per_sec.set(tokens_per_sec)
         if examples_per_sec is not None:
             self.examples_per_sec.set(examples_per_sec)
         if mfu is not None:
             self.mfu.set(mfu)
+        if step is not None:
+            self.step.set(int(step))
 
     def record_streak(self, streak: int) -> int:
         """Fold a window-fetch `nonfinite_streak` reading into the skipped
@@ -267,8 +287,13 @@ class WorkerTelemetry:
     def serve(self, port: int = 0, host: str = "",
               healthy=None) -> TelemetryServer:
         if self._server is None:
+            # export the event log alongside /metrics: the controller's
+            # collector pulls /events with the same scrape and merges
+            # the records into the job timeline (clock-offset corrected)
+            events_path = self.events.path if self.events else None
             self._server = TelemetryServer(
-                self.registry, port=port, host=host, healthy=healthy)
+                self.registry, port=port, host=host, healthy=healthy,
+                events_path=events_path)
         return self._server
 
     @property

@@ -275,16 +275,23 @@ def reset_saved_state() -> None:
     _LAST_SAVED.clear()
 
 
-def periodic_saver(train_dir, every: int, log=print, keep_last: int = 0):
+def periodic_saver(train_dir, every: int, log=print, keep_last: int = 0,
+                   resilience=None):
     """A `hook(state, step)` for training loops: every `every` steps it
     fires a NON-blocking async checkpoint (training overlaps the write —
     this is what makes mid-run gang restarts resumable instead of losing
     the whole run). keep_last > 0 additionally garbage-collects older
     step_N directories after each save (gc_checkpoints). None when
     disabled; pair with wait_for_checkpoints() (or the final maybe_save,
-    which joins implicitly) before exit."""
+    which joins implicitly) before exit.
+
+    `resilience` (a ResilienceContext) gets record_checkpoint(step) on
+    the NEXT hook firing, after wait_for_checkpoints has joined the
+    write — the `checkpoint_saved` event must describe a committed
+    checkpoint, not an in-flight one."""
     if not train_dir or every <= 0:
         return None
+    pending = []        # steps dispatched but not yet reported committed
 
     def hook(state, step: int) -> None:
         if step % every == 0:
@@ -294,10 +301,15 @@ def periodic_saver(train_dir, every: int, log=print, keep_last: int = 0):
             # gc deletes older ones — gc must never race an in-flight
             # write it cannot see (tmp-named until commit)
             wait_for_checkpoints()
+            if resilience is not None:
+                while pending:
+                    resilience.record_checkpoint(pending.pop(0))
             if keep_last > 0:
                 gc_checkpoints(train_dir, keep_last, log)
             # explicit step: save_checkpoint(step=None) would host-read
             # state.step, a device sync the training loop must not pay
             path = save_checkpoint(train_dir, state, step=step, block=False)
+            if resilience is not None:
+                pending.append(step)
             log(f"async checkpoint -> {path}")
     return hook

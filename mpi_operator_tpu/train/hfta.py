@@ -63,6 +63,7 @@ from jax.sharding import Mesh
 
 from ..parallel.mesh import BATCH_AXES
 from ..telemetry import TrainTelemetry
+from ..telemetry import events as tev
 from ..telemetry.core import Registry
 from ..utils import flops
 from .lm_trainer import LMTrainer, LMTrainerConfig, LMTrainState, make_adamw
@@ -412,19 +413,27 @@ class HFTATrainer:
                   log: Callable[[str], None] = print,
                   registry: Optional[Registry] = None,
                   faults: Optional[FaultInjector] = None,
-                  step_hook: Optional[Callable] = None
+                  step_hook: Optional[Callable] = None,
+                  events=None
                   ) -> Tuple[HFTATrainState, Dict[str, Any]]:
         """Timed fused loop. `dataset` yields ([K,B,S] tokens, [K,B,S]
         targets). Per-replica throughput/MFU/goodput land as LABELED
         tpu_worker_* series (labels={"replica": k}) on one shared
-        registry — the per-job view the packing controller scrapes."""
+        registry — the per-job view the packing controller scrapes.
+        `events` (an EventLog) gets the same treatment: all K replicas
+        share the file, so each replica's records are emitted through a
+        bound view stamping the matching ``replica`` label."""
         cfg = self.config
         kk = self.k
         reg = registry if registry is not None else Registry()
         tels = [TrainTelemetry(reg, labels={"replica": str(k)})
                 for k in range(kk)]
+        evs = ([events.bind(replica=str(k)) for k in range(kk)]
+               if events is not None else None)
         if faults is None:
             faults = FaultInjector.from_env()
+        if faults is not None and faults.events is None:
+            faults.events = events
 
         it = iter(dataset)
         tokens, targets = next(it)
@@ -440,6 +449,7 @@ class HFTATrainer:
 
         base_step = int(state.step)
         log_every = max(1, min(cfg.log_every, num_steps))
+        prev_frozen = np.asarray(state.frozen).astype(bool).copy()
         windows: List[Dict[str, Any]] = []
         t0 = g0 = time.perf_counter()
         start = t0
@@ -467,8 +477,16 @@ class HFTATrainer:
                     tels[k].host_gap_seconds.observe(max(t1 - g0, 0.0))
                     tels[k].observe_steps(dt / log_every, log_every)
                     tels[k].update_window(tokens_per_sec=tps_replica,
-                                          mfu=mfu_stats.get("mfu"))
+                                          mfu=mfu_stats.get("mfu"),
+                                          step=base_step + i)
                     tels[k].record_streak(int(streaks[k]))
+                    # a replica freezing is a discrete, precious fact —
+                    # one labeled record per transition, not per window
+                    if evs is not None and frozen[k] and not prev_frozen[k]:
+                        evs[k].emit(tev.REPLICA_FROZEN,
+                                    step=base_step + i,
+                                    streak=int(streaks[k]))
+                prev_frozen = frozen.astype(bool).copy()
                 windows.append({
                     "steps": log_every, "seconds": dt,
                     "loss": loss.tolist(), "frozen": frozen.tolist(),

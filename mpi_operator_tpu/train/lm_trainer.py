@@ -616,7 +616,8 @@ class LMTrainer:
                     tel.update_window(
                         tokens_per_sec=tps,
                         mfu=flops.throughput_stats(
-                            flops_per_step, tps / tokens_per_step, n)["mfu"])
+                            flops_per_step, tps / tokens_per_step, n)["mfu"],
+                        step=base_step + i)
                     streak = int(metrics.get("nonfinite_streak", 0))
                     if streak:
                         tel.record_streak(streak)

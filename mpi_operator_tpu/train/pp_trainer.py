@@ -489,7 +489,8 @@ class PipelineLMTrainer:
         stats = flops.throughput_stats(
             per_token * tokens_per_step, tps / tokens_per_step, n)
         tel.observe_steps(dt / num_steps, num_steps)
-        tel.update_window(tokens_per_sec=tps, mfu=stats["mfu"])
+        tel.update_window(tokens_per_sec=tps, mfu=stats["mfu"],
+                          step=base_step + num_steps)
         p50_ms, p99_ms = tel.step_percentiles_ms()
         gap50_ms, gap99_ms = tel.host_gap_percentiles_ms()
         log(f"pp={self.pp} M={self.num_microbatches} "

@@ -288,6 +288,13 @@ class FaultInjector:
     silently injects nothing would green a test that proved nothing."""
 
     def __init__(self, spec: str = ""):
+        #: telemetry.EventLog — when set (ResilienceContext wires its
+        #: own), step faults leave a durable `fault_injected` record
+        #: BEFORE the kill. A hard death writes no emergency checkpoint,
+        #: so this record is the only evidence of how far the run got —
+        #: the controller's goodput ledger charges restart-lost steps
+        #: against exactly this frontier.
+        self.events = None
         self.die_at_step: Optional[int] = None
         self.sigterm_at_step: Optional[int] = None
         self.corrupt_latest = False
@@ -331,12 +338,20 @@ class FaultInjector:
         preemption signal — the return value makes the drill
         deterministic instead of racing CPython's signal delivery)."""
         if self.die_at_step is not None and step >= self.die_at_step:
+            self._emit_fault("die", step)
             os._exit(FAULT_DIE_EXIT)
         if self.sigterm_at_step is not None and step >= self.sigterm_at_step:
             self.sigterm_at_step = None        # one shot
+            self._emit_fault("sigterm", step)
             os.kill(os.getpid(), signal.SIGTERM)
             return True
         return False
+
+    def _emit_fault(self, fault: str, step: int) -> None:
+        """The drill leaves evidence: one fsync'd record before the kill."""
+        if self.events is not None:
+            from ..telemetry import events as ev
+            self.events.emit(ev.FAULT_INJECTED, fault=fault, step=int(step))
 
     def check_nan_replica(self, step: int) -> Optional[int]:
         """One-shot nan-replica:K@N probe — returns the replica index to
@@ -435,6 +450,8 @@ class ResilienceContext:
         self.events = events
         #: telemetry.TrainTelemetry — rollback accounting feeds goodput
         self.telemetry = telemetry
+        if self.faults is not None and self.faults.events is None:
+            self.faults.events = events
         self._pending_stop = False
         self._rollbacks = 0
 
@@ -503,6 +520,40 @@ class ResilienceContext:
         if self.events is not None:
             self.events.emit(ev.EMERGENCY_CHECKPOINT, step=step,
                              train_dir=self.config.train_dir)
+        if self.telemetry is not None:
+            self.telemetry.last_checkpoint_step.set(step)
+            self.telemetry.step.set(step)
+
+    # -- restart-aware goodput bookkeeping -----------------------------------
+
+    def record_restore(self, step: int, path: Optional[str] = None) -> None:
+        """Report the step this incarnation restored from. The controller
+        charges (last observed step − restore step) to the lost column of
+        the job goodput ledger, so the restore step MUST be durable in the
+        event log and visible on /metrics — call this right after
+        maybe_resume, with step 0 meaning a fresh start (no event)."""
+        step = int(step)
+        if step > 0 and self.events is not None:
+            from ..telemetry import events as ev
+            fields = {"step": step}
+            if path:
+                fields["path"] = path
+            self.events.emit(ev.CHECKPOINT_RESTORE, **fields)
+        if self.telemetry is not None:
+            self.telemetry.restore_step.set(step)
+            if step > 0:
+                self.telemetry.last_checkpoint_step.set(step)
+                self.telemetry.step.set(step)
+
+    def record_checkpoint(self, step: int) -> None:
+        """Report a durable periodic checkpoint (periodic_saver hook)."""
+        step = int(step)
+        if self.events is not None:
+            from ..telemetry import events as ev
+            self.events.emit(ev.CHECKPOINT_SAVED, step=step,
+                             train_dir=self.config.train_dir)
+        if self.telemetry is not None:
+            self.telemetry.last_checkpoint_step.set(step)
 
     def rollback(self, state):
         """Restore the newest intact checkpoint after divergence_k

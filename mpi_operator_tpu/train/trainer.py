@@ -276,7 +276,8 @@ class Trainer:
                         mfu=flops.throughput_stats(
                             flops_per_step,
                             ips / self.config.global_batch_size,
-                            self.mesh.size)["mfu"])
+                            self.mesh.size)["mfu"],
+                        step=base_step + i)
                     streak = int(metrics.get("nonfinite_streak", 0))
                     if streak:
                         tel.record_streak(streak)

@@ -24,10 +24,13 @@
 #   the in-process pytest e2e can't observe the exit-status contract).
 
 if [ "${1:-}" = "--resilience" ]; then
-  # Preemption smoke: kill the shipped lm_benchmark entrypoint at step 5
-  # via the fault injector, assert the RETRYABLE exit code (215) and the
-  # emergency checkpoint, then rerun clean and assert it resumes and
-  # exits 0 — the controller-eye view of a preempted gang.
+  # Preemption smoke, four runs: (1) SIGTERM at step 5 → exit 215 +
+  # emergency step_5; (2) resume → stop at step 8, exit 0; (3) hard
+  # death (die-at-step:11) → exit 217, NO checkpoint; (4) resume from
+  # step_8 → stop at step 12. The collector CLI plays the controller
+  # between runs (gang_restart records), then merges controller+worker
+  # logs into ONE timeline.jsonl and renders the federated goodput
+  # ledger — the controller-eye view of a preempted gang, end to end.
   set -u
   dir=$(mktemp -d)
   trap 'rm -rf "$dir"' EXIT
@@ -37,6 +40,9 @@ if [ "${1:-}" = "--resilience" ]; then
         --workload gpt2 --size test --batch-per-device 1 --seq-len 16
         --dtype float32 --warmup-steps 1 --num-steps 20
         --train-dir "$dir/ckpt")
+  emit=("${run_env[@]}" python -m mpi_operator_tpu.telemetry.collector
+        emit --log "$dir/controller.jsonl" --job smoke)
+  "${emit[@]}" job_created tpus=8 || exit 1
   echo "== resilience smoke: preempt at step 5 =="
   "${run_env[@]}" TPU_FAULT_INJECT=sigterm-at-step:5 \
     "${args[@]}" > "$dir/preempt.log" 2>&1
@@ -59,6 +65,9 @@ if [ "${1:-}" = "--resilience" ]; then
     echo "FAIL: no emergency_checkpoint record in the event log"
     cat "$dir/ckpt/events.jsonl" 2>/dev/null; exit 1
   fi
+  # play the controller's role: record the restart in the controller-
+  # side log the merge below folds into the job timeline
+  "${emit[@]}" gang_restart exit_code=215 restart=1 || exit 1
   echo "== resilience smoke: resume to step 8 =="
   "${run_env[@]}" "${args[@]}" --num-steps 20 --stop-at-step 8 \
     > "$dir/resume.log" 2>&1
@@ -74,7 +83,90 @@ if [ "${1:-}" = "--resilience" ]; then
     echo "FAIL: resumed run did not reach global step 8"
     ls "$dir/ckpt"; exit 1
   fi
-  echo "resilience smoke: OK (exit 215 -> emergency step_5 -> events -> resume -> step_8)"
+  # Hard-death leg: the injector die()s at step 11 — os._exit(217), NO
+  # emergency checkpoint — so the resume must fall back to step_8 and
+  # RE-EXECUTE steps 9-11. That re-execution is exactly what the
+  # restart-aware goodput ledger charges as lost steps: the durable
+  # fault_injected record (fsync'd before _exit) is the only surviving
+  # evidence of the pre-death step frontier.
+  echo "== resilience smoke: hard death at step 11 =="
+  "${run_env[@]}" TPU_FAULT_INJECT=die-at-step:11 \
+    "${args[@]}" > "$dir/die.log" 2>&1
+  rc=$?
+  if [ "$rc" -ne 217 ]; then
+    echo "FAIL: fault-injected run exited $rc (want 217)"
+    tail -20 "$dir/die.log"; exit 1
+  fi
+  if [ -d "$dir/ckpt/step_11" ]; then
+    echo "FAIL: hard death must NOT leave a step_11 checkpoint"; exit 1
+  fi
+  if ! grep -q '"event": "fault_injected"' "$dir/ckpt/events.jsonl"; then
+    echo "FAIL: no durable fault_injected record (the step frontier is lost)"
+    exit 1
+  fi
+  "${emit[@]}" gang_restart exit_code=217 restart=2 || exit 1
+  echo "== resilience smoke: resume to step 12 =="
+  "${run_env[@]}" "${args[@]}" --num-steps 20 --stop-at-step 12 \
+    > "$dir/resume2.log" 2>&1
+  rc=$?
+  if [ "$rc" -ne 0 ]; then
+    echo "FAIL: second resume exited $rc"; tail -20 "$dir/resume2.log"; exit 1
+  fi
+  if [ ! -d "$dir/ckpt/step_12" ]; then
+    echo "FAIL: second resume did not reach global step 12"
+    ls "$dir/ckpt"; exit 1
+  fi
+  "${emit[@]}" job_succeeded || exit 1
+  # Merge controller + worker logs into the job timeline and render the
+  # federated goodput series — the same code path the operator's
+  # /metrics uses (telemetry/collector.py goodput_ledger).
+  echo "== resilience smoke: merged timeline + goodput ledger =="
+  "${run_env[@]}" python -m mpi_operator_tpu.telemetry.collector merge \
+    --job smoke --controller "$dir/controller.jsonl" \
+    --worker "worker-0=$dir/ckpt/events.jsonl" \
+    --out "$dir/timeline.jsonl" --metrics-out "$dir/federated.prom" \
+    > "$dir/merge.json" || { echo "FAIL: timeline merge"; exit 1; }
+  if [ ! -s "$dir/timeline.jsonl" ]; then
+    echo "FAIL: no merged timeline.jsonl"; exit 1
+  fi
+  # ts-order interleave: the worker's drain records must land BEFORE the
+  # controller's first gang_restart in the merged file (the controller
+  # only learns of the exit after the worker drained)
+  drain_line=$(grep -n '"event": "preemption_drain"' "$dir/timeline.jsonl" | head -1 | cut -d: -f1)
+  ckpt_line=$(grep -n '"event": "emergency_checkpoint"' "$dir/timeline.jsonl" | head -1 | cut -d: -f1)
+  restart_line=$(grep -n '"event": "gang_restart"' "$dir/timeline.jsonl" | head -1 | cut -d: -f1)
+  if [ -z "$drain_line" ] || [ -z "$ckpt_line" ] || [ -z "$restart_line" ]; then
+    echo "FAIL: merged timeline is missing drain/checkpoint/restart records"
+    cat "$dir/timeline.jsonl"; exit 1
+  fi
+  if [ "$drain_line" -ge "$restart_line" ] || [ "$ckpt_line" -ge "$restart_line" ]; then
+    echo "FAIL: timeline not in ts order (drain=$drain_line ckpt=$ckpt_line restart=$restart_line)"
+    cat "$dir/timeline.jsonl"; exit 1
+  fi
+  # ledger arithmetic, checkable by hand from the timeline: the hard
+  # death at step 11 forced a resume from step_8 — steps 9-11 re-ran, so
+  # lost=3; the run finished at step 12, so useful=12 and
+  # goodput = 12/(12+3) = 0.8. The clean drain (restore step == drain
+  # step) contributes NOTHING — that's the point of the ledger.
+  if ! grep -Eq 'tpu_job_steps_lost_total\{job="smoke"\} 3$' "$dir/federated.prom"; then
+    echo "FAIL: federated steps_lost != 3"; cat "$dir/federated.prom"; exit 1
+  fi
+  if ! grep -Eq 'tpu_job_goodput\{job="smoke"\} 0\.8$' "$dir/federated.prom"; then
+    echo "FAIL: federated goodput != 0.8"; cat "$dir/federated.prom"; exit 1
+  fi
+  # the postmortem CLI must render the timeline (exit 0) and refuse an
+  # empty one (nonzero — the "did the run leave a usable postmortem"
+  # one-liner)
+  "${run_env[@]}" python -m mpi_operator_tpu.postmortem "$dir/timeline.jsonl" \
+    > "$dir/postmortem.txt" \
+    || { echo "FAIL: postmortem CLI on a real timeline"; exit 1; }
+  : > "$dir/empty.jsonl"
+  if "${run_env[@]}" python -m mpi_operator_tpu.postmortem "$dir/empty.jsonl" \
+      > /dev/null 2>&1; then
+    echo "FAIL: postmortem CLI must exit nonzero on an empty timeline"
+    exit 1
+  fi
+  echo "resilience smoke: OK (215 -> step_5 -> resume 8 -> 217 -> resume 12; timeline + goodput 0.8, lost 3)"
   exit 0
 fi
 

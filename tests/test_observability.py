@@ -1,0 +1,418 @@
+"""Job-level observability tests (telemetry/collector.py + postmortem).
+
+The contracts a postmortem actually leans on:
+
+- **Timeline merge under clock skew**: per-host offsets (anchored once
+  per boot at bootstrap) shift every worker record onto the
+  controller's clock, so a ±5s skew between hosts cannot reorder cause
+  and effect in the merged timeline. Raw timestamps are preserved.
+- **Goodput ledger**: a clean drain (emergency checkpoint at the drain
+  step) loses NOTHING; a hard death re-executes the steps past the last
+  durable checkpoint and the ledger charges exactly those.
+- **Metrics federation**: counters sum, throughput gauges sum, level
+  gauges max, histograms merge bucket-wise — and a pod the scraper
+  cannot reach is VISIBLE (up 0, failures counted), not silently
+  absent. Exercised end to end: a real TPUJobController reconciling
+  through the wire-level fake kube API server, scraping real worker
+  /metrics listeners, re-exported through the controller's own
+  MetricsServer.
+- **Postmortem CLI**: renders a lifecycle report from timeline.jsonl,
+  exits nonzero when the timeline is empty or unparseable.
+"""
+import json
+import os
+import sys
+import threading
+import time
+import urllib.request
+
+import pytest
+
+from mpi_operator_tpu.telemetry import WorkerTelemetry
+from mpi_operator_tpu.telemetry.collector import (
+    ClockSync,
+    JobObservatory,
+    MetricsFederation,
+    goodput_ledger,
+    merge_timeline,
+    parse_prometheus,
+)
+from mpi_operator_tpu.telemetry.collector import main as collector_main
+from mpi_operator_tpu import postmortem
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from fake_kube_apiserver import FakeKubeAPIServer  # noqa: E402
+
+
+# ---------------------------------------------------------------------------
+# clock-offset correction + timeline merge
+# ---------------------------------------------------------------------------
+
+def _rec(ts, event, **f):
+    return {"ts": ts, "event": event, **f}
+
+
+def test_merge_timeline_corrects_five_second_skew(tmp_path):
+    """Two synthetic hosts, one +5s fast and one -5s slow against the
+    controller clock. The TRUE order is interleaved; raw timestamps
+    would garble it; per-host offsets must restore it exactly."""
+    # true controller-clock times: a@100 (fast host), c@101 (controller),
+    # b@102 (slow host), r@103 (controller)
+    controller = [_rec(101.0, "gang_restart", restart=1),
+                  _rec(103.0, "pods_ready")]
+    fast = [_rec(105.0, "preemption_drain", step=5)]    # clock reads +5
+    slow = [_rec(97.0, "emergency_checkpoint", step=5)]  # clock reads -5
+    out = str(tmp_path / "timeline.jsonl")
+    merged = merge_timeline(
+        [(None, controller), ("fast:9100", fast), ("slow:9100", slow)],
+        offsets={"fast:9100": -5.0, "slow:9100": +5.0},
+        out_path=out)
+    assert [r["event"] for r in merged] == [
+        "preemption_drain", "gang_restart", "emergency_checkpoint",
+        "pods_ready"]
+    ts = [r["ts"] for r in merged]
+    assert ts == sorted(ts) == [100.0, 101.0, 102.0, 103.0]
+    # corrected records keep the evidence: raw ts + applied offset + host
+    drain = merged[0]
+    assert drain["ts_raw"] == 105.0 and drain["clock_offset"] == -5.0
+    assert drain["host"] == "fast:9100"
+    assert merged[1]["host"] == "controller"
+    # the on-disk timeline is the same records, one JSON object per line
+    with open(out) as fh:
+        on_disk = [json.loads(line) for line in fh]
+    assert on_disk == merged
+
+
+def test_clock_sync_pins_offset_per_boot():
+    cs = ClockSync()
+    cs.note("h:9100", local_now=100.0, remote_now=105.0, boot_id="b1")
+    assert cs.offset("h:9100") == -5.0
+    # later scrapes of the SAME boot must not re-pin (network jitter in
+    # the later samples would smear the correction)
+    cs.note("h:9100", local_now=200.0, remote_now=209.0, boot_id="b1")
+    assert cs.offset("h:9100") == -5.0
+    # a new boot (pod restart) re-anchors
+    cs.note("h:9100", local_now=300.0, remote_now=298.0, boot_id="b2")
+    assert cs.offset("h:9100") == 2.0
+    assert cs.offset("unknown") == 0.0
+
+
+# ---------------------------------------------------------------------------
+# the goodput ledger
+# ---------------------------------------------------------------------------
+
+def test_ledger_clean_drain_loses_nothing():
+    records = [
+        _rec(1.0, "preemption_drain", step=5),
+        _rec(1.1, "emergency_checkpoint", step=5),
+        _rec(2.0, "gang_restart", exit_code=215, restart=1),
+        _rec(3.0, "checkpoint_restore", step=5),     # restore == frontier
+        _rec(4.0, "run_complete", step=8),
+    ]
+    led = goodput_ledger(records)
+    assert led["lost_steps"] == 0
+    assert led["useful_steps"] == 8
+    assert led["goodput"] == 1.0
+    assert led["restarts"] == 1
+
+
+def test_ledger_hard_death_charges_reexecuted_steps():
+    """The tier1 --resilience shape: drain at 5 (lossless), finish at 8,
+    hard death at 11 with last checkpoint 8, finish at 12 →
+    re-executed 9-11 = 3 lost, 12 useful, goodput 0.8."""
+    records = [
+        _rec(1.0, "preemption_drain", step=5),
+        _rec(1.1, "emergency_checkpoint", step=5),
+        _rec(2.0, "gang_restart", exit_code=215, restart=1),
+        _rec(3.0, "checkpoint_restore", step=5),
+        _rec(4.0, "run_complete", step=8),
+        _rec(5.0, "checkpoint_restore", step=8),
+        _rec(6.0, "fault_injected", fault="die", step=11),
+        _rec(7.0, "gang_restart", exit_code=217, restart=2),
+        _rec(8.0, "checkpoint_restore", step=8),     # 9-11 re-run
+        _rec(9.0, "run_complete", step=12),
+    ]
+    led = goodput_ledger(records)
+    assert led["lost_steps"] == 3
+    assert led["useful_steps"] == 12
+    assert led["goodput"] == pytest.approx(0.8)
+    assert led["restarts"] == 2
+
+
+def test_ledger_rollback_charges_rewound_steps():
+    records = [
+        _rec(1.0, "run_complete", step=4),
+        _rec(2.0, "divergence_rollback", from_step=7, to_step=4),
+        _rec(3.0, "run_complete", step=9),
+    ]
+    led = goodput_ledger(records)
+    assert led["lost_steps"] == 3
+    assert led["useful_steps"] == 9
+    assert led["rollbacks"] == 1
+
+
+def test_ledger_empty_is_perfect():
+    led = goodput_ledger([])
+    assert led["goodput"] == 1.0
+    assert led["lost_steps"] == 0
+
+
+# ---------------------------------------------------------------------------
+# federation aggregation (pure)
+# ---------------------------------------------------------------------------
+
+POD0 = """\
+# HELP tpu_worker_steps_total train steps executed
+# TYPE tpu_worker_steps_total counter
+tpu_worker_steps_total 100
+# TYPE tpu_worker_step gauge
+tpu_worker_step 7
+# TYPE tpu_worker_tokens_per_sec gauge
+tpu_worker_tokens_per_sec 1000.5
+# TYPE tpu_worker_step_seconds histogram
+tpu_worker_step_seconds_bucket{le="0.1"} 3
+tpu_worker_step_seconds_bucket{le="+Inf"} 5
+tpu_worker_step_seconds_sum 0.4
+tpu_worker_step_seconds_count 5
+tpu_operator_syncs_total 9
+"""
+
+POD1 = """\
+# TYPE tpu_worker_steps_total counter
+tpu_worker_steps_total 40
+# TYPE tpu_worker_step gauge
+tpu_worker_step 9
+# TYPE tpu_worker_tokens_per_sec gauge
+tpu_worker_tokens_per_sec 999.5
+# TYPE tpu_worker_step_seconds histogram
+tpu_worker_step_seconds_bucket{le="0.1"} 1
+tpu_worker_step_seconds_bucket{le="+Inf"} 2
+tpu_worker_step_seconds_sum 0.3
+tpu_worker_step_seconds_count 2
+"""
+
+
+def test_parse_prometheus_labels_and_types():
+    samples, types = parse_prometheus(
+        '# TYPE m counter\nm{a="x\\"y",b="z"} 4\nnot a sample\n')
+    assert samples == [("m", {"a": 'x"y', "b": "z"}, 4.0)]
+    assert types["m"] == "counter"
+
+
+def test_federation_sums_maxes_and_merges():
+    fed = MetricsFederation("trainjob", clock=lambda: 50.0)
+    fed.ingest(0, POD0)
+    fed.ingest(1, POD1)
+    text = "\n".join(fed.render_lines())
+    # counters sum across the gang; level gauges take the max;
+    # throughput (_per_sec) gauges sum; histograms merge bucket-wise
+    assert 'tpu_job_steps_total{job="trainjob"} 140' in text
+    assert 'tpu_job_step{job="trainjob"} 9' in text
+    assert 'tpu_job_tokens_per_sec{job="trainjob"} 2000' in text
+    assert 'tpu_job_step_seconds_bucket{job="trainjob",le="0.1"} 4' in text
+    assert 'tpu_job_step_seconds_count{job="trainjob"} 7' in text
+    # operator series do NOT re-federate
+    assert "tpu_job_syncs_total" not in text
+    # both pods healthy
+    assert 'tpu_job_up{job="trainjob",replica_rank="0"} 1' in text
+    assert 'tpu_job_up{job="trainjob",replica_rank="1"} 1' in text
+    assert fed.observed_step() == 9
+
+
+def test_federation_failed_scrape_is_visible():
+    clock = [100.0]
+    fed = MetricsFederation("trainjob", clock=lambda: clock[0])
+    fed.ingest(0, POD0)
+    clock[0] = 130.0
+    fed.scrape_failed(0)
+    text = "\n".join(fed.render_lines())
+    assert 'tpu_job_up{job="trainjob",replica_rank="0"} 0' in text
+    assert ('tpu_job_scrape_failures_total{job="trainjob",'
+            'replica_rank="0"} 1' in text)
+    assert ('tpu_job_scrape_staleness_seconds{job="trainjob",'
+            'replica_rank="0"} 30' in text)
+
+
+# ---------------------------------------------------------------------------
+# federation end to end: controller over the wire-level fake kube API
+# server, scraping real worker /metrics listeners
+# ---------------------------------------------------------------------------
+
+def _http(url: str) -> str:
+    with urllib.request.urlopen(url, timeout=5) as resp:
+        return resp.read().decode()
+
+
+def test_federation_over_fake_kube_apiserver(tmp_path):
+    from mpi_operator_tpu.api.types import new_tpu_job
+    from mpi_operator_tpu.cluster.kubeclient import KubeAPIServer, KubeConfig
+    from mpi_operator_tpu.controller import (ControllerConfig,
+                                             TPUJobController)
+    from mpi_operator_tpu.controller.metrics import MetricsServer
+
+    fake = FakeKubeAPIServer().start()
+    kube = KubeAPIServer(KubeConfig(server=fake.url),
+                         request_timeout=5.0, watch_timeout_seconds=2)
+    stop = threading.Event()
+    controller = None
+    metrics_srv = None
+    workers = []
+    try:
+        controller = TPUJobController(
+            kube, config=ControllerConfig(worker_metrics_port=1,
+                                          events_dir=str(tmp_path),
+                                          scrape_interval=0.0))
+        assert controller.observatory is not None   # config switched it on
+        controller.run(threadiness=1, stop_event=stop)
+        job = new_tpu_job("trainjob", tpus=8)
+        job.spec.template.main_container().image = "tpu-bench:latest"
+        kube.create(job)
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            if fake.get_object("statefulsets", "default", "trainjob-worker"):
+                break
+            time.sleep(0.02)
+        else:
+            raise TimeoutError("controller never reconciled the job")
+        # the sync recorded job_created on the controller's own event log
+        obs = controller.observatory
+        assert obs.view("trainjob")["created"]
+
+        # two real worker /metrics listeners stand in for the pods (the
+        # fake API server hosts no kubelet, so the pod DNS names the
+        # controller would scrape in-cluster don't resolve here)
+        targets = {}
+        for rank, step in ((0, 7), (1, 9)):
+            wt = WorkerTelemetry()
+            wt.train.update_window(tokens_per_sec=1000.0, step=step)
+            srv = wt.serve(port=0, host="127.0.0.1")
+            workers.append((wt, srv))
+            targets[rank] = f"http://127.0.0.1:{srv.port}"
+        obs.observe("trainjob", targets, force=True)
+
+        # the federated series ride the controller's OWN /metrics scrape
+        metrics_srv = MetricsServer(controller, port=0, host="127.0.0.1")
+        text = _http(f"http://127.0.0.1:{metrics_srv.port}/metrics")
+        assert "tpu_operator_syncs_total" in text
+        assert 'tpu_job_step{job="trainjob"} 9' in text
+        assert 'tpu_job_tokens_per_sec{job="trainjob"} 2000' in text
+        assert 'tpu_job_up{job="trainjob",replica_rank="0"} 1' in text
+        assert 'tpu_job_up{job="trainjob",replica_rank="1"} 1' in text
+        assert 'tpu_job_goodput{job="trainjob"} 1' in text
+
+        # kill pod 0 and re-observe: the dead pod must be VISIBLE
+        workers[0][1].close()
+        obs.observe("trainjob", targets, force=True)
+        text = _http(f"http://127.0.0.1:{metrics_srv.port}/metrics")
+        assert 'tpu_job_up{job="trainjob",replica_rank="0"} 0' in text
+        assert ('tpu_job_scrape_failures_total{job="trainjob",'
+                'replica_rank="0"} 1' in text)
+        assert 'tpu_job_up{job="trainjob",replica_rank="1"} 1' in text
+    finally:
+        stop.set()
+        controller and controller.queue.shut_down()
+        for wt, srv in workers:
+            srv.close()
+            wt.close()
+        metrics_srv and metrics_srv.close()
+        kube.stop()
+        fake.stop()
+
+
+# ---------------------------------------------------------------------------
+# observatory: /events scrape -> clock anchor -> merged timeline
+# ---------------------------------------------------------------------------
+
+def test_observatory_scrapes_events_and_writes_timeline(tmp_path):
+    from mpi_operator_tpu.telemetry import EventLog
+
+    worker_log = EventLog(str(tmp_path / "worker" / "events.jsonl"))
+    worker_log.emit("clock_anchor", boot_id="boot1", process_id=0)
+    worker_log.emit("preemption_drain", step=5)
+    wt = WorkerTelemetry(events=worker_log)
+    wt.train.update_window(step=5)
+    srv = wt.serve(port=0, host="127.0.0.1")
+    try:
+        obs = JobObservatory(events_dir=str(tmp_path / "op"),
+                             scrape_interval=0.0)
+        obs.note_created("trainjob", namespace="default", tpus=8)
+        obs.observe("trainjob", {0: f"http://127.0.0.1:{srv.port}"},
+                    force=True)
+        # the /events payload's server-side "now" + the clock_anchor's
+        # boot_id pin this host's offset (≈0 here — same machine); every
+        # merged worker record carries the correction evidence
+        merged = obs.merged_records("trainjob")
+        events = [r["event"] for r in merged]
+        assert "preemption_drain" in events and "job_created" in events
+        drain = merged[events.index("preemption_drain")]
+        assert drain["host"].startswith("127.0.0.1:")
+        assert drain["ts"] == pytest.approx(
+            drain["ts_raw"] + drain["clock_offset"])
+        assert abs(drain["clock_offset"]) < 5.0
+        # scraping a live step also emits first_step_observed exactly once
+        obs.observe("trainjob", {0: f"http://127.0.0.1:{srv.port}"},
+                    force=True)
+        firsts = [r for r in obs.view("trainjob")["controller_records"]
+                  if r["event"] == "first_step_observed"]
+        assert len(firsts) == 1
+        # terminal note writes <events_dir>/<job>/timeline.jsonl
+        obs.note_terminal("trainjob", succeeded=True)
+        out = os.path.join(str(tmp_path / "op"), "trainjob",
+                           "timeline.jsonl")
+        with open(out) as fh:
+            lines = [json.loads(line) for line in fh]
+        ts = [r["ts"] for r in lines]
+        assert ts == sorted(ts) and len(lines) >= 4
+        obs.close()
+    finally:
+        srv.close()
+        wt.close()
+
+
+# ---------------------------------------------------------------------------
+# collector CLI round-trip + postmortem CLI
+# ---------------------------------------------------------------------------
+
+def test_collector_cli_emit_merge_and_postmortem(tmp_path, capsys):
+    ctl = str(tmp_path / "controller.jsonl")
+    wrk = str(tmp_path / "events.jsonl")
+    for argv in (
+        ["emit", "--log", ctl, "--job", "j", "job_created", "tpus=8"],
+        ["emit", "--log", wrk, "--job", "j", "emergency_checkpoint",
+         "step=5"],
+        ["emit", "--log", wrk, "--job", "j", "fault_injected", "step=11"],
+        ["emit", "--log", ctl, "--job", "j", "gang_restart",
+         "exit_code=217", "restart=1"],
+        ["emit", "--log", wrk, "--job", "j", "checkpoint_restore",
+         "step=8"],
+        ["emit", "--log", wrk, "--job", "j", "run_complete", "step=12"],
+    ):
+        assert collector_main(argv) == 0
+    out = str(tmp_path / "timeline.jsonl")
+    prom = str(tmp_path / "federated.prom")
+    assert collector_main(["merge", "--job", "j", "--controller", ctl,
+                           "--worker", f"w0={wrk}", "--out", out,
+                           "--metrics-out", prom]) == 0
+    summary = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert summary["lost_steps"] == 3 and summary["useful_steps"] == 12
+    with open(prom) as fh:
+        text = fh.read()
+    assert 'tpu_job_steps_lost_total{job="j"} 3' in text
+    assert 'tpu_job_goodput{job="j"} 0.8' in text
+
+    # postmortem renders it and the ledger numbers agree
+    assert postmortem.main([out]) == 0
+    report = capsys.readouterr().out
+    assert "gang_restart" in report and "goodput" in report
+    assert "0.8000" in report
+
+    # empty and unparseable timelines are a nonzero exit
+    empty = tmp_path / "empty.jsonl"
+    empty.write_text("")
+    assert postmortem.main([str(empty)]) == 2
+    garbage = tmp_path / "garbage.jsonl"
+    garbage.write_text("not json\nstill not json\n")
+    assert postmortem.main([str(garbage)]) == 2
+    assert postmortem.main(["--json", out]) == 0
+    js = json.loads(capsys.readouterr().out)
+    assert js["ledger"]["lost_steps"] == 3
