@@ -532,6 +532,29 @@ def main() -> None:
         return {k.replace("serving_", "serving_paged_", 1): v
                 for k, v in m.items()}
 
+    def serving_disagg_metrics():
+        # disaggregated prefill/decode A/B at equal chip count: the same
+        # long-prompt-heavy greedy trace through a colocated paged
+        # engine and the two-pool DisaggEngine, TTFT/TPOT p50/p99 for
+        # both plus kv_handoff p50/p99 and the token-identity + per-pool
+        # compile-pin gates. Keys already carry the disagg_/coloc_
+        # prefixes — no rewrite needed.
+        from mpi_operator_tpu.examples.serve_benchmark import (
+            run_disagg_benchmark)
+        return retry_infra_once(lambda: run_disagg_benchmark(
+            size="test" if args.smoke else None,
+            slots=4 if args.smoke else 8,
+            num_requests=8 if args.smoke else 24,
+            # prompt-heavy trace: prefill interference on the decode
+            # stream is what disaggregation removes, so the grid skews
+            # long relative to the serving leg's
+            prompt_grid=(8, 16, 24) if args.smoke else (64, 256, 384),
+            new_grid=(8, 16) if args.smoke else (16, 32),
+            chunk_buckets=(8, 16) if args.smoke else (64, 128),
+            dtype_name=args.dtype,
+            page_size=16 if args.smoke else 64,
+            log=lambda s: print(s, file=sys.stderr)))
+
     if args.workload == "serving":
         line = {
             "metric": "serving_tokens_per_sec",
@@ -547,6 +570,9 @@ def main() -> None:
         pm = serving_paged_metrics()
         line.update(pm)
         emit_leg("serving_paged", pm)
+        dm = serving_disagg_metrics()
+        line.update(dm)
+        emit_leg("serving_disagg", dm)
         finish(line)
         return
     if args.workload == "generate":

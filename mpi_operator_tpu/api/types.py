@@ -144,6 +144,22 @@ class PodTemplateSpec:
 # ---------------------------------------------------------------------------
 
 @dataclass
+class ServingSpec:
+    """Disaggregated-serving role pools (serve/engine.py DisaggEngine).
+
+    The reference's core trick is materializing heterogeneous pod roles
+    (launcher vs worker) from ONE job spec; this extends the same move to
+    the serving plane: the worker gang splits into a PREFILL pool and a
+    DECODE pool, each its own StatefulSet with `TPU_SERVE_ROLE` and peer
+    addresses in env (covered by the template hash, so role/count changes
+    are an ordinary level-triggered gang restart). The pool sizes must sum
+    to the worker replica count the sizing mode derives — serving
+    re-partitions the gang, it does not resize it."""
+    prefill_replicas: int = 1
+    decode_replicas: int = 1
+
+
+@dataclass
 class TPUJobSpec:
     """Exactly one of (tpus, processing_units, replicas) must be set — the
     reference enforces this with an openAPIV3 oneOf (deploy/0-crd.yaml:16-99);
@@ -231,6 +247,13 @@ class TPUJobSpec:
     # the rest get a "Packed" condition naming the leader. None (default)
     # keeps the ordinary one-job-one-gang behavior.
     pack_group: Optional[str] = None
+
+    # Disaggregated-serving role pools (ServingSpec): when set, the worker
+    # gang is partitioned into `<job>-prefill` / `<job>-decode`
+    # StatefulSets instead of the flat worker group. Single-slice only;
+    # mutually exclusive with elastic and pack_group (each rewrites the
+    # worker topology its own way).
+    serving: Optional[ServingSpec] = None
 
 
 # ---------------------------------------------------------------------------
@@ -372,7 +395,8 @@ __all__ = [
     "V5E_VALID_SLICE_CHIPS",
     "OwnerReference", "ObjectMeta", "is_controlled_by",
     "Container", "PodTemplateSpec",
-    "TPUJobSpec", "JobCondition", "ReplicaStatus", "TPUJobStatus", "TPUJob",
+    "ServingSpec", "TPUJobSpec", "JobCondition", "ReplicaStatus",
+    "TPUJobStatus", "TPUJob",
     "COND_CREATED", "COND_RUNNING", "COND_RESTARTING", "COND_SUCCEEDED",
     "COND_FAILED", "COND_DEGRADED",
     "LAUNCHER_ACTIVE", "LAUNCHER_SUCCEEDED", "LAUNCHER_FAILED",

@@ -50,6 +50,22 @@ def _valid_tpu_count(n: int) -> bool:
     return n in V5E_VALID_SLICE_CHIPS
 
 
+def _derived_workers(spec: TPUJobSpec):
+    """Worker count when the spec alone determines it (replicas mode, or
+    Mode A with an explicit per-worker count); None when only the
+    operator's flag default can resolve it — those cases stay controller
+    backstops that converge to Failed/InvalidTPUJobSpec."""
+    if spec.replicas is not None and spec.replicas >= 1:
+        return spec.replicas
+    total = spec.tpus if spec.tpus is not None else spec.processing_units
+    per = spec.tpus_per_worker if spec.tpus is not None else \
+        spec.processing_units_per_worker
+    if total is not None and per and per >= 1:
+        return 1 if total < per else (
+            total // per if total % per == 0 else None)
+    return None
+
+
 def validate_spec(spec: TPUJobSpec,
                   default_resource_type: str = RESOURCE_TPU) -> None:
     """Raises ValidationError listing every violation (the reference's schema
@@ -169,17 +185,7 @@ def validate_spec(spec: TPUJobSpec,
         # itself determines the count (replicas mode, or Mode A with an
         # explicit per-worker); the controller keeps a backstop for the
         # flag-default case it alone can see.
-        workers = None
-        if spec.replicas is not None and spec.replicas >= 1:
-            workers = spec.replicas
-        else:
-            total = spec.tpus if spec.tpus is not None else \
-                spec.processing_units
-            per = spec.tpus_per_worker if spec.tpus is not None else \
-                spec.processing_units_per_worker
-            if total is not None and per and per >= 1:
-                workers = 1 if total < per else (
-                    total // per if total % per == 0 else None)
+        workers = _derived_workers(spec)
         if workers is not None and workers % spec.num_slices:
             errs.append(
                 f"the spec derives {workers} worker(s), which does not "
@@ -243,6 +249,43 @@ def validate_spec(spec: TPUJobSpec,
                 f"spec.minTpus={spec.min_tpus} exceeds spec.tpus="
                 f"{spec.tpus}"
             )
+
+    if spec.serving is not None:
+        # disaggregated-serving role pools (serve/engine.py DisaggEngine):
+        # the pools re-partition the worker gang the sizing mode derives —
+        # they never resize it, so the counts must agree exactly
+        sv = spec.serving
+        if sv.prefill_replicas < 1:
+            errs.append(
+                f"spec.serving.prefillReplicas must be >= 1, got "
+                f"{sv.prefill_replicas}")
+        if sv.decode_replicas < 1:
+            errs.append(
+                f"spec.serving.decodeReplicas must be >= 1, got "
+                f"{sv.decode_replicas}")
+        if spec.num_slices > 1:
+            errs.append(
+                f"spec.serving does not support numSlices="
+                f"{spec.num_slices} (> 1); role pools partition a "
+                f"single-slice gang")
+        if spec.elastic:
+            errs.append(
+                "spec.serving is incompatible with spec.elastic (an "
+                "elastic shrink cannot preserve the fixed pool split)")
+        if spec.pack_group:
+            errs.append(
+                "spec.serving is incompatible with spec.packGroup (both "
+                "rewrite the worker topology)")
+        workers = _derived_workers(spec)
+        want = sv.prefill_replicas + sv.decode_replicas
+        if (workers is not None and spec.num_slices == 1
+                and sv.prefill_replicas >= 1 and sv.decode_replicas >= 1
+                and workers != want):
+            errs.append(
+                f"spec.serving pools need prefillReplicas + "
+                f"decodeReplicas == worker replicas: {want} != {workers} "
+                f"(the sizing mode derives the worker count; serving only "
+                f"partitions it)")
 
     if spec.backoff_limit is not None and spec.backoff_limit < 0:
         errs.append(f"spec.backoffLimit must be >= 0, got {spec.backoff_limit}")

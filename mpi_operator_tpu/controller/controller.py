@@ -59,7 +59,7 @@ from ..cluster.resources import (
     StatefulSetSpec,
 )
 from ..cluster.workqueue import RateLimitingQueue, meta_namespace_key, split_key
-from .packing import COND_PACKED, PackPlan, plan_packing
+from .packing import COND_PACKED, PackPlan, plan_packing, slices_used
 
 logger = logging.getLogger("tpujob-controller")
 
@@ -71,6 +71,20 @@ CONFIG_VOLUME_NAME = "tpu-job-config"
 CONFIG_MOUNT_PATH = "/etc/tpu"          # ref configMountPath "/etc/mpi" (:62)
 COORDINATOR_PORT = 8476                 # jax.distributed default port
 LABEL_GROUP = "tpu_job_name"            # ref "mpi_job_name" label (:1007-1012)
+
+# Disaggregated serving (spec.serving, serve/engine.py DisaggEngine): the
+# worker gang splits into two role pools, each its own StatefulSet. The
+# role + peer addresses ride pod env — covered by the template hash, so a
+# pool-split change is an ordinary level-triggered gang restart.
+PREFILL_SUFFIX = "-prefill"
+DECODE_SUFFIX = "-decode"
+SERVE_ROLES = ("prefill", "decode")     # pool index -> role name
+LABEL_SERVE_ROLE = "tpu_serve_role"     # pool-distinguishing pod label
+SERVE_ENV_ROLE = "TPU_SERVE_ROLE"
+SERVE_ENV_PREFILL_HOSTS = "TPU_SERVE_PREFILL_HOSTS"
+SERVE_ENV_DECODE_HOSTS = "TPU_SERVE_DECODE_HOSTS"
+SERVE_ENV_KV_PORT = "TPU_SERVE_KV_PORT"
+KV_TRANSFER_PORT = 8477                 # page-handoff listener (D2D proxy)
 
 # Kubernetes node-selector keys for TPU slices (GKE conventions).
 NS_ACCELERATOR = "cloud.google.com/gke-tpu-accelerator"
@@ -292,12 +306,26 @@ class AllocationResult:
     resource_type: str
     slots_per_worker: int
     num_slices: int = 1
+    # disaggregated serving (spec.serving): per-pool worker counts,
+    # aligned with worker_group_names order (prefill, decode). None keeps
+    # the uniform slice-group partitioning.
+    serving_pools: Optional[Tuple[int, ...]] = None
 
     @property
     def workers_per_slice(self) -> int:
         if self.num_slices <= 1:
             return self.worker_replicas
         return self.worker_replicas // self.num_slices
+
+    def group_sizes(self) -> List[int]:
+        """Replica count per worker group, aligned with
+        worker_group_names. Uniform per slice normally; the serving pool
+        split otherwise. Zeros on scale-down (worker_replicas == 0)."""
+        if self.serving_pools is not None:
+            return [n if self.worker_replicas > 0 else 0
+                    for n in self.serving_pools]
+        per = self.workers_per_slice if self.worker_replicas > 0 else 0
+        return [per] * self.num_slices
 
 
 class TPUJobController:
@@ -484,6 +512,15 @@ class TPUJobController:
             self.sync_counters.observe_sync(time.monotonic() - t0)
             self.queue.done(key)
         return True
+
+    def slices_in_use(self) -> int:
+        """Pack-aware slice quota usage over the informer cache: physical
+        slices claimed by live jobs, counting each packed gang ONCE (its
+        leader) instead of once per member. This is the number a cluster
+        quota check must compare against capacity — the naive per-job sum
+        overcharges by k-1 slices per packed gang. Exported as the
+        tpu_operator_slices_in_use gauge (controller/metrics.py)."""
+        return slices_used(self.job_lister.list())
 
     def workers_alive(self) -> bool:
         """Liveness signal for /healthz: healthy while starting (run() not
@@ -1025,6 +1062,24 @@ class TPUJobController:
                 f"worker replicas ({workers}) must divide evenly into "
                 f"numSlices ({num_slices}) worker groups"
             )
+        serving_pools = None
+        if spec.serving is not None:
+            # backstop for what admission can't derive (flag-default
+            # per-worker counts); same ValueError contract as the
+            # divisibility rules above — converges to InvalidTPUJobSpec
+            if num_slices > 1:
+                raise ValueError(
+                    f"spec.serving does not support numSlices="
+                    f"{num_slices} (> 1)")
+            want = (spec.serving.prefill_replicas
+                    + spec.serving.decode_replicas)
+            if workers > 0 and workers != want:
+                raise ValueError(
+                    f"serving pools need prefillReplicas + "
+                    f"decodeReplicas == worker replicas: {want} != "
+                    f"{workers}")
+            serving_pools = (spec.serving.prefill_replicas,
+                             spec.serving.decode_replicas)
         if done:
             workers = 0              # scale-down after completion (ref :594-596)
         return AllocationResult(
@@ -1033,6 +1088,7 @@ class TPUJobController:
             resource_type=resource_type,
             slots_per_worker=slots,
             num_slices=num_slices,
+            serving_pools=serving_pools,
         )
 
     # ------------------------------------------------------------------
@@ -1193,11 +1249,11 @@ class TPUJobController:
         changed this sync (template reconciled or a slice group pruned)
         and the gang was restarted onto it."""
         out: List[Optional[StatefulSet]] = []
-        per_group = (alloc.workers_per_slice if alloc.worker_replicas > 0
-                     else 0)
         group_names = self.worker_group_names(job, alloc.num_slices)
+        group_sizes = alloc.group_sizes()       # aligned with group_names
         stale_groups: List[StatefulSet] = []    # need a gang restart
         for slice_id, name in enumerate(group_names):
+            per_group = group_sizes[slice_id]
             existing = self.statefulset_lister.try_get(
                 job.metadata.namespace, name)
             if existing is None:
@@ -1308,6 +1364,11 @@ class TPUJobController:
         single hostfile could not express (SURVEY §7 multi-slice bootstrap;
         the hostfile-as-topology-truth analogue is mpi_job_controller.go:
         857-869)."""
+        if job.spec.serving is not None:
+            # disaggregated serving: the gang is two ROLE pools, not slice
+            # groups — `<job>-prefill` / `<job>-decode` (SERVE_ROLES order)
+            return [job.metadata.name + PREFILL_SUFFIX,
+                    job.metadata.name + DECODE_SUFFIX]
         base = job.metadata.name + WORKER_SUFFIX
         if num_slices <= 1:
             return [base]
@@ -1317,11 +1378,14 @@ class TPUJobController:
         """All worker pod names in GLOBAL RANK ORDER (slice-major): slice k
         worker i has global worker index k*workers_per_slice + i — the
         rank derivation bootstrap.process_info applies from TPU_SLICE_ID +
-        the pod ordinal."""
+        the pod ordinal. Serving role pools enumerate prefill-major (the
+        coordinator is prefill pod 0)."""
         return [
             f"{group}-{i}"
-            for group in self.worker_group_names(job, alloc.num_slices)
-            for i in range(alloc.workers_per_slice)
+            for group, size in zip(
+                self.worker_group_names(job, alloc.num_slices),
+                alloc.group_sizes())
+            for i in range(size)
         ]
 
     def worker_hostnames(self, job: TPUJob, alloc: AllocationResult) -> List[str]:
@@ -1364,6 +1428,12 @@ class TPUJobController:
             "num-slices": str(job.spec.num_slices),
             "workers-per-slice": str(alloc.workers_per_slice),
         }
+        if alloc.serving_pools is not None:
+            # role-pool partitioning, greppable like the hostfile: the
+            # hostnames list above is prefill-major, so these two counts
+            # split it exactly
+            data["serving-prefill-replicas"] = str(alloc.serving_pools[0])
+            data["serving-decode-replicas"] = str(alloc.serving_pools[1])
         return ConfigMap(
             metadata=ObjectMeta(
                 name=job.metadata.name + CONFIG_SUFFIX,
@@ -1478,6 +1548,32 @@ class TPUJobController:
             env["TPU_LAUNCHER"] = "1"
         return env
 
+    def _serving_env(self, job: TPUJob, alloc: AllocationResult,
+                     role: Optional[str] = None) -> dict:
+        """Disaggregated-serving env (spec.serving): BOTH pools (and the
+        launcher, which fronts as the request router) get the full peer
+        address lists, so a prefill worker can push pages to any decode
+        worker and the router can target either pool. Workers additionally
+        get their own role. DNS rides the shared governing Service — pod
+        names are unique across pools, exactly like multi-slice groups."""
+        names = self.worker_group_names(job, alloc.num_slices)
+        sizes = alloc.group_sizes()
+        svc = job.metadata.name + WORKER_SUFFIX
+        ns = job.metadata.namespace
+        hosts = [
+            ",".join(f"{names[i]}-{k}.{svc}.{ns}.svc"
+                     for k in range(sizes[i]))
+            for i in range(len(SERVE_ROLES))
+        ]
+        env = {
+            SERVE_ENV_PREFILL_HOSTS: hosts[0],
+            SERVE_ENV_DECODE_HOSTS: hosts[1],
+            SERVE_ENV_KV_PORT: str(KV_TRANSFER_PORT),
+        }
+        if role is not None:
+            env[SERVE_ENV_ROLE] = role
+        return env
+
     def new_worker(self, job: TPUJob, alloc: AllocationResult,
                    slice_id: int = 0,
                    pack: Optional[PackPlan] = None) -> StatefulSet:
@@ -1498,6 +1594,14 @@ class TPUJobController:
             **self._discovery_env(job, alloc, is_launcher=False),
             **(pack.env() if pack is not None else {}),
         }
+        if alloc.serving_pools is not None:
+            # role identity + peer addresses in env: covered by the
+            # template hash (like pack.env()), so changing the pool split
+            # gang-restarts onto the new partitioning
+            role = SERVE_ROLES[slice_id]
+            container.env.update(self._serving_env(job, alloc, role=role))
+            template.metadata.labels = {
+                **template.metadata.labels, LABEL_SERVE_ROLE: role}
         if alloc.num_slices > 1:
             container.env["TPU_SLICE_ID"] = str(slice_id)
             container.env["MEGASCALE_SLICE_ID"] = str(slice_id)
@@ -1598,7 +1702,7 @@ class TPUJobController:
                 owner_references=[job.controller_owner_reference()],
             ),
             spec=StatefulSetSpec(
-                replicas=alloc.workers_per_slice,
+                replicas=alloc.group_sizes()[slice_id],
                 # ALL slice groups share the base governing Service so
                 # every pod resolves as <pod>.<job>-worker.<ns>.svc —
                 # stable DNS (ref :1079) without per-slice Services
@@ -1662,6 +1766,10 @@ class TPUJobController:
             **self._discovery_env(job, alloc, is_launcher=True),
             **(pack.env() if pack is not None else {}),
         }
+        if alloc.serving_pools is not None:
+            # the launcher is the serving frontend/router: it needs both
+            # pools' addresses but belongs to neither
+            container.env.update(self._serving_env(job, alloc))
         container.volume_mounts = container.volume_mounts + [
             {"name": CONFIG_VOLUME_NAME, "mountPath": CONFIG_MOUNT_PATH}
         ]
@@ -1903,5 +2011,7 @@ __all__ = [
     "TPUJobController", "ControllerConfig", "AllocationResult",
     "EventRecorder", "Event", "ForeignOwnershipError",
     "CONFIG_SUFFIX", "LAUNCHER_SUFFIX", "WORKER_SUFFIX",
+    "PREFILL_SUFFIX", "DECODE_SUFFIX", "SERVE_ROLES",
+    "LABEL_SERVE_ROLE", "KV_TRANSFER_PORT",
     "CONFIG_MOUNT_PATH", "COORDINATOR_PORT", "LABEL_GROUP",
 ]

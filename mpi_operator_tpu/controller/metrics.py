@@ -22,6 +22,9 @@ Exported series (all prefixed ``tpu_operator_``):
   job_restarts           gauge   — sum of status.restart_count over
                                    currently-cached jobs (drops when a job
                                    is deleted — hence gauge, no _total)
+  slices_in_use          gauge   — physical slices claimed by live jobs,
+                                   pack-aware: a packed gang counts its
+                                   slices once (controller/packing.py)
 
 The histogram machinery and text-format helpers come from the worker-side
 telemetry package (telemetry/) — one implementation of buckets, label
@@ -136,6 +139,12 @@ def render_metrics(controller) -> str:
         "# HELP tpu_operator_job_restarts sum of restart counts over live jobs",
         "# TYPE tpu_operator_job_restarts gauge",
         f"tpu_operator_job_restarts {restarts}",
+        # pack-aware quota accounting (controller/packing.py slices_used):
+        # each packed gang counts its slices once, via its leader
+        "# HELP tpu_operator_slices_in_use physical slices claimed by live "
+        "jobs (packed gangs counted once)",
+        "# TYPE tpu_operator_slices_in_use gauge",
+        f"tpu_operator_slices_in_use {controller.slices_in_use()}",
     ]
     # job-level federation (telemetry/collector.py): the observatory's
     # aggregated tpu_job_* series ride the SAME scrape as the operator's

@@ -173,5 +173,25 @@ def plan_packing(job: api.TPUJob,
                     members=tuple(m.metadata.name for m in members))
 
 
+def slices_used(jobs: Sequence[api.TPUJob]) -> int:
+    """Pack-aware slice quota accounting: how many physical slices the
+    given jobs actually claim. A packed gang counts its slices ONCE — the
+    leader owns the pods and the members are fused into the same program,
+    so summing member specs would overcharge the quota by (k-1) slices
+    per gang (exactly the overcount job packing exists to avoid).
+    Terminal jobs hold no slices (their gangs are scaled down or about to
+    be); invalid-spec Failed jobs are terminal by the same condition test
+    plan_packing uses, keeping the two views consistent."""
+    total = 0
+    for job in jobs:
+        if _is_terminal(job):
+            continue
+        plan = plan_packing(job, jobs)
+        if plan is not None and not plan.is_leader(job.metadata.name):
+            continue        # member: the leader's gang already counts
+        total += max(job.spec.num_slices, 1)
+    return total
+
+
 __all__ = ["PACK_ENV_GROUP", "PACK_ENV_JOBS", "PACK_ENV_K", "COND_PACKED",
-           "PackPlan", "pack_key", "plan_packing"]
+           "PackPlan", "pack_key", "plan_packing", "slices_used"]

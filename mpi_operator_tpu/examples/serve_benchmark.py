@@ -323,6 +323,155 @@ def run_serving_benchmark(
     return out
 
 
+def run_disagg_benchmark(
+    size: Optional[str] = None,
+    family: str = "gpt2",
+    slots: int = 8,
+    num_requests: int = 24,
+    prompt_grid: Sequence[int] = (64, 256, 384),
+    new_grid: Sequence[int] = (16, 32),
+    chunk_buckets: Tuple[int, ...] = (64, 128),
+    dtype_name: str = "bfloat16",
+    kv_cache_dtype: Optional[str] = None,
+    decode_kernel: Optional[bool] = None,
+    page_size: int = 64,
+    num_pages: Optional[int] = None,
+    seed: int = 0,
+    log: Callable[[str], None] = print,
+) -> Dict[str, object]:
+    """Disaggregated prefill/decode A/B vs the colocated engine at equal
+    chip count: the same long-prompt-heavy greedy trace (the grid skews
+    long — long prompts are exactly the TTFT/TPOT interference the
+    split removes) replays through a colocated paged ServingEngine and
+    a DisaggEngine built from the SAME params and config, reporting
+    TTFT/TPOT p50/p99 for both, kv_handoff p50/p99, and the per-pool
+    compile pins (prefill pool never compiles step, decode pool never
+    compiles prefill). Greedy-only: temperature 0 is the token-exact
+    parity regime, so the A/B also asserts token identity.
+
+    On CPU smoke the two pools are host devices and the latency split is
+    structural only — token identity + pins are the gate there; the
+    TTFT/TPOT win is measured on real hardware (ROADMAP follow-up)."""
+    import time
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from ..models import create_lm
+    from ..parallel import MeshConfig, make_mesh
+    from ..parallel.sharding import shard_init
+    from ..serve import DisaggEngine, EngineConfig, Request, ServingEngine
+
+    dtype = jnp.bfloat16 if dtype_name == "bfloat16" else jnp.float32
+    if decode_kernel is None:
+        decode_kernel = jax.default_backend() == "tpu"
+    need = max(prompt_grid) + max(new_grid)
+    max_len = need if need <= 128 else -(-need // 128) * 128
+    if max_len % page_size:
+        max_len = -(-max_len // page_size) * page_size
+    name = f"{family}-{size}" if size else family
+    model = create_lm(name, dtype=dtype, kv_cache_dtype=kv_cache_dtype,
+                      decode_kernel=decode_kernel, max_len=max_len)
+    mesh = make_mesh(MeshConfig(dp=jax.device_count()))
+    variables, _ = shard_init(
+        model, mesh, jax.random.PRNGKey(0),
+        jnp.zeros((1, min(prompt_grid)), jnp.int32))
+    params = variables["params"]
+
+    vocab = model.config.vocab_size
+    rs = np.random.RandomState(seed)
+
+    def make_request(i, p, n):
+        return Request(id=i, prompt=rs.randint(0, vocab, (p,)).tolist(),
+                       max_new_tokens=n)
+
+    trace = [make_request(i, int(rs.choice(prompt_grid)),
+                          int(rs.choice(new_grid)))
+             for i in range(num_requests)]
+
+    cfg = EngineConfig(
+        slots=slots, chunk_buckets=tuple(chunk_buckets),
+        decode_kernel=decode_kernel, rng_seed=seed,
+        paged=True, page_size=page_size, num_pages=num_pages)
+    coloc = ServingEngine(model, params, cfg)
+    disagg = DisaggEngine(model, params, cfg)
+
+    warm = [make_request(10_000 + j, p, 2)
+            for j, p in enumerate(sorted(set(int(r) for r in prompt_grid)))]
+
+    def timed(engine):
+        engine.run(warm)
+        engine.reset()
+        t0 = time.perf_counter()
+        results = engine.run(trace)
+        return results, time.perf_counter() - t0
+
+    coloc_results, coloc_wall = timed(coloc)
+    disagg_results, disagg_wall = timed(disagg)
+
+    def latency(results):
+        ttft = _percentiles([r.ttft for r in results.values()])
+        tpot = _percentiles([dt for r in results.values()
+                             for dt in np.diff(r.token_times)])
+        return ttft, tpot
+
+    ms = lambda v: round(v * 1e3, 3) if v is not None else None  # noqa: E731
+    c_ttft, c_tpot = latency(coloc_results)
+    d_ttft, d_tpot = latency(disagg_results)
+    total_new = sum(len(r.tokens) for r in disagg_results.values())
+
+    identical = all(coloc_results[r.id].tokens == disagg_results[r.id].tokens
+                    for r in trace)
+    counts = disagg.compile_counts()
+    pre, dec = counts["prefill_pool"], counts["decode_pool"]
+    pins = (pre["step"] == 0 and pre["prefill"] <= len(chunk_buckets)
+            and dec["prefill"] == 0 and dec["step"] <= 3)
+    handoff = _percentiles([dt for dt, _, _ in disagg.handoff_log])
+
+    out: Dict[str, object] = {
+        "disagg_tokens_per_sec": round(total_new / disagg_wall, 1),
+        "disagg_wall_seconds": round(disagg_wall, 3),
+        "disagg_ttft_p50_ms": ms(d_ttft[50]),
+        "disagg_ttft_p99_ms": ms(d_ttft[99]),
+        "disagg_tpot_p50_ms": ms(d_tpot[50]),
+        "disagg_tpot_p99_ms": ms(d_tpot[99]),
+        "coloc_tokens_per_sec": round(
+            sum(len(r.tokens) for r in coloc_results.values())
+            / coloc_wall, 1),
+        "coloc_wall_seconds": round(coloc_wall, 3),
+        "coloc_ttft_p50_ms": ms(c_ttft[50]),
+        "coloc_ttft_p99_ms": ms(c_ttft[99]),
+        "coloc_tpot_p50_ms": ms(c_tpot[50]),
+        "coloc_tpot_p99_ms": ms(c_tpot[99]),
+        "disagg_kv_handoff_p50_ms": ms(handoff[50]),
+        "disagg_kv_handoff_p99_ms": ms(handoff[99]),
+        "disagg_kv_handoff_pages_total": disagg.transfer.pages_moved,
+        "disagg_handoffs": len(disagg.handoff_log),
+        "disagg_token_identical": bool(identical),
+        "disagg_pool_pins_held": bool(pins),
+        "disagg_prefill_pool_prefill_compiles": pre["prefill"],
+        "disagg_prefill_pool_step_compiles": pre["step"],
+        "disagg_decode_pool_step_compiles": dec["step"],
+        "disagg_decode_pool_prefill_compiles": dec["prefill"],
+        "disagg_requests": num_requests,
+        "disagg_slots": slots,
+        "disagg_page_size": page_size,
+        "disagg_two_devices": disagg.devices[0] != disagg.devices[1],
+    }
+    log(f"disagg {name}: {num_requests} reqs, TTFT p50/p99 "
+        f"{out['disagg_ttft_p50_ms']}/{out['disagg_ttft_p99_ms']} ms vs "
+        f"coloc {out['coloc_ttft_p50_ms']}/{out['coloc_ttft_p99_ms']} ms; "
+        f"TPOT p99 {out['disagg_tpot_p99_ms']} vs "
+        f"{out['coloc_tpot_p99_ms']} ms; kv_handoff p50/p99 "
+        f"{out['disagg_kv_handoff_p50_ms']}/"
+        f"{out['disagg_kv_handoff_p99_ms']} ms over "
+        f"{out['disagg_handoffs']} handoffs "
+        f"({out['disagg_kv_handoff_pages_total']} pages); "
+        f"token-identical={identical}, pool-pins={pins}")
+    return out
+
+
 def main(argv=None) -> int:
     import argparse
     import json
@@ -349,6 +498,12 @@ def main(argv=None) -> int:
                         help="prepend one seeded system prompt of this "
                              "many tokens to every request (the "
                              "prefix-cache trace)")
+    parser.add_argument("--disagg", action="store_true",
+                        help="disaggregated prefill/decode A/B vs the "
+                             "colocated paged engine: same greedy trace "
+                             "through both, TTFT/TPOT p50/p99 each, "
+                             "kv_handoff p50/p99, token-identity + "
+                             "per-pool compile pins")
     parser.add_argument("--no-baseline", action="store_true")
     parser.add_argument("--compare-sync", action="store_true",
                         help="re-run the trace with async_decode=False "
@@ -363,6 +518,17 @@ def main(argv=None) -> int:
                         help="serve live engine telemetry at "
                              "/metrics on this port (0 = any free port)")
     args = parser.parse_args(argv)
+    if args.disagg:
+        metrics = run_disagg_benchmark(
+            size=args.size, family=args.family, slots=args.slots,
+            num_requests=args.num_requests, dtype_name=args.dtype,
+            kv_cache_dtype=args.kv_cache_dtype,
+            page_size=args.page_size, num_pages=args.num_pages,
+            seed=args.seed)
+        print(json.dumps({"metric": "disagg_tokens_per_sec",
+                          "value": metrics["disagg_tokens_per_sec"],
+                          "unit": "tokens/sec", **metrics}))
+        return 0
     metrics = run_serving_benchmark(
         size=args.size, family=args.family, slots=args.slots,
         num_requests=args.num_requests, dtype_name=args.dtype,

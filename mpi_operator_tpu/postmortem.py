@@ -61,22 +61,32 @@ _DETAIL_FIELDS = ("step", "from_step", "to_step", "last_observed_step",
 def read_timeline(path: str) -> List[Dict]:
     """Parse a timeline.jsonl tolerantly: undecodable lines are skipped
     (a postmortem must survive the torn tail of a crashed writer), but
-    ZERO parseable records is an error the caller turns into exit 2."""
+    ZERO parseable records is an error the caller turns into exit 2.
+    Rotated generations (timeline.jsonl.1, .2 ... from the collector's
+    TPU_TIMELINE_MAX_BYTES cap) are read through the same chain walk
+    events.py uses, oldest first, so a capped long-run timeline still
+    yields the full lifecycle."""
     records: List[Dict] = []
     try:
-        with open(path, "r") as fh:
-            for line in fh:
-                line = line.strip()
-                if not line:
-                    continue
-                try:
-                    rec = json.loads(line)
-                except ValueError:
-                    continue
-                if isinstance(rec, dict) and "ts" in rec and "event" in rec:
-                    records.append(rec)
+        files = ev.event_files(path)
     except OSError:
-        return []
+        files = [path]
+    for fname in files:
+        try:
+            with open(fname, "r") as fh:
+                for line in fh:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    try:
+                        rec = json.loads(line)
+                    except ValueError:
+                        continue
+                    if isinstance(rec, dict) and "ts" in rec \
+                            and "event" in rec:
+                        records.append(rec)
+        except OSError:
+            continue
     records.sort(key=lambda r: r.get("ts", 0.0))
     return records
 
@@ -103,6 +113,11 @@ def summarize(records: Sequence[Dict]) -> Dict:
     incidents: List[Dict] = []
     other: Dict[str, int] = {}
     last_milestone_ts = t0
+    # drain latency: preemption_drain -> the same host's next
+    # emergency_checkpoint — the window the grace period has to cover;
+    # the delta lands on the checkpoint's incident entry
+    drain_open: Dict[str, float] = {}
+    drain_latencies: List[Dict] = []
     for rec in records:
         kind = rec.get("event")
         entry = {
@@ -111,6 +126,16 @@ def summarize(records: Sequence[Dict]) -> Dict:
             "event": kind,
             "detail": _fmt_detail(rec),
         }
+        if kind == ev.PREEMPTION_DRAIN:
+            drain_open[entry["host"]] = rec.get("ts", t0)
+        elif kind == ev.EMERGENCY_CHECKPOINT \
+                and entry["host"] in drain_open:
+            seconds = round(rec.get("ts", t0)
+                            - drain_open.pop(entry["host"]), 3)
+            entry["drain_seconds"] = seconds
+            drain_latencies.append({"t": entry["t"],
+                                    "host": entry["host"],
+                                    "seconds": seconds})
         if kind in MILESTONES:
             # the duration of the phase this milestone CLOSES
             entry["phase_seconds"] = round(rec.get("ts", t0)
@@ -128,6 +153,7 @@ def summarize(records: Sequence[Dict]) -> Dict:
         "job": next((r["job"] for r in records if "job" in r), None),
         "milestones": milestones,
         "incidents": incidents,
+        "drain_latencies": drain_latencies,
         "other_events": other,
         "ledger": goodput_ledger(records),
     }
@@ -151,12 +177,21 @@ def render(summary: Dict, out: TextIO) -> None:
         out.write(f"  {m['t']:>9.3f}s  {m['host']:<12} "
                   f"{m['event']:<22}{detail}{phase}\n")
 
+    drains = summary.get("drain_latencies") or []
+    if drains:
+        worst = max(d["seconds"] for d in drains)
+        out.write(f"  drain latency: {len(drains)} preemption drain(s) "
+                  f"reached the emergency checkpoint, worst "
+                  f"{_fmt_duration(worst)}\n")
+
     if summary["incidents"]:
         out.write("\nincidents:\n")
         for i in summary["incidents"]:
             detail = f"  {i['detail']}" if i["detail"] else ""
+            drain = (f"  (drain->ckpt {_fmt_duration(i['drain_seconds'])})"
+                     if "drain_seconds" in i else "")
             out.write(f"  {i['t']:>9.3f}s  {i['host']:<12} "
-                      f"{i['event']:<22}{detail}\n")
+                      f"{i['event']:<22}{detail}{drain}\n")
 
     if summary["other_events"]:
         pairs = ", ".join(f"{k}×{v}"

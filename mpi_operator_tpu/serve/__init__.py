@@ -8,15 +8,18 @@ See engine.py for the architecture notes; generate() remains the
 fixed-batch oracle the engine is parity-tested against.
 """
 from .engine import (  # noqa: F401
-    EngineConfig, RequestResult, ServingEngine, sample_slots,
+    DecodeEngine, DisaggEngine, EngineConfig, PrefillEngine,
+    RequestResult, ServingEngine, sample_slots,
 )
 from .scheduler import (  # noqa: F401
     Request, RequestState, Scheduler, plan_chunks,
 )
 from .slots import PageAllocator, SlotManager  # noqa: F401
+from .transfer import PageTransfer  # noqa: F401
 
 __all__ = [
-    "EngineConfig", "PageAllocator", "Request", "RequestResult",
+    "DecodeEngine", "DisaggEngine", "EngineConfig", "PageAllocator",
+    "PageTransfer", "PrefillEngine", "Request", "RequestResult",
     "RequestState", "Scheduler", "ServingEngine", "SlotManager",
     "plan_chunks", "sample_slots",
 ]

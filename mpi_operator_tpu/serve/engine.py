@@ -65,6 +65,7 @@ dense and Pallas decode-kernel paths, async and sync.
 from __future__ import annotations
 
 import dataclasses
+import os
 import time
 from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
@@ -78,6 +79,7 @@ from ..telemetry import span
 from ..telemetry import events as ev
 from .scheduler import Request, RequestState, Scheduler
 from .slots import PageAllocator, SlotManager
+from .transfer import PageTransfer
 
 
 @dataclasses.dataclass
@@ -215,6 +217,11 @@ class ServingEngine:
     per-request results. Submit-with-future-`arrival` replays a trace.
     """
 
+    #: page-reservation mode handed to the Scheduler — the
+    #: disaggregated PrefillEngine overrides this to "prompt" (its pool
+    #: never holds decode tokens, so it only reserves the prompt span)
+    RESERVE = "full"
+
     def __init__(self, model, params, config: Optional[EngineConfig] = None,
                  telemetry=None, events=None):
         """telemetry: a telemetry.ServeTelemetry — live TTFT/TPOT/step
@@ -266,6 +273,20 @@ class ServingEngine:
         # HBM-bound; see generate.cast_params for the barrier story)
         self._cast = jax.jit(lambda p: cast_params(p, dt))
         self.params = self._cast(params)
+        # the device the engine's params are COMMITTED to, or None when
+        # they are uncommitted/sharded (the colocated default — jit
+        # places everything on the default device). A disaggregated
+        # pool's params arrive committed to its pool device, which
+        # makes every jit output committed too; the persistent
+        # host-born operand (_prev_tok) must then match, or the first
+        # decode step (uncommitted chain) and every later one
+        # (committed chain) would key two compiled programs
+        leaves = jax.tree.leaves(self.params)
+        self.device = None
+        if leaves and getattr(leaves[0], "committed", False):
+            devs = leaves[0].devices()
+            if len(devs) == 1:
+                self.device = next(iter(devs))
 
         nblk = mcfg.max_len // ps if cfg.paged else 0
         self._nblk = nblk
@@ -367,10 +388,11 @@ class ServingEngine:
                                  static_argnums=(10,))
 
         self.scheduler = Scheduler(cfg.chunk_buckets, mcfg.max_len,
-                                   admit_lookahead=cfg.admit_lookahead)
+                                   admit_lookahead=cfg.admit_lookahead,
+                                   reserve=self.RESERVE)
         self.slots = SlotManager(S)
         self.cache = self._init_cache(self.params)
-        self._prev_tok = jnp.zeros((S,), jnp.int32)
+        self._prev_tok = self._zeros_tok(S)
         # high-water marks over a run(): the capacity story in one pair
         # of numbers (paged mode sustains more slots than contiguous at
         # equal cache bytes exactly when pages_in_use_peak stays under
@@ -379,6 +401,13 @@ class ServingEngine:
         self.pages_in_use_peak = 0
 
     # -- bookkeeping ------------------------------------------------------
+
+    def _zeros_tok(self, n: int):
+        """The device-side token chain's initial value, committed to the
+        engine's device (see __init__) — step N's out_tok is committed
+        there too, so step 1 and step N hit the same compiled program."""
+        z = jnp.zeros((n,), jnp.int32)
+        return z if self.device is None else jax.device_put(z, self.device)
 
     def reset(self) -> None:
         """Clear all serving state (queue, slots, cache contents, page
@@ -389,15 +418,22 @@ class ServingEngine:
         self.scheduler = Scheduler(self.config.chunk_buckets,
                                    self.model_config.max_len,
                                    admit_lookahead=self.config
-                                   .admit_lookahead)
+                                   .admit_lookahead,
+                                   reserve=self.RESERVE)
         self.slots = SlotManager(self.config.slots)
         if self.page_allocator is not None:
+            if os.environ.get("TPU_DEBUG_PAGES") == "1":
+                # O(num_pages) invariant audit of the state the trace
+                # left behind — debug builds only (the test suite sets
+                # TPU_DEBUG_PAGES=1), so the bench's warmup→measure
+                # reset stays O(slots)
+                self.page_allocator.check()
             # rewind refcounts, free list, AND the prefix cache — cached
             # pages index into a cache whose contents init_cache is about
             # to zero, so carrying them over would serve stale K/V
             self.page_allocator.reset()
         self.cache = self._init_cache(self.params)
-        self._prev_tok = jnp.zeros((self.config.slots,), jnp.int32)
+        self._prev_tok = self._zeros_tok(self.config.slots)
         # the per-step rng folds in this counter — rewind it so a reset
         # engine replays a trace with identical draws
         self._steps_dispatched = 0
@@ -594,6 +630,60 @@ class ServingEngine:
                 finished.append(st)
         return finished
 
+    def _note_admissions(self, admitted: List[RequestState]) -> None:
+        """Bind newly admitted states to their slot rows and record the
+        admission (slot_admit event, prefix-cache page counters). Shared
+        by run() and the disaggregated facade's prefill side."""
+        alloc = self.page_allocator
+        tel = self.telemetry
+        for st in admitted:
+            self.slots.bind(st)
+            if self.events is not None:
+                self.events.emit(ev.SLOT_ADMIT, request=st.req.id,
+                                 slot=st.slot,
+                                 prompt_len=len(st.req.prompt),
+                                 cached_tokens=st.cached_tokens)
+            if tel is not None and alloc is not None:
+                ps_ = alloc.page_size
+                full = (len(st.req.prompt) - 1) // ps_
+                hit = st.cached_tokens // ps_
+                tel.prefix_hit_pages.inc(hit)
+                tel.prefix_miss_pages.inc(full - hit)
+
+    def _retire_state(self, st: RequestState,
+                      results: Dict[int, "RequestResult"]) -> None:
+        """Retire ONE finished state: scheduler/slot/page bookkeeping,
+        the slot_retire event, and the RequestResult record. Shared by
+        run() and the disaggregated facade's decode side."""
+        alloc = self.page_allocator
+        self.scheduler.retire(st)
+        if not st.slot_released:          # EOS path: freed here; the
+            self.slots.release(st)        # length path freed its row
+            st.slot_released = True       # at dispatch already
+        if alloc is not None:
+            # drop every reference this request held — pinned shared
+            # prefix pages and private pages alike; its PUBLISHED pages
+            # park in the evictable LRU where future lookups still find
+            # them
+            for p in st.owned_pages:
+                alloc.release(p)
+            st.owned_pages = []
+        if self.events is not None:
+            self.events.emit(
+                ev.SLOT_RETIRE, request=st.req.id, slot=st.slot,
+                finish_reason=st.finish_reason,
+                new_tokens=len(st.generated))
+        if self.telemetry is not None:
+            self.telemetry.requests_total.inc()
+        results[st.req.id] = RequestResult(
+            id=st.req.id, tokens=list(st.generated),
+            logprobs=list(st.logprobs),
+            finish_reason=st.finish_reason,
+            ttft=st.token_times[0] - st.req.arrival,
+            token_times=list(st.token_times),
+            cached_tokens=st.cached_tokens,
+            admitted_at=st.admitted_at)
+
     def run(self, requests: Sequence[Request] = (),
             on_token: Optional[Callable[[Request, int], None]] = None,
             ) -> Dict[int, RequestResult]:
@@ -620,33 +710,7 @@ class ServingEngine:
 
         def retire(finished: List[RequestState]) -> None:
             for st in finished:
-                self.scheduler.retire(st)
-                if not st.slot_released:      # EOS path: freed here; the
-                    self.slots.release(st)    # length path freed its row
-                    st.slot_released = True   # at dispatch already
-                if alloc is not None:
-                    # drop every reference this request held — pinned
-                    # shared prefix pages and private pages alike; its
-                    # PUBLISHED pages park in the evictable LRU where
-                    # future lookups still find them
-                    for p in st.owned_pages:
-                        alloc.release(p)
-                    st.owned_pages = []
-                if self.events is not None:
-                    self.events.emit(
-                        ev.SLOT_RETIRE, request=st.req.id, slot=st.slot,
-                        finish_reason=st.finish_reason,
-                        new_tokens=len(st.generated))
-                if tel is not None:
-                    tel.requests_total.inc()
-                results[st.req.id] = RequestResult(
-                    id=st.req.id, tokens=list(st.generated),
-                    logprobs=list(st.logprobs),
-                    finish_reason=st.finish_reason,
-                    ttft=st.token_times[0] - st.req.arrival,
-                    token_times=list(st.token_times),
-                    cached_tokens=st.cached_tokens,
-                    admitted_at=st.admitted_at)
+                self._retire_state(st, results)
 
         # the double buffer: the step whose tokens are still on the
         # device. Each iteration dispatches step N+1 FIRST, then syncs
@@ -658,20 +722,9 @@ class ServingEngine:
         while not (self.scheduler.idle and pending is None):
             now = now_fn()
             with span("serve.schedule"):
-                for st in self.scheduler.admit(self.slots.free, now,
-                                               allocator=alloc):
-                    self.slots.bind(st)
-                    if self.events is not None:
-                        self.events.emit(ev.SLOT_ADMIT, request=st.req.id,
-                                         slot=st.slot,
-                                         prompt_len=len(st.req.prompt),
-                                         cached_tokens=st.cached_tokens)
-                    if tel is not None and alloc is not None:
-                        ps_ = alloc.page_size
-                        full = (len(st.req.prompt) - 1) // ps_
-                        hit = st.cached_tokens // ps_
-                        tel.prefix_hit_pages.inc(hit)
-                        tel.prefix_miss_pages.inc(full - hit)
+                self._note_admissions(
+                    self.scheduler.admit(self.slots.free, now,
+                                         allocator=alloc))
             self.occupancy_peak = max(self.occupancy_peak,
                                       self.slots.occupied)
             if alloc is not None:
@@ -716,5 +769,366 @@ class ServingEngine:
         return results
 
 
-__all__ = ["SAMPLE_POOL", "EngineConfig", "RequestResult",
-           "ServingEngine", "sample_slots"]
+class PrefillEngine(ServingEngine):
+    """The prefill half of a disaggregated pair (DisaggEngine drives
+    it): admits requests and runs batched chunked prefill, but never
+    dispatches a decode step — so its compiled-program footprint is
+    prefill-only (`prefill <= len(chunk_buckets)`, `step == 0`; the
+    per-pool HBM program-cache win of the split). Page reservations
+    cover the PROMPT span only (Scheduler reserve="prompt"): the decode
+    span lives in the decode pool, so this pool's pages all do prefill
+    work — at equal bytes it keeps strictly more prompts in flight than
+    a colocated engine could."""
+
+    RESERVE = "prompt"
+
+    def __init__(self, model, params, config: Optional[EngineConfig] = None,
+                 telemetry=None, events=None):
+        cfg = config or EngineConfig()
+        if not cfg.paged:
+            raise ValueError("disaggregated serving requires paged=True "
+                             "(the handoff unit is a page list)")
+        super().__init__(model, params, cfg, telemetry=telemetry,
+                         events=events)
+
+    def take_prefilled(self) -> List[RequestState]:
+        """Pop every state whose prefill just completed: it leaves the
+        scheduler and frees its slot row (the next prompt starts
+        immediately) but KEEPS its page references — the handoff copy
+        still reads those pages; DisaggEngine releases them once the
+        copy is dispatched. Nothing can write the kept pages meanwhile:
+        writes route through slot page tables, and the freed row's
+        table is rebuilt from its next occupant's pages."""
+        done = [st for st in self.scheduler.active if not st.prefilling]
+        for st in done:
+            self.scheduler.retire(st)
+            self.slots.release(st)
+            st.slot_released = True
+        return done
+
+
+class DecodeEngine(ServingEngine):
+    """The decode half: requests arrive pre-filled via
+    `install_handoff` and flow through the shared decode step; this
+    pool never compiles a prefill program (`step <= 3`, `prefill ==
+    0`). Its PageAllocator runs the same prefix cache as a colocated
+    engine — a handed-off prompt whose prefix is already resident here
+    needs NO bytes moved for those pages (DisaggEngine transfers only
+    the misses)."""
+
+    def __init__(self, model, params, config: Optional[EngineConfig] = None,
+                 telemetry=None, events=None):
+        cfg = config or EngineConfig()
+        if not cfg.paged:
+            raise ValueError("disaggregated serving requires paged=True "
+                             "(the handoff unit is a page list)")
+        super().__init__(model, params, cfg, telemetry=telemetry,
+                         events=events)
+
+    def install_handoff(self, req: Request, reserved, now: float,
+                        cached_tokens: int = 0,
+                        ) -> Tuple[RequestState, List[Tuple[int, int]]]:
+        """Bind a prefill-complete request into a slot of THIS pool.
+        `reserved` is this pool's full-span page reservation (chain,
+        private, table) from Scheduler._reserve_pages — the chain pages
+        are decode-side prefix-cache hits whose KV is already resident.
+        Returns (state, fill) where fill lists (prompt-page index,
+        physical page here) for every page whose contents must still be
+        copied in from the prefill pool; full prompt pages among them
+        are published into this pool's prefix cache immediately, so the
+        NEXT handoff sharing the prefix skips their copy too.
+
+        The caller must have checked `self.slots.free` first."""
+        chain, private, table = reserved
+        alloc = self.page_allocator
+        ps = alloc.page_size
+        p1 = len(req.prompt) - 1
+        full = p1 // ps                   # complete PROMPT pages
+        # pages prefill actually wrote: positions [0, p1)
+        written = 0 if p1 < 1 else (p1 - 1) // ps + 1
+        slot = self.slots.free.pop(0)
+        st = RequestState(req=req, slot=slot, pos=p1, chunks=[],
+                          next_input=int(req.prompt[-1]), admitted_at=now)
+        st.page_table = table
+        st.owned_pages = chain + private
+        st.cached_tokens = cached_tokens
+        st.published_pages = full         # published below or inherited —
+        st.publish_parent = -1            # the engine never re-publishes
+        self.slots.bind(st)
+        self.scheduler.active.append(st)
+        fill = [(k, table[k]) for k in range(len(chain), written)]
+        if self.config.prefix_cache:
+            parent = chain[-1] if chain else -1
+            for k in range(len(chain), full):
+                if not alloc.publish(table[k], parent,
+                                     req.prompt[k * ps:(k + 1) * ps]):
+                    break
+                parent = table[k]
+        if self.telemetry is not None:
+            # decode-side hit/miss = handoff pages saved/moved — the
+            # same instruments a colocated engine feeds at admission
+            self.telemetry.prefix_hit_pages.inc(len(chain))
+            self.telemetry.prefix_miss_pages.inc(written - len(chain))
+        if self.events is not None:
+            self.events.emit(ev.SLOT_ADMIT, request=req.id, slot=slot,
+                             prompt_len=len(req.prompt),
+                             cached_tokens=len(chain) * ps)
+        return st, fill
+
+
+class DisaggEngine:
+    """Disaggregated prefill/decode serving: a PrefillEngine and a
+    DecodeEngine on SEPARATE devices, bridged by paged-KV handoff
+    (serve/transfer.py). One long prompt saturates the prefill pool
+    while in-flight decodes keep stepping on the decode pool — the
+    TTFT/TPOT interference a colocated engine can't avoid is gone by
+    construction, and each pool compiles only its own programs.
+
+    Flow per request: admit → prefill pool (prompt-span-only page
+    reservation, batched chunked prefill) → handoff (decode-side
+    full-span reservation; device-to-device copy of exactly the prompt
+    pages the decode pool's prefix cache does NOT already hold) →
+    decode pool (shared double-buffered step) → retire (pages park in
+    the decode pool's prefix cache). Admission is backpressured when
+    the decode pool's free pages can't absorb the in-flight handoffs
+    (Scheduler.gate), so a handoff can stall only on slots, never
+    deadlock on pages.
+
+    Token parity: at temperature 0 the facade is token-for-token
+    identical to a colocated paged ServingEngine over the same trace
+    (tests/test_disagg.py pins it, dense and Pallas-kernel, int8 KV
+    included): per-slot prefill/step rows are computed independently,
+    so batching composition doesn't change a row's KV; the handoff
+    copies those exact bytes (int8 payloads move with their scale
+    planes); and the decode step is the same compiled program. At
+    temperature > 0 sampling matches distributionally but not bitwise —
+    the per-step rng folds in each pool's own dispatch counter.
+
+    On CPU smoke the two "pools" are two of the virtual host devices
+    (same program structure, host-memory device_put); on real hardware
+    point `devices=` at chips in different pools and the copy rides
+    ICI/DCN. The controller stands up the two pools as distinct worker
+    groups (TPU_SERVE_ROLE) — see controller/controller.py."""
+
+    def __init__(self, model, params, config: Optional[EngineConfig] = None,
+                 *, prefill_config: Optional[EngineConfig] = None,
+                 registry=None, events=None, devices=None):
+        cfg = config or EngineConfig(paged=True)
+        pcfg = prefill_config or cfg
+        if not cfg.paged or not pcfg.paged:
+            raise ValueError("disaggregated serving requires paged=True")
+        if pcfg.page_size != cfg.page_size:
+            raise ValueError(
+                f"prefill/decode page_size disagree "
+                f"({pcfg.page_size} vs {cfg.page_size}) — the handoff "
+                f"moves pages verbatim")
+        if devices is None:
+            local = jax.local_devices()
+            devices = ((local[0], local[1]) if len(local) > 1
+                       else (local[0], local[0]))
+        self.devices = tuple(devices)
+        pre_tel = dec_tel = None
+        if registry is not None:
+            from ..telemetry.worker import ServeTelemetry
+            pre_tel = ServeTelemetry(registry, labels={"pool": "prefill"})
+            dec_tel = ServeTelemetry(registry, labels={"pool": "decode"})
+        self.events = events
+        pre_ev = events.bind(pool="prefill") if events is not None else None
+        dec_ev = events.bind(pool="decode") if events is not None else None
+        # device_put COMMITS each pool's params to its device; every jit
+        # downstream (cast, init_cache, prefill/step, transfer
+        # gather/scatter) follows its committed operands, so the two
+        # engines' programs land on the two devices with no mesh code
+        self.prefill = PrefillEngine(
+            model, jax.device_put(params, self.devices[0]), pcfg,
+            telemetry=pre_tel, events=pre_ev)
+        self.decode = DecodeEngine(
+            model, jax.device_put(params, self.devices[1]), cfg,
+            telemetry=dec_tel, events=dec_ev)
+        self.transfer = PageTransfer(self.prefill.page_allocator.num_pages,
+                                     self.decode.page_allocator.num_pages)
+        self.config = cfg
+        self._handoff_q: List[RequestState] = []
+        # handoff trace for the bench: (seconds, pages moved, pages
+        # skipped via the decode-side prefix cache) per handoff
+        self.handoff_log: List[Tuple[float, int, int]] = []
+        self._install_gate()
+
+    def _install_gate(self) -> None:
+        """Decode-capacity backpressure on PREFILL admission: a request
+        enters the prefill pool only while the decode pool's available
+        pages cover every in-flight request's worst-case span plus this
+        one — so prefill can't fill with prompts the decode pool cannot
+        absorb, and handoffs drain as decode capacity frees (the
+        scheduler's lookahead still packs smaller requests past a gated
+        head)."""
+        ps = self.config.page_size
+        dec_alloc = self.decode.page_allocator
+
+        def gate(req: Request) -> bool:
+            inflight = sum(Scheduler.pages_needed(s.req, ps)
+                           for s in self.prefill.scheduler.active)
+            inflight += sum(Scheduler.pages_needed(s.req, ps)
+                            for s in self._handoff_q)
+            return (dec_alloc.available
+                    >= inflight + Scheduler.pages_needed(req, ps))
+
+        self.prefill.scheduler.gate = gate
+
+    def reset(self) -> None:
+        """Reset both pools (queues, caches, allocators) keeping every
+        compiled program — including the transfer's gather/scatter,
+        which live on this facade, so a warmed DisaggEngine replays a
+        trace with identical tokens and identical compile counts."""
+        self.prefill.reset()
+        self.decode.reset()
+        self._handoff_q = []
+        self.handoff_log = []
+        self.transfer.pages_moved = 0
+        self._install_gate()              # reset() rebuilt the scheduler
+
+    def compile_counts(self) -> Dict[str, Dict[str, int]]:
+        """Per-pool program-cache sizes plus the transfer pair. The
+        disaggregation pins: prefill pool `step == 0`, decode pool
+        `prefill == 0` — neither pool ever compiles the other's
+        programs."""
+        return {"prefill_pool": self.prefill.compile_counts(),
+                "decode_pool": self.decode.compile_counts(),
+                "transfer": self.transfer.compile_counts()}
+
+    def _handoff(self, st: RequestState, reserved, now: float) -> None:
+        """Move one prefill-complete request into the decode pool:
+        install it there (decode-side reservation already made), copy
+        exactly the non-cached written prompt pages device-to-device,
+        then drop the prefill pool's page references — its published
+        prompt pages park in the prefill prefix cache (a repeat prompt
+        skips the recompute), the private tail returns to its free
+        list."""
+        pre, dec = self.prefill, self.decode
+        t0 = time.perf_counter()
+        chain_hits = len(reserved[0])
+        new_st, fill = dec.install_handoff(st.req, reserved, now,
+                                           cached_tokens=st.cached_tokens)
+        src_ids = [st.page_table[k] for k, _ in fill]
+        dst_ids = [p for _, p in fill]
+        with span("serve.kv_handoff"):
+            dec.cache, moved = self.transfer.move(pre.cache, dec.cache,
+                                                  src_ids, dst_ids)
+        # the gather captured the source buffers at dispatch — the page
+        # REFERENCES can drop now (see PageTransfer.move)
+        for p in st.owned_pages:
+            pre.page_allocator.release(p)
+        st.owned_pages = []
+        dt = time.perf_counter() - t0     # host wall, async-dispatch
+        self.handoff_log.append((dt, moved, chain_hits))
+        if dec.telemetry is not None:
+            dec.telemetry.kv_handoff_seconds.observe(dt)
+            dec.telemetry.kv_handoff_pages.inc(moved)
+        if self.events is not None:
+            self.events.emit(ev.KV_HANDOFF, request=st.req.id,
+                             pages=moved, cached_pages=chain_hits,
+                             seconds=dt)
+
+    def _drain_handoffs(self, now_fn) -> None:
+        """Install every queued handoff the decode pool can take right
+        now (a free slot + a full-span page reservation); the rest stay
+        queued — backpressure keeps this queue short, and decode-side
+        retirements free the capacity that drains it."""
+        dec = self.decode
+        still: List[RequestState] = []
+        for st in self._handoff_q:
+            reserved = None
+            if dec.slots.free:
+                reserved = dec.scheduler._reserve_pages(
+                    st.req, dec.page_allocator)
+            if reserved is None:
+                still.append(st)
+                continue
+            self._handoff(st, reserved, now_fn())
+        self._handoff_q = still
+
+    def run(self, requests: Sequence[Request] = (),
+            on_token: Optional[Callable[[Request, int], None]] = None,
+            ) -> Dict[int, RequestResult]:
+        """Drive both pools to completion over `requests` — same
+        contract as ServingEngine.run (trace replay via future
+        arrivals, on_token streaming, {id: RequestResult})."""
+        pre, dec = self.prefill, self.decode
+        ps = self.config.page_size
+        for r in requests:
+            need = Scheduler.pages_needed(r, ps)
+            if need > dec.page_allocator.usable:
+                raise ValueError(
+                    f"request {r.id}: worst-case span needs {need} KV "
+                    f"pages but the decode pool has "
+                    f"{dec.page_allocator.usable} usable")
+            pneed = Scheduler.prompt_pages_needed(r, ps)
+            if pneed > pre.page_allocator.usable:
+                raise ValueError(
+                    f"request {r.id}: prompt span needs {pneed} KV pages "
+                    f"but the prefill pool has "
+                    f"{pre.page_allocator.usable} usable")
+            pre.scheduler.submit(r)
+        t0 = time.perf_counter()
+        now_fn = lambda: time.perf_counter() - t0   # noqa: E731
+        results: Dict[int, RequestResult] = {}
+        pending = None
+        while not (pre.scheduler.idle and not self._handoff_q
+                   and dec.scheduler.idle and pending is None):
+            now = now_fn()
+            with span("serve.schedule"):
+                pre._note_admissions(
+                    pre.scheduler.admit(pre.slots.free, now,
+                                        allocator=pre.page_allocator))
+            for eng, qdepth in ((pre, len(pre.scheduler.queue)),
+                                (dec, len(self._handoff_q))):
+                eng.occupancy_peak = max(eng.occupancy_peak,
+                                         eng.slots.occupied)
+                eng.pages_in_use_peak = max(eng.pages_in_use_peak,
+                                            eng.page_allocator.in_use)
+                if eng.telemetry is not None:
+                    # the decode pool's "queue" is the handoff queue —
+                    # prompts prefilled but not yet installed
+                    eng.telemetry.queue_depth.set(qdepth)
+                    eng.telemetry.slot_occupancy.set(eng.slots.occupied)
+                    eng.telemetry.pages_in_use.set(
+                        eng.page_allocator.in_use)
+                    eng.telemetry.pages_cached.set(
+                        eng.page_allocator.cached_pages)
+            if (pre.slots.occupied == 0 and not self._handoff_q
+                    and dec.slots.occupied == 0 and pending is None):
+                nxt = pre.scheduler.next_arrival()
+                if nxt is not None and nxt > now_fn():
+                    time.sleep(min(nxt - now_fn(), 0.05))
+                continue
+            lead = pre.scheduler.next_prefill()
+            if lead is not None:
+                pre._run_prefill_batched(lead)
+            self._handoff_q.extend(pre.take_prefilled())
+            self._drain_handoffs(now_fn)
+            new_pending = (dec._dispatch_decode_step()
+                           if dec.scheduler.decoding() else None)
+            if pending is not None:
+                for fin in dec._sync_decode_step(pending, now_fn,
+                                                 on_token):
+                    dec._retire_state(fin, results)
+                pending = None
+            if self.config.async_decode:
+                pending = new_pending
+            elif new_pending is not None:
+                for fin in dec._sync_decode_step(new_pending, now_fn,
+                                                 on_token):
+                    dec._retire_state(fin, results)
+        for eng in (pre, dec):
+            if eng.telemetry is not None:
+                counts = eng.compile_counts()
+                eng.telemetry.step_compiles.set(counts["step"])
+                eng.telemetry.prefill_compiles.set(counts["prefill"])
+                eng.telemetry.queue_depth.set(0)
+                eng.telemetry.slot_occupancy.set(eng.slots.occupied)
+        return results
+
+
+__all__ = ["SAMPLE_POOL", "DecodeEngine", "DisaggEngine", "EngineConfig",
+           "PrefillEngine", "RequestResult", "ServingEngine",
+           "sample_slots"]

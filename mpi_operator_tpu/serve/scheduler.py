@@ -151,7 +151,7 @@ class Scheduler:
     pages left over. FCFS order is preserved whenever the head fits."""
 
     def __init__(self, chunk_buckets: Sequence[int], max_len: int,
-                 admit_lookahead: int = 8):
+                 admit_lookahead: int = 8, reserve: str = "full"):
         buckets = tuple(chunk_buckets)
         if not 1 <= len(buckets) <= 3:
             raise ValueError(f"chunk_buckets must have 1-3 entries "
@@ -165,9 +165,26 @@ class Scheduler:
         if admit_lookahead < 1:
             raise ValueError(f"admit_lookahead must be >= 1, "
                              f"got {admit_lookahead}")
+        if reserve not in ("full", "prompt"):
+            raise ValueError(f"reserve must be 'full' or 'prompt', "
+                             f"got {reserve!r}")
         self.chunk_buckets = buckets
         self.max_len = max_len
         self.admit_lookahead = admit_lookahead
+        # "full" reserves a request's whole worst-case span at admission
+        # (colocated serving: decode must never allocate mid-flight);
+        # "prompt" reserves only the pages prefill will write — the
+        # disaggregated PREFILL pool's mode, where the decode span is
+        # the decode pool's problem (serve/engine.py PrefillEngine).
+        self.reserve = reserve
+        # optional admission gate: a predicate over the candidate
+        # request checked before any reservation work. The
+        # disaggregated facade installs the decode-pool backpressure
+        # here — when the decode pool's free pages cannot absorb the
+        # in-flight handoffs plus this request, the candidate stays
+        # queued (lookahead still lets a smaller request behind it try,
+        # the same packing rule as a failed page reservation).
+        self.gate = None
         self.queue: deque[Request] = deque()
         self.active: List[RequestState] = []
         # slot-aware reserve-ahead (paged mode): page reservations made
@@ -206,16 +223,29 @@ class Scheduler:
         written position is P-2+max_new, so the span is its page + 1."""
         return (len(req.prompt) - 2 + req.max_new_tokens) // page_size + 1
 
+    @staticmethod
+    def prompt_pages_needed(req: Request, page_size: int) -> int:
+        """Prompt-only page span: prefill writes positions [0, P-1), so
+        the last written position is P-2. This is what a disaggregated
+        PREFILL pool reserves (reserve="prompt") — the decode span never
+        touches its pages, which is exactly the capacity win of the
+        split (serve/engine.py PrefillEngine)."""
+        p1 = len(req.prompt) - 1
+        return 0 if p1 < 1 else (p1 - 1) // page_size + 1
+
     def _reserve_pages(self, req: Request, allocator):
-        """Try to reserve `req`'s whole page span: pin its cached prefix
+        """Try to reserve `req`'s page span: pin its cached prefix
         chain, then allocate the rest — or undo the pins and return None
-        when the pool (free + evictable) can't cover it. Reserving
-        up-front is what makes decode allocation-free: a request that
-        gets a slot can always finish."""
+        when the pool (free + evictable) can't cover it. The span is the
+        worst case for this scheduler's reserve mode (full request or
+        prompt only); reserving up-front is what makes the steady state
+        allocation-free: a request that gets a slot can always finish
+        its phase here."""
         ps = allocator.page_size
         p1 = len(req.prompt) - 1              # bonus token excluded
         full = p1 // ps                       # complete PROMPT pages
-        total = self.pages_needed(req, ps)
+        total = (self.pages_needed(req, ps) if self.reserve == "full"
+                 else self.prompt_pages_needed(req, ps))
         chain = allocator.lookup(req.prompt, full)
         if allocator.available < total - len(chain):
             for p in reversed(chain):
@@ -248,6 +278,8 @@ class Scheduler:
             for idx, req in enumerate(self.queue):
                 if idx >= self.admit_lookahead or req.arrival > now:
                     break
+                if self.gate is not None and not self.gate(req):
+                    continue              # backpressured; let others try
                 if allocator is None:
                     picked = (idx, req, None)
                     break
@@ -286,6 +318,8 @@ class Scheduler:
                 if idx >= self.admit_lookahead or req.arrival > now:
                     break
                 if req.id in self.staged:
+                    continue
+                if self.gate is not None and not self.gate(req):
                     continue
                 reserved = self._reserve_pages(req, allocator)
                 if reserved is not None:

@@ -55,13 +55,20 @@ import urllib.request
 from typing import Callable, Dict, Iterable, List, Optional, Tuple
 
 from . import events as ev
-from .events import EventLog, read_events
+from .events import EventLog, _env_int, read_events, rotate_chain
 from .prometheus import escape_label_value, format_value
 
 logger = logging.getLogger("mpi_operator_tpu.telemetry.collector")
 
 WORKER_PREFIX = "tpu_worker_"
 JOB_PREFIX = "tpu_job_"
+
+# timeline.jsonl size cap (0/unset = the historical full-rewrite mode).
+# Capped mode switches write_timeline to incremental appends rotated
+# through the SAME .N chain events.py uses, so event_files/read_events
+# (and postmortem.read_timeline) span the generations transparently.
+ENV_TIMELINE_MAX_BYTES = "TPU_TIMELINE_MAX_BYTES"
+ENV_TIMELINE_KEEP = "TPU_TIMELINE_KEEP"
 
 # Fields that carry a global-step position; the running max across a
 # merged timeline is "the furthest the gang has ever trained" — the
@@ -610,12 +617,48 @@ class JobObservatory:
         if out_path is None:
             root = self.events_dir or "."
             out_path = os.path.join(root, job, "timeline.jsonl")
-        merge_timeline(
-            [(None, self.view(job)["controller_records"])] +
-            [(host, recs) for host, recs
-             in sorted(self.view(job)["worker_records"].items())],
-            offsets=self.view(job)["clock_sync"].offsets,
-            out_path=out_path)
+        view = self.view(job)
+        sources = ([(None, view["controller_records"])] +
+                   [(host, recs) for host, recs
+                    in sorted(view["worker_records"].items())])
+        max_bytes = _env_int(ENV_TIMELINE_MAX_BYTES, 0)
+        if not max_bytes:
+            merge_timeline(sources, offsets=view["clock_sync"].offsets,
+                           out_path=out_path)
+            return out_path
+        # Size-capped mode: a long-lived job's full rewrite grows without
+        # bound, so instead append only records not yet persisted and
+        # shift the chain (events.py rotate_chain — same .N layout) when
+        # the live file would blow the cap. Per-source high-water marks
+        # make the append duplicate-free: the pull loop only ever extends
+        # each source's record list. The batch is ts-sorted within
+        # itself; cross-batch ordering is arrival order, and every
+        # chain-spanning reader (postmortem.read_timeline, read_events)
+        # re-sorts by ts anyway.
+        keep = max(1, _env_int(ENV_TIMELINE_KEEP, 1))
+        consumed: Dict[str, int] = view.setdefault("timeline_consumed", {})
+        fresh = [(host, recs[consumed.get(host or "controller", 0):])
+                 for host, recs in sources]
+        batch = merge_timeline([(h, r) for h, r in fresh if r],
+                               offsets=view["clock_sync"].offsets)
+        for host, recs in sources:
+            consumed[host or "controller"] = len(recs)
+        parent = os.path.dirname(out_path)
+        if parent:
+            os.makedirs(parent, exist_ok=True)
+        payload = "".join(json.dumps(rec) + "\n" for rec in batch)
+        try:
+            size = os.path.getsize(out_path)
+        except OSError:
+            size = 0
+        if size and size + len(payload) > max_bytes:
+            try:
+                rotate_chain(out_path, keep)
+            except OSError:
+                logger.warning("timeline rotation failed for %s", out_path,
+                               exc_info=True)
+        with open(out_path, "a", encoding="utf-8") as fh:
+            fh.write(payload)
         return out_path
 
     def render_lines(self) -> List[str]:

@@ -47,6 +47,9 @@ CLOCK_ANCHOR = "clock_anchor"
 FAULT_INJECTED = "fault_injected"
 REPLICA_FROZEN = "replica_frozen"
 RUN_COMPLETE = "run_complete"
+# disaggregated serving: one record per prefill→decode page handoff
+# (serve/engine.py DisaggEngine), with pages moved/cached and seconds
+KV_HANDOFF = "kv_handoff"
 # Controller-side kinds (the operator's own EventLog; stamped with a
 # "job" field and merged with worker records into <job>/timeline.jsonl):
 JOB_CREATED = "job_created"
@@ -126,14 +129,7 @@ class EventLog:
             self._fh.flush()
             os.fsync(self._fh.fileno())
             self._fh.close()
-            oldest = self.path + ".%d" % self.keep
-            if os.path.exists(oldest):
-                os.remove(oldest)
-            for i in range(self.keep - 1, 0, -1):
-                src = self.path + ".%d" % i
-                if os.path.exists(src):
-                    os.replace(src, self.path + ".%d" % (i + 1))
-            os.replace(self.path, self.path + ".1")
+            rotate_chain(self.path, self.keep)
         except OSError:
             logger.warning("event log rotation failed for %s", self.path,
                            exc_info=True)
@@ -200,6 +196,23 @@ class BoundEventLog:
         self._log.close()
 
 
+def rotate_chain(path: str, keep: int) -> None:
+    """Shift `path` -> .1 -> .2 ... keeping the newest `keep` rotated
+    generations; the base path no longer exists on return (the caller
+    reopens or rewrites it). ONE chain layout shared by every size-
+    capped JSONL sink — EventLog above and the collector's
+    timeline.jsonl — so event_files/read_events span them all."""
+    oldest = path + ".%d" % keep
+    if os.path.exists(oldest):
+        os.remove(oldest)
+    for i in range(keep - 1, 0, -1):
+        src = path + ".%d" % i
+        if os.path.exists(src):
+            os.replace(src, path + ".%d" % (i + 1))
+    if os.path.exists(path):
+        os.replace(path, path + ".1")
+
+
 def event_files(path: str) -> List[str]:
     """The rotation chain for `path`, oldest first: highest-numbered
     .N down to .1, then the live file. Only existing files returned."""
@@ -253,7 +266,7 @@ def read_events(path: str, kind: Optional[str] = None) -> List[Dict]:
 
 
 __all__ = ["EventLog", "BoundEventLog", "read_events", "event_files",
-           "DECODE_ERRORS", "PREEMPTION_DRAIN",
+           "rotate_chain", "DECODE_ERRORS", "PREEMPTION_DRAIN",
            "EMERGENCY_CHECKPOINT", "DIVERGENCE_ROLLBACK", "INIT_RETRY",
            "SLOT_ADMIT", "SLOT_RETIRE", "CHECKPOINT_RESTORE",
            "CHECKPOINT_SAVED", "CLOCK_ANCHOR", "FAULT_INJECTED",

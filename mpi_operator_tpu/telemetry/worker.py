@@ -54,6 +54,18 @@ Serve series (ServingEngine):
   prefix_hit_pages_total  counter   — prompt pages served from the
                                       prefix cache at admission
   prefix_miss_pages_total counter   — prompt pages prefilled cold
+  kv_handoff_seconds      histogram — disaggregated serving: one
+                                      prefill→decode page handoff,
+                                      install + copy dispatch (host
+                                      wall time, async like prefill)
+  kv_handoff_pages_total  counter   — KV pages moved between pools
+                                      (decode-side prefix hits move
+                                      nothing and are NOT counted)
+
+Disaggregated serving creates one ServeTelemetry per pool with
+``labels={"pool": "prefill"|"decode"}`` on a shared registry — the same
+bundle-per-label-set pattern as the fused trainer — so every serve
+series above federates per pool (tpu_job_queue_depth{pool="decode"}).
 """
 from __future__ import annotations
 
@@ -206,14 +218,23 @@ class TrainTelemetry:
 
 
 class ServeTelemetry:
-    """Serving-engine instruments over a shared registry."""
+    """Serving-engine instruments over a shared registry.
 
-    def __init__(self, registry: Optional[Registry] = None):
+    ``labels`` stamps every instrument with the same label set (the
+    TrainTelemetry pattern): the disaggregated facade creates one
+    bundle per pool (``labels={"pool": "prefill"}`` / ``"decode"``) on
+    a shared registry, so per-pool series federate side by side."""
+
+    def __init__(self, registry: Optional[Registry] = None,
+                 labels: Optional[Dict[str, str]] = None):
         reg = registry if registry is not None else Registry()
         self.registry = reg
+        self.labels = dict(labels) if labels else None
+        labels = self.labels
         # serving latencies reach sub-100µs on real accelerators; start
         # the buckets a decade lower than the train histogram
-        hist = lambda n, h: reg.histogram(n, h, lo=1e-5, hi=1e3)  # noqa: E731
+        hist = lambda n, h: reg.histogram(  # noqa: E731
+            n, h, lo=1e-5, hi=1e3, labels=labels)
         self.ttft_seconds = hist(
             "tpu_worker_ttft_seconds", "request arrival to first token")
         self.tpot_seconds = hist(
@@ -227,35 +248,51 @@ class ServeTelemetry:
         self.host_gap_seconds = hist(
             "tpu_worker_host_gap_seconds",
             "host blocked on the device token read per step")
+        self.kv_handoff_seconds = hist(
+            "tpu_worker_kv_handoff_seconds",
+            "prefill->decode KV page handoff, install + copy dispatch")
         self.queue_depth = reg.gauge(
-            "tpu_worker_queue_depth", "requests waiting for a slot")
+            "tpu_worker_queue_depth", "requests waiting for a slot",
+            labels=labels)
         self.slot_occupancy = reg.gauge(
-            "tpu_worker_slot_occupancy", "slots currently bound")
+            "tpu_worker_slot_occupancy", "slots currently bound",
+            labels=labels)
         self.slots = reg.gauge(
-            "tpu_worker_slots", "configured decode slots")
+            "tpu_worker_slots", "configured decode slots", labels=labels)
         self.step_compiles = reg.gauge(
-            "tpu_worker_step_compiles", "decode-step compile count")
+            "tpu_worker_step_compiles", "decode-step compile count",
+            labels=labels)
         self.prefill_compiles = reg.gauge(
-            "tpu_worker_prefill_compiles", "prefill compile count")
+            "tpu_worker_prefill_compiles", "prefill compile count",
+            labels=labels)
         self.requests_total = reg.counter(
-            "tpu_worker_requests_total", "requests retired")
+            "tpu_worker_requests_total", "requests retired",
+            labels=labels)
         self.tokens_total = reg.counter(
-            "tpu_worker_tokens_total", "new tokens emitted")
+            "tpu_worker_tokens_total", "new tokens emitted",
+            labels=labels)
+        self.kv_handoff_pages = reg.counter(
+            "tpu_worker_kv_handoff_pages_total",
+            "KV pages moved prefill->decode (prefix hits excluded)",
+            labels=labels)
         self.pages_total = reg.gauge(
             "tpu_worker_kv_pages_total",
-            "usable KV pages (paged mode; pool minus the trash page)")
+            "usable KV pages (paged mode; pool minus the trash page)",
+            labels=labels)
         self.pages_in_use = reg.gauge(
             "tpu_worker_kv_pages_in_use",
-            "KV pages referenced by live requests")
+            "KV pages referenced by live requests", labels=labels)
         self.pages_cached = reg.gauge(
             "tpu_worker_kv_pages_cached",
-            "idle prefix-cache pages retained for future lookups")
+            "idle prefix-cache pages retained for future lookups",
+            labels=labels)
         self.prefix_hit_pages = reg.counter(
             "tpu_worker_prefix_hit_pages_total",
-            "prompt pages served from the prefix cache at admission")
+            "prompt pages served from the prefix cache at admission",
+            labels=labels)
         self.prefix_miss_pages = reg.counter(
             "tpu_worker_prefix_miss_pages_total",
-            "prompt pages prefilled cold")
+            "prompt pages prefilled cold", labels=labels)
 
 
 class WorkerTelemetry:

@@ -22,6 +22,55 @@
 #   ./scripts/tier1.sh --resilience additionally runs the OUT-OF-PROCESS
 #   preemption smoke below (real SIGTERM, real exit codes, real resume —
 #   the in-process pytest e2e can't observe the exit-status contract).
+#
+#   ./scripts/tier1.sh --serving runs the OUT-OF-PROCESS disaggregated
+#   prefill/decode A/B smoke: the same greedy trace through the
+#   colocated paged engine and the two-pool DisaggEngine, gated on
+#   token identity + the per-pool compile pins + actual KV handoffs.
+
+if [ "${1:-}" = "--serving" ]; then
+  # Disagg A/B smoke via the benchmark CLI (examples/serve_benchmark.py
+  # --disagg): one subprocess builds both engines from the same params,
+  # replays one trace through each, and prints a JSON line. On CPU the
+  # latency split is structural, so the gates are the CORRECTNESS
+  # contracts: greedy tokens bitwise-identical across modes, prefill
+  # pool compiled zero decode steps / decode pool zero prefills, and a
+  # nonzero handoff count (pages actually moved between pools).
+  set -u
+  dir=$(mktemp -d)
+  trap 'rm -rf "$dir"' EXIT
+  echo "== serving smoke: disagg vs colocated A/B =="
+  env JAX_PLATFORMS=cpu python -m mpi_operator_tpu.examples.serve_benchmark \
+    --disagg --size test --slots 4 --num-requests 8 --page-size 16 \
+    > "$dir/disagg.json" 2> "$dir/disagg.log"
+  rc=$?
+  if [ "$rc" -ne 0 ]; then
+    echo "FAIL: disagg benchmark exited $rc"
+    tail -20 "$dir/disagg.log"; exit 1
+  fi
+  if ! grep -q '"disagg_token_identical": true' "$dir/disagg.json"; then
+    echo "FAIL: disagg tokens differ from the colocated engine's"
+    cat "$dir/disagg.json"; exit 1
+  fi
+  if ! grep -q '"disagg_pool_pins_held": true' "$dir/disagg.json"; then
+    echo "FAIL: a pool compiled the other role's program"
+    cat "$dir/disagg.json"; exit 1
+  fi
+  if grep -q '"disagg_handoffs": 0' "$dir/disagg.json"; then
+    echo "FAIL: no KV handoffs — the A/B never crossed the pool boundary"
+    cat "$dir/disagg.json"; exit 1
+  fi
+  for key in disagg_kv_handoff_p50_ms disagg_kv_handoff_p99_ms \
+             disagg_ttft_p99_ms coloc_ttft_p99_ms; do
+    if ! grep -q "\"$key\":" "$dir/disagg.json"; then
+      echo "FAIL: missing $key in the benchmark JSON"
+      cat "$dir/disagg.json"; exit 1
+    fi
+  done
+  echo "serving smoke: OK (disagg A/B token-identical, pool pins held," \
+       "$(grep -o '"disagg_handoffs": [0-9]*' "$dir/disagg.json" | grep -o '[0-9]*') handoffs)"
+  exit 0
+fi
 
 if [ "${1:-}" = "--resilience" ]; then
   # Preemption smoke, four runs: (1) SIGTERM at step 5 → exit 215 +

@@ -19,6 +19,10 @@ from mpi_operator_tpu.utils.hostplatform import force_host_platform  # noqa: E40
 
 force_host_platform(8)
 
+# debug builds pay for the O(num_pages) PageAllocator.check() audit on
+# every engine reset(); production resets skip it (serve/engine.py)
+os.environ.setdefault("TPU_DEBUG_PAGES", "1")
+
 
 import pytest  # noqa: E402
 

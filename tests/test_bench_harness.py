@@ -114,3 +114,22 @@ def test_sigterm_flushes_summary_json(bench_procs):
         assert summary.get("gpt2_decode_tokens_per_sec", 0) > 0
     # (if the ladder won the race and finished first, the normal summary
     # satisfies the same contract: a parseable record, never a null)
+
+
+@pytest.mark.parametrize("argv", [
+    [BENCH],
+    ["-m", "mpi_operator_tpu.examples.lm_benchmark"],
+    ["-m", "mpi_operator_tpu.examples.serve_benchmark"],
+], ids=["bench", "lm_benchmark", "serve_benchmark"])
+def test_benchmark_cli_help_exits_zero(argv):
+    """`--help` on every benchmark entrypoint must exit 0 without
+    touching jax device state — a flag typo in an argparse block
+    otherwise surfaces only when a cluster run dies at parse time."""
+    env = os.environ.copy()
+    env["JAX_PLATFORMS"] = "cpu"
+    proc = subprocess.run(
+        [sys.executable, *argv, "--help"], cwd=REPO, env=env,
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        timeout=120)
+    assert proc.returncode == 0, proc.stdout
+    assert "usage" in proc.stdout.lower()

@@ -1824,3 +1824,178 @@ def test_packed_member_tears_down_pre_packing_resources():
     actions = f.run("default/b")
     assert ("delete", "StatefulSet") in verbs(actions)
     assert ("update-status", "TPUJob") in verbs(actions)
+
+# ---------------------------------------------------------------------------
+# disaggregated serving role pools (spec.serving; serve/engine.py DisaggEngine)
+# ---------------------------------------------------------------------------
+
+def _serving_job(name="test", tpus=16, prefill=3, decode=1, **kw):
+    return new_job(name=name, tpus=tpus,
+                   serving=api.ServingSpec(prefill_replicas=prefill,
+                                           decode_replicas=decode), **kw)
+
+
+def test_serving_stands_up_both_role_pools():
+    """One TPUJob spec materializes TWO worker StatefulSets — the
+    reference's heterogeneous-roles trick (launcher vs worker) extended
+    to prefill vs decode. Each pool carries its role + both pools' peer
+    addresses in env, and the pool label for per-pool federation."""
+    from mpi_operator_tpu.controller.controller import (
+        DECODE_SUFFIX, KV_TRANSFER_PORT, LABEL_SERVE_ROLE, PREFILL_SUFFIX,
+    )
+    f = Fixture()
+    f.seed(_serving_job())            # 16 chips / 4 per worker = 4 workers
+    f.run("default/test")
+    pre = f.api.get("StatefulSet", "default", "test" + PREFILL_SUFFIX)
+    dec = f.api.get("StatefulSet", "default", "test" + DECODE_SUFFIX)
+    assert pre.spec.replicas == 3 and dec.spec.replicas == 1
+    for sts, role in ((pre, "prefill"), (dec, "decode")):
+        env = sts.spec.template.main_container().env
+        assert env["TPU_SERVE_ROLE"] == role
+        assert env["TPU_SERVE_PREFILL_HOSTS"] == (
+            "test-prefill-0.test-worker.default.svc,"
+            "test-prefill-1.test-worker.default.svc,"
+            "test-prefill-2.test-worker.default.svc")
+        assert env["TPU_SERVE_DECODE_HOSTS"] == (
+            "test-decode-0.test-worker.default.svc")
+        assert env["TPU_SERVE_KV_PORT"] == str(KV_TRANSFER_PORT)
+        assert sts.spec.template.metadata.labels[LABEL_SERVE_ROLE] == role
+        # both pools still match the shared governing Service selector
+        assert sts.spec.template.metadata.labels["tpu_job_role"] == "worker"
+    # discovery data is prefill-major and records the split
+    cm = f.api.get("ConfigMap", "default", "test" + CONFIG_SUFFIX)
+    assert cm.data["worker-hostnames"].splitlines()[0].startswith(
+        "test-prefill-0.")
+    assert cm.data["serving-prefill-replicas"] == "3"
+    assert cm.data["serving-decode-replicas"] == "1"
+
+
+def test_serving_launcher_gated_on_both_pools():
+    """The readiness gate spans BOTH pools (total ready == worker
+    replicas); the launcher — the serving router — gets the peer host
+    lists but no role of its own."""
+    from mpi_operator_tpu.controller.controller import (
+        DECODE_SUFFIX, PREFILL_SUFFIX,
+    )
+    f = Fixture()
+    f.seed(_serving_job())
+    f.run("default/test")
+    _seed_ready_workers(f, "test" + PREFILL_SUFFIX, 3)
+    f.run("default/test")             # decode pool not Ready yet
+    from mpi_operator_tpu.cluster.apiserver import NotFoundError
+    with pytest.raises(NotFoundError):
+        f.api.get("Job", "default", "test" + LAUNCHER_SUFFIX)
+    _seed_ready_workers(f, "test" + DECODE_SUFFIX, 1)
+    f.run("default/test")
+    launcher = f.api.get("Job", "default", "test" + LAUNCHER_SUFFIX)
+    env = launcher.spec.template.main_container().env
+    assert "TPU_SERVE_ROLE" not in env
+    assert env["TPU_SERVE_PREFILL_HOSTS"].count("test-prefill-") == 3
+    assert env["TPU_SERVE_DECODE_HOSTS"] == (
+        "test-decode-0.test-worker.default.svc")
+
+
+def test_serving_pool_split_change_is_a_gang_restart():
+    """Re-partitioning 3/1 -> 2/2 at the same chip count changes every
+    pod's peer env — template drift on BOTH pools, so the change rides
+    the template hash as one ordinary level-triggered gang restart."""
+    from mpi_operator_tpu.controller.controller import (
+        DECODE_SUFFIX, PREFILL_SUFFIX,
+    )
+    f = Fixture()
+    f.seed(_serving_job())
+    f.run("default/test")
+    job = f.api.get(api.KIND, "default", "test")
+    job.spec.serving = api.ServingSpec(prefill_replicas=2, decode_replicas=2)
+    f.api.update(job)
+    f.run("default/test")
+    pre = f.api.get("StatefulSet", "default", "test" + PREFILL_SUFFIX)
+    dec = f.api.get("StatefulSet", "default", "test" + DECODE_SUFFIX)
+    assert pre.spec.replicas == 2 and dec.spec.replicas == 2
+    assert dec.spec.template.main_container().env[
+        "TPU_SERVE_DECODE_HOSTS"].count("test-decode-") == 2
+    assert any(e.reason == "TPUJobResized"
+               for e in f.controller.recorder.events)
+
+
+def test_serving_scales_down_both_pools_when_done():
+    from mpi_operator_tpu.controller.controller import (
+        DECODE_SUFFIX, PREFILL_SUFFIX,
+    )
+    f = Fixture()
+    f.seed(_serving_job())
+    f.run("default/test")
+    _seed_ready_workers(f, "test" + PREFILL_SUFFIX, 3)
+    _seed_ready_workers(f, "test" + DECODE_SUFFIX, 1)
+    f.run("default/test")                         # creates the launcher
+    launcher = f.api.get("Job", "default", "test" + LAUNCHER_SUFFIX)
+    launcher.status = JobStatus(succeeded=1, completion_time=123.0)
+    f.api.update(launcher)
+    f.run("default/test")
+    assert f.api.get("StatefulSet", "default",
+                     "test" + PREFILL_SUFFIX).spec.replicas == 0
+    assert f.api.get("StatefulSet", "default",
+                     "test" + DECODE_SUFFIX).spec.replicas == 0
+
+
+def test_serving_admission_rejects_bad_pool_split():
+    """Pool counts must re-partition the derived worker count exactly —
+    and serving composes with neither elastic nor packing."""
+    from mpi_operator_tpu.cluster.apiserver import InMemoryAPIServer as S
+    f = Fixture()
+    with pytest.raises(S.AdmissionError, match="prefillReplicas"):
+        # explicit per-worker: admission can derive 4 workers != 3 pooled
+        f.api.create(_serving_job(prefill=2, decode=1, tpus_per_worker=4))
+    with pytest.raises(S.AdmissionError, match="elastic"):
+        f.api.create(_serving_job(elastic=True))
+    with pytest.raises(S.AdmissionError, match="packGroup"):
+        f.api.create(_serving_job(pack_group="sweep"))
+    with pytest.raises(S.AdmissionError, match="decodeReplicas"):
+        f.api.create(_serving_job(prefill=4, decode=0))
+    # flag-default per-worker count: only the controller can derive the
+    # worker count — the backstop converges to Failed/InvalidTPUJobSpec
+    f.seed(_serving_job(prefill=2, decode=1))
+    f.run("default/test")
+    cond = f.api.get(api.KIND, "default", "test").status.get_condition(
+        api.COND_FAILED)
+    assert cond is not None and cond.reason == "InvalidTPUJobSpec"
+    assert "prefillReplicas" in cond.message
+
+
+# ---------------------------------------------------------------------------
+# pack-aware slice quota accounting (controller/packing.py slices_used)
+# ---------------------------------------------------------------------------
+
+def test_slice_quota_counts_packed_gang_once():
+    """Two packed members share ONE physical gang: quota accounting must
+    charge their slice once (via the leader), not once per member job —
+    the naive per-job sum overcharges by k-1 slices per gang."""
+    f = Fixture()
+    f.seed(_pack_job("a", 100.0))
+    f.seed(_pack_job("b", 200.0))
+    f.seed(new_job(name="solo", tpus=8))
+    assert f.controller.slices_in_use() == 2      # pack(a,b) + solo
+    # a member finishing doesn't change the count (its gang was never
+    # separately charged); the LEADER finishing releases the pack's slice
+    b = f.api.get(api.KIND, "default", "b")
+    b.status.set_condition(api.JobCondition(
+        api.COND_SUCCEEDED, "True", "Done", "done"))
+    f.api.update_status(b)
+    assert f.controller.slices_in_use() == 2
+    a = f.api.get(api.KIND, "default", "a")
+    a.status.set_condition(api.JobCondition(
+        api.COND_SUCCEEDED, "True", "Done", "done"))
+    f.api.update_status(a)
+    assert f.controller.slices_in_use() == 1      # solo only
+
+
+def test_slice_quota_multi_slice_and_metrics_surface():
+    """A multi-slice job charges num_slices; the gauge rides the operator
+    /metrics scrape so a cluster quota check can consume it."""
+    from mpi_operator_tpu.controller.metrics import render_metrics
+    f = Fixture()
+    f.seed(new_job(name="ms", tpus=16, num_slices=2))
+    f.seed(_pack_job("a", 100.0))
+    f.seed(_pack_job("b", 200.0))
+    assert f.controller.slices_in_use() == 3      # 2 + pack(a,b)
+    assert "tpu_operator_slices_in_use 3" in render_metrics(f.controller)

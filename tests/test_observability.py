@@ -416,3 +416,74 @@ def test_collector_cli_emit_merge_and_postmortem(tmp_path, capsys):
     assert postmortem.main(["--json", out]) == 0
     js = json.loads(capsys.readouterr().out)
     assert js["ledger"]["lost_steps"] == 3
+
+
+# ---------------------------------------------------------------------------
+# timeline rotation (TPU_TIMELINE_MAX_BYTES) + drain latency
+# ---------------------------------------------------------------------------
+
+def test_timeline_rotation_spans_chain(tmp_path, monkeypatch):
+    """With TPU_TIMELINE_MAX_BYTES set, write_timeline appends
+    incrementally (no duplicates across calls) and rotates through the
+    events.py .N chain; both read_events and postmortem.read_timeline
+    see every record across the generations."""
+    from mpi_operator_tpu.telemetry.events import event_files, read_events
+
+    monkeypatch.setenv("TPU_TIMELINE_MAX_BYTES", "600")
+    monkeypatch.setenv("TPU_TIMELINE_KEEP", "10")
+    obs = JobObservatory(events_dir=str(tmp_path), scrape_interval=0.0)
+    obs.note_created("j", tpus=8)
+    out = None
+    for step in range(40):
+        obs.record("j", "window_stats", step=step)
+        out = obs.write_timeline("j")
+    assert os.path.getsize(out) <= 600
+    assert len(event_files(out)) >= 2        # the cap actually rotated
+    for records in (read_events(out), postmortem.read_timeline(out)):
+        steps = [r["step"] for r in records
+                 if r.get("event") == "window_stats"]
+        assert sorted(steps) == list(range(40))   # complete, no dupes
+        assert any(r.get("event") == "job_created" for r in records)
+    obs.close()
+
+
+def test_timeline_uncapped_rewrite_unchanged(tmp_path, monkeypatch):
+    """Without the env cap the historical behaviour holds: one atomic
+    full rewrite per call, no .N files."""
+    from mpi_operator_tpu.telemetry.events import event_files
+
+    monkeypatch.delenv("TPU_TIMELINE_MAX_BYTES", raising=False)
+    obs = JobObservatory(events_dir=str(tmp_path), scrape_interval=0.0)
+    obs.note_created("j", tpus=8)
+    for step in range(10):
+        obs.record("j", "window_stats", step=step)
+        out = obs.write_timeline("j")
+    assert event_files(out) == [out]
+    assert len(postmortem.read_timeline(out)) == 11
+    obs.close()
+
+
+def test_postmortem_drain_latency(tmp_path, capsys):
+    """preemption_drain -> same host's next emergency_checkpoint delta
+    is computed per host and surfaced in both the summary and the
+    rendered report; an unpaired checkpoint gets no latency."""
+    path = tmp_path / "timeline.jsonl"
+    recs = [
+        _rec(0.0, "job_created", job="j", host="controller"),
+        _rec(1.0, "preemption_drain", step=5, host="w0"),
+        _rec(2.0, "emergency_checkpoint", step=7, host="w1"),  # unpaired
+        _rec(3.5, "emergency_checkpoint", step=5, host="w0"),
+        _rec(4.0, "job_failed", host="controller"),
+    ]
+    path.write_text("".join(json.dumps(r) + "\n" for r in recs))
+    summary = postmortem.summarize(postmortem.read_timeline(str(path)))
+    assert summary["drain_latencies"] == [
+        {"t": 3.5, "host": "w0", "seconds": 2.5}]
+    paired = [i for i in summary["incidents"]
+              if i.get("drain_seconds") is not None]
+    assert len(paired) == 1 and paired[0]["host"] == "w0"
+
+    assert postmortem.main([str(path)]) == 0
+    report = capsys.readouterr().out
+    assert "drain latency: 1 preemption drain(s)" in report
+    assert "(drain->ckpt 2.5s)" in report
