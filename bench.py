@@ -830,6 +830,52 @@ def main() -> None:
                 line["gpt2_hfta8_error"] = type(exc).__name__
                 emit_leg("gpt2_hfta8",
                          {"gpt2_hfta8_error": type(exc).__name__})
+        # Elastic gang resize (examples/elastic_benchmark.py): the full
+        # 4 -> 2 -> 4 drain -> gang_resize -> resharding-restore cycle
+        # with an oracle loss-parity gate. The phases are ALWAYS
+        # CPU-host subprocesses (they could not grab the TPU under this
+        # process's hold anyway), so the leg measures the resize
+        # machinery — drain/restore/recompile split and resume wall
+        # time — not chip throughput.
+        if not over_budget("gpt2_elastic"):
+            try:
+                from mpi_operator_tpu.examples.elastic_benchmark import (
+                    run_elastic_benchmark)
+                em = run_elastic_benchmark(
+                    log=lambda s: print(s, file=sys.stderr))
+                fields = {
+                    "gpt2_elastic_ok": em["ok"],
+                    "gpt2_elastic_resize_seconds":
+                        em.get("resize_seconds"),
+                    "gpt2_elastic_goodput": em.get("goodput"),
+                    "gpt2_elastic_token_identical":
+                        em.get("elastic_token_identical"),
+                    # resume wall = phase start -> exit for the two
+                    # post-resize incarnations (includes process boot)
+                    "gpt2_elastic_resume_wall_seconds": [
+                        p["wall_seconds"]
+                        for p in em.get("phases", [])[1:]],
+                }
+                worst = max((r for r in em.get("resizes") or []
+                             if "total_seconds" in r),
+                            key=lambda r: r["total_seconds"],
+                            default=None)
+                if worst is not None:
+                    for p in ("drain", "restore", "recompile"):
+                        if f"{p}_seconds" in worst:
+                            fields[f"gpt2_elastic_{p}_seconds"] = \
+                                worst[f"{p}_seconds"]
+                line.update(fields)
+                emit_leg("gpt2_elastic", fields)
+            except Exception as exc:  # noqa: BLE001
+                from mpi_operator_tpu.train.resilience import Preempted
+                if isinstance(exc, Preempted):
+                    raise
+                print(f"# gpt2_elastic bench leg failed: {exc!r}",
+                      file=sys.stderr)
+                line["gpt2_elastic_error"] = type(exc).__name__
+                emit_leg("gpt2_elastic",
+                         {"gpt2_elastic_error": type(exc).__name__})
         # the SAME decode suite as --workload generate — the driver
         # records only this default run, so a leg measured in one mode
         # but not here would be effectively unmeasured. Primary MBU

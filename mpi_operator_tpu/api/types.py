@@ -241,6 +241,16 @@ class TPUJobSpec:
     # valid v5e size down to 1 chip)
     min_tpus: Optional[int] = None
 
+    # User-driven gang resize (the imperative cousin of `elastic`):
+    # editing spec.resize to a valid v5e chip count reallocates the gang
+    # at that size — drain (stop bit -> emergency checkpoint -> exit
+    # 215) -> StatefulSet rescale -> re-bootstrap at the new world size,
+    # training resumed from the drained checkpoint via resharding
+    # restore (train/checkpoint.py restore_resharded). None = run at
+    # spec.tpus. Mode A (tpus) single-slice only; mutually exclusive
+    # with elastic / serving / pack_group.
+    resize: Optional[int] = None
+
     # Job packing opt-in (controller/packing.py): jobs sharing a
     # (namespace, pack_group) whose resource shape matches are fused onto
     # ONE shared worker gang — the oldest member leads and owns the pods;

@@ -250,6 +250,39 @@ def validate_spec(spec: TPUJobSpec,
                 f"{spec.tpus}"
             )
 
+    if spec.resize is not None:
+        # user-driven gang resize walks the same single-slice Mode A
+        # topology ladder the elastic controller does — but it is the
+        # USER steering the size, so it cannot share the job with the
+        # controller-driven rewrites
+        if spec.tpus is None:
+            errs.append(
+                "spec.resize requires the tpus sizing mode (the resize "
+                "target replaces spec.tpus on the v5e chip-count ladder)"
+            )
+        if spec.num_slices > 1:
+            errs.append(
+                f"spec.resize does not support numSlices="
+                f"{spec.num_slices} (> 1)"
+            )
+        if not _valid_tpu_count(spec.resize):
+            errs.append(
+                f"spec.resize={spec.resize} is not a valid v5e chip "
+                f"count {V5E_VALID_SLICE_CHIPS}"
+            )
+        if spec.elastic:
+            errs.append(
+                "spec.resize is incompatible with spec.elastic (two "
+                "drivers steering one gang size)")
+        if spec.serving is not None:
+            errs.append(
+                "spec.resize is incompatible with spec.serving (a resize "
+                "cannot preserve the fixed pool split)")
+        if spec.pack_group:
+            errs.append(
+                "spec.resize is incompatible with spec.packGroup (both "
+                "rewrite the worker topology)")
+
     if spec.serving is not None:
         # disaggregated-serving role pools (serve/engine.py DisaggEngine):
         # the pools re-partition the worker gang the sizing mode derives —

@@ -283,6 +283,7 @@ def _tpujob_spec_to_manifest(s: TPUJobSpec) -> dict:
         "restartPolicy": s.restart_policy,
         "elastic": s.elastic or None,
         "minTpus": s.min_tpus,
+        "resize": s.resize,
         "template": template_to_manifest(s.template),
     })
 
@@ -307,6 +308,7 @@ def _tpujob_spec_from_manifest(m: dict) -> TPUJobSpec:
         restart_policy=m.get("restartPolicy", "Never"),
         elastic=bool(m.get("elastic", False)),
         min_tpus=m.get("minTpus"),
+        resize=m.get("resize"),
         template=template_from_manifest(m.get("template") or {}),
     )
 

@@ -1002,7 +1002,14 @@ class TPUJobController:
             # cluster flag, ref :449-453). An elastic shrink overrides the
             # spec size through STATUS (the user's spec is never edited).
             total = spec.tpus
-            if spec.elastic and job.status.elastic_tpus is not None:
+            if spec.resize is not None:
+                # user-driven gang resize: the edited target replaces the
+                # spec size outright — the new world rides the worker
+                # template hash, so the next sync drains and re-bootstraps
+                # the gang at this size (validation guarantees a valid
+                # ladder count and no elastic/serving/packing conflict)
+                total = spec.resize
+            elif spec.elastic and job.status.elastic_tpus is not None:
                 total = job.status.elastic_tpus
             per_worker = (
                 spec.tpus_per_worker
@@ -1338,10 +1345,17 @@ class TPUJobController:
                     "worker topology changed; gang restarted on the new "
                     "template")
                 if self.observatory is not None:
+                    # spec.resize is the user steering the gang size —
+                    # that lands in the timeline as gang_resize (the
+                    # resize_seconds ledger keys off it); every other
+                    # template drift stays the plain elastic resize event
+                    fields = {"replicas": alloc.worker_replicas,
+                              "num_slices": alloc.num_slices}
+                    if job.spec.resize is not None:
+                        fields["tpus"] = job.spec.resize
                     self.observatory.note_resize(
                         job.metadata.name,
-                        replicas=alloc.worker_replicas,
-                        num_slices=alloc.num_slices)
+                        gang=job.spec.resize is not None, **fields)
             else:
                 # the restart did NOT happen this sync — the stale hash
                 # annotations make the next sync retry; say so instead of
@@ -1671,12 +1685,14 @@ class TPUJobController:
                 NS_ACCELERATOR: job.spec.accelerator_type,
             }
             topo = job.spec.slice_topology
-            if job.spec.elastic and job.status.elastic_tpus is not None \
-                    and topo:
-                # the shrunken world must not stay pinned to the FULL
-                # size's topology nodepool (that's exactly the capacity
-                # that's gone) — recompute for the degraded chip count,
-                # or drop the selector if no canonical shape exists
+            if topo and (job.spec.resize is not None
+                         or (job.spec.elastic
+                             and job.status.elastic_tpus is not None)):
+                # the resized/shrunken world must not stay pinned to the
+                # FULL size's topology nodepool (for an elastic shrink
+                # that's exactly the capacity that's gone) — recompute
+                # for the new chip count, or drop the selector if no
+                # canonical shape exists
                 from ..api.validation import V5E_TOPOLOGIES
                 shapes = V5E_TOPOLOGIES.get(
                     alloc.worker_replicas * alloc.units_per_worker)

@@ -246,8 +246,8 @@ def run_lm_benchmark(
                                        schedule=pp_schedule,
                                        interleave=pp_interleave)
         pp_state = pp_trainer.init_state(jax.random.PRNGKey(0))
-        from ..train.checkpoint import (maybe_resume, maybe_save,
-                                        wait_for_checkpoints)
+        from ..train.checkpoint import (last_restore_info, maybe_resume,
+                                        maybe_save, wait_for_checkpoints)
         pp_resilience = ResilienceContext(
             ResilienceConfig.from_env(train_dir=train_dir,
                                       divergence_k=divergence_k,
@@ -261,7 +261,12 @@ def run_lm_benchmark(
             maybe_resume(train_dir, pp_trainer.canonical_state(pp_state),
                          log))
         pp_resumed_step = int(pp_state.step)
-        pp_resilience.record_restore(pp_resumed_step)
+        pp_info = last_restore_info()
+        pp_resilience.record_restore(pp_resumed_step,
+                                     path=pp_info.get("path"),
+                                     seconds=pp_info.get("seconds"),
+                                     leaves=pp_info.get("leaves"),
+                                     resharded=pp_info.get("resharded"))
         if stop_at_step is not None:
             remaining = (stop_at_step - pp_resumed_step
                          - max(1, warmup_steps))
@@ -372,8 +377,8 @@ def run_lm_benchmark(
     trainer = LMTrainer(model, mesh, tcfg)
     state = trainer.init_state(jax.random.PRNGKey(0))
 
-    from ..train.checkpoint import (maybe_resume, maybe_save,
-                                        wait_for_checkpoints)
+    from ..train.checkpoint import (last_restore_info, maybe_resume,
+                                    maybe_save, wait_for_checkpoints)
     resilience = ResilienceContext(
         ResilienceConfig.from_env(train_dir=train_dir,
                                   divergence_k=divergence_k,
@@ -386,7 +391,12 @@ def run_lm_benchmark(
     try:
         state = maybe_resume(train_dir, state, log)
         resumed_step = int(state.step)
-        resilience.record_restore(resumed_step)
+        restore_info = last_restore_info()
+        resilience.record_restore(resumed_step,
+                                  path=restore_info.get("path"),
+                                  seconds=restore_info.get("seconds"),
+                                  leaves=restore_info.get("leaves"),
+                                  resharded=restore_info.get("resharded"))
         if stop_at_step is not None:
             # finish at the same GLOBAL step the uninterrupted run would
             # have: warmup batches advance the step counter too
@@ -1083,6 +1093,13 @@ def main(argv=None) -> int:
             headline = {"metric": f"{args.workload}_tokens_per_sec",
                         "value": round(metrics["tokens_per_sec"], 0),
                         "unit": "tokens/sec"}
+            if "final_loss" in metrics:
+                # the elastic orchestrator gates resumed-vs-oracle loss
+                # parity on this field (examples/elastic_benchmark.py)
+                headline["final_loss"] = round(
+                    float(metrics["final_loss"]), 6)
+            if "steps" in metrics:
+                headline["steps"] = int(metrics["steps"])
         if info.is_coordinator:
             print(json.dumps(headline))
         exit_code = 0

@@ -5,7 +5,7 @@ from .mesh import (  # noqa: F401
 from . import collectives  # noqa: F401
 from .sharding import (  # noqa: F401
     DEFAULT_RULES, logical_sharding, logical_to_spec, param_shardings,
-    shard_init,
+    path_match, shard_init, sharding_for_path, spec_for_path,
 )
 from .ring_attention import ring_attention, ring_attention_inner  # noqa: F401
 from .pipeline import (pipeline_apply, stack_stage_params, stack_lm_params,  # noqa: F401

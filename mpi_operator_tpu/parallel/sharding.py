@@ -26,6 +26,7 @@ XLA insert the collectives.
 from __future__ import annotations
 
 import contextvars
+import re
 from typing import Any, Optional, Sequence, Tuple
 
 import jax
@@ -212,6 +213,53 @@ def unbox(variables):
     return meta.unbox(variables)
 
 
+# ---------------------------------------------------------------------------
+# Regex restore rules — PartitionSpecs keyed by checkpoint tree path
+# ---------------------------------------------------------------------------
+# The logical-axis rules above govern params the MODEL annotates. A
+# resharding restore (train/checkpoint.restore_resharded) works on the
+# CHECKPOINT's tree paths instead — e.g. ("params", "blocks_0", "attn",
+# "kernel") — because a checkpoint written by someone else's run carries
+# no logical axis metadata, only names. Restore rules are (patterns,
+# PartitionSpec) pairs: `patterns` is a tuple of regexes matched as a
+# contiguous window anywhere along the flattened path (the t5x/flaxformer
+# idiom), first hit wins.
+
+def path_match(qs: Sequence[str], ks: Sequence[str]) -> bool:
+    """True when the regex window `qs` matches a contiguous run of path
+    components `ks` (each pattern is anchored with a trailing ``$``)."""
+    qts = tuple(re.compile(x + "$") for x in qs)
+    for i in range(len(ks) - len(qts) + 1):
+        window = [q.match(k) for q, k in zip(qts, ks[i:])]
+        if window and all(window):
+            return True
+    return False
+
+
+def spec_for_path(path: Sequence[str], rules, default=None) -> Optional[P]:
+    """Resolve a checkpoint tree path against restore rules; `rules` is a
+    sequence of ((pattern, ...), PartitionSpec-or-None) pairs. None in
+    the spec slot means replicate. Falls through to `default` (usually
+    the target state's own sharding, signalled by None)."""
+    ks = tuple(str(k) for k in path)
+    for qs, spec in rules or ():
+        if path_match(tuple(qs), ks):
+            return spec if spec is not None else P()
+    return default
+
+
+def sharding_for_path(mesh: Mesh, path: Sequence[str], rules, shape,
+                      default: Optional[NamedSharding] = None
+                      ) -> Optional[NamedSharding]:
+    """NamedSharding for one checkpoint leaf: first matching restore rule
+    wins (downgraded to replication on non-divisible dims, same policy as
+    param_shardings); no rule hit returns `default`."""
+    spec = spec_for_path(path, rules)
+    if spec is None:
+        return default
+    return NamedSharding(mesh, _divisible_spec(mesh, spec, shape))
+
+
 def shard_init(model: nn.Module, mesh: Mesh, rng, *init_args,
                rules=DEFAULT_RULES, **init_kwargs):
     """Initialize a logically-annotated model with every parameter created
@@ -239,5 +287,6 @@ def shard_init(model: nn.Module, mesh: Mesh, rng, *init_args,
 
 __all__ = ["DEFAULT_RULES", "ACTIVATION_RULES", "activation_rules_scope",
            "current_mesh", "logical_to_spec", "logical_sharding",
-           "param_shardings", "shard_init", "tp_manual_spec",
+           "param_shardings", "path_match", "shard_init",
+           "sharding_for_path", "spec_for_path", "tp_manual_spec",
            "tp_overlap_activation_spec", "unbox"]

@@ -35,13 +35,14 @@ import sys
 from typing import Dict, List, Optional, Sequence, TextIO
 
 from .telemetry import events as ev
-from .telemetry.collector import goodput_ledger
+from .telemetry.collector import goodput_ledger, resize_ledger
+from .train.resilience import suggest_stop_check_every
 
 #: milestone kinds, i.e. records that OPEN a new lifecycle phase; every
 #: other record is an incident inside the current phase
 MILESTONES = (
     ev.JOB_CREATED, ev.PODS_READY, ev.FIRST_STEP_OBSERVED,
-    ev.JOB_PACKED, ev.JOB_RESIZED, ev.GANG_RESTART,
+    ev.JOB_PACKED, ev.JOB_RESIZED, ev.GANG_RESIZE, ev.GANG_RESTART,
     ev.RUN_COMPLETE, ev.JOB_SUCCEEDED, ev.JOB_FAILED,
 )
 
@@ -49,13 +50,14 @@ MILESTONES = (
 #: stats, slot churn — is summarized as a count)
 INCIDENTS = (
     ev.PREEMPTION_DRAIN, ev.EMERGENCY_CHECKPOINT, ev.CHECKPOINT_RESTORE,
-    ev.CHECKPOINT_SAVED, ev.DIVERGENCE_ROLLBACK, ev.FAULT_INJECTED,
-    ev.REPLICA_FROZEN, ev.INIT_RETRY, ev.CLOCK_ANCHOR,
+    ev.CHECKPOINT_SAVED, ev.FIRST_RESUME_STEP, ev.DIVERGENCE_ROLLBACK,
+    ev.FAULT_INJECTED, ev.REPLICA_FROZEN, ev.INIT_RETRY, ev.CLOCK_ANCHOR,
 )
 
 _DETAIL_FIELDS = ("step", "from_step", "to_step", "last_observed_step",
                   "exit_code", "restart", "replicas", "num_slices", "tpus",
-                  "k", "fault", "signal", "path", "boot_id")
+                  "workers", "k", "fault", "signal", "seconds", "leaves",
+                  "resharded", "stop_check_every", "path", "boot_id")
 
 
 def read_timeline(path: str) -> List[Dict]:
@@ -115,8 +117,10 @@ def summarize(records: Sequence[Dict]) -> Dict:
     last_milestone_ts = t0
     # drain latency: preemption_drain -> the same host's next
     # emergency_checkpoint — the window the grace period has to cover;
-    # the delta lands on the checkpoint's incident entry
-    drain_open: Dict[str, float] = {}
+    # the delta lands on the checkpoint's incident entry. The drain
+    # record carries the stop_check_every cadence it ran under, so the
+    # report can suggest a better one (see render).
+    drain_open: Dict[str, Dict] = {}
     drain_latencies: List[Dict] = []
     for rec in records:
         kind = rec.get("event")
@@ -127,15 +131,20 @@ def summarize(records: Sequence[Dict]) -> Dict:
             "detail": _fmt_detail(rec),
         }
         if kind == ev.PREEMPTION_DRAIN:
-            drain_open[entry["host"]] = rec.get("ts", t0)
+            drain_open[entry["host"]] = {
+                "ts": rec.get("ts", t0),
+                "stop_check_every": rec.get("stop_check_every"),
+            }
         elif kind == ev.EMERGENCY_CHECKPOINT \
                 and entry["host"] in drain_open:
-            seconds = round(rec.get("ts", t0)
-                            - drain_open.pop(entry["host"]), 3)
+            opened = drain_open.pop(entry["host"])
+            seconds = round(rec.get("ts", t0) - opened["ts"], 3)
             entry["drain_seconds"] = seconds
-            drain_latencies.append({"t": entry["t"],
-                                    "host": entry["host"],
-                                    "seconds": seconds})
+            latency = {"t": entry["t"], "host": entry["host"],
+                       "seconds": seconds}
+            if opened["stop_check_every"] is not None:
+                latency["stop_check_every"] = opened["stop_check_every"]
+            drain_latencies.append(latency)
         if kind in MILESTONES:
             # the duration of the phase this milestone CLOSES
             entry["phase_seconds"] = round(rec.get("ts", t0)
@@ -146,6 +155,20 @@ def summarize(records: Sequence[Dict]) -> Dict:
             incidents.append(entry)
         else:
             other[str(kind)] = other.get(str(kind), 0) + 1
+    # auto-tune hint: scale the cadence the worst drain actually ran
+    # under so that the next drain lands near the target latency
+    suggested = None
+    paced = [d for d in drain_latencies if "stop_check_every" in d]
+    if paced:
+        worst = max(paced, key=lambda d: d["seconds"])
+        suggested = suggest_stop_check_every(worst["seconds"],
+                                             worst["stop_check_every"])
+    resizes = []
+    for r in resize_ledger(records):
+        r = dict(r)
+        r["t"] = round(r.pop("ts") - t0, 3)
+        r.pop("drain_start_ts", None)
+        resizes.append(r)
     return {
         "records": len(records),
         "span_seconds": round(records[-1].get("ts", t0) - t0, 3),
@@ -154,6 +177,8 @@ def summarize(records: Sequence[Dict]) -> Dict:
         "milestones": milestones,
         "incidents": incidents,
         "drain_latencies": drain_latencies,
+        "suggested_stop_check_every": suggested,
+        "resizes": resizes,
         "other_events": other,
         "ledger": goodput_ledger(records),
     }
@@ -183,6 +208,26 @@ def render(summary: Dict, out: TextIO) -> None:
         out.write(f"  drain latency: {len(drains)} preemption drain(s) "
                   f"reached the emergency checkpoint, worst "
                   f"{_fmt_duration(worst)}\n")
+        suggested = summary.get("suggested_stop_check_every")
+        if suggested is not None:
+            out.write(f"  suggested --stop-check-every: {suggested}  "
+                      f"(or TPU_STOP_CHECK_EVERY=auto to derive it from "
+                      f"this run's events.jsonl)\n")
+
+    resizes = summary.get("resizes") or []
+    if resizes:
+        out.write("\ngang resizes:\n")
+        for r in resizes:
+            t = r["t"]
+            size = "".join(f"  {k}={r[k]}" for k in
+                           ("workers", "tpus", "replicas") if k in r)
+            phases = "  ".join(
+                f"{p}={_fmt_duration(r[f'{p}_seconds'])}"
+                for p in ("drain", "restore", "recompile")
+                if f"{p}_seconds" in r)
+            total = (f"  total {_fmt_duration(r['total_seconds'])}"
+                     if "total_seconds" in r else "  (never resumed)")
+            out.write(f"  resize at t={t:.3f}s{size}  [{phases}]{total}\n")
 
     if summary["incidents"]:
         out.write("\nincidents:\n")

@@ -2,7 +2,8 @@
 job-level collector, and XProf span annotations. See core.py for the
 design constraints and collector.py for the operator-side job view."""
 from .collector import (ClockSync, JobObservatory, MetricsFederation,
-                        goodput_ledger, merge_timeline, parse_prometheus)
+                        goodput_ledger, merge_timeline, parse_prometheus,
+                        resize_ledger, resize_lines)
 from .core import Counter, Gauge, Histogram, Registry
 from .events import (BoundEventLog, EventLog, read_events,
                      PREEMPTION_DRAIN, EMERGENCY_CHECKPOINT,
@@ -11,6 +12,7 @@ from .events import (BoundEventLog, EventLog, read_events,
                      CLOCK_ANCHOR, FAULT_INJECTED, REPLICA_FROZEN,
                      RUN_COMPLETE, JOB_CREATED, GANG_RESTART, PODS_READY,
                      FIRST_STEP_OBSERVED, JOB_PACKED, JOB_RESIZED,
+                     GANG_RESIZE, FIRST_RESUME_STEP,
                      JOB_SUCCEEDED, JOB_FAILED)
 from .prometheus import (CONTENT_TYPE, TelemetryServer, escape_label_value,
                          format_value, histogram_lines, render_registry)
@@ -19,7 +21,7 @@ from .worker import ServeTelemetry, TrainTelemetry, WorkerTelemetry
 
 __all__ = [
     "ClockSync", "JobObservatory", "MetricsFederation", "goodput_ledger",
-    "merge_timeline", "parse_prometheus",
+    "merge_timeline", "parse_prometheus", "resize_ledger", "resize_lines",
     "Counter", "Gauge", "Histogram", "Registry",
     "BoundEventLog", "EventLog", "read_events",
     "PREEMPTION_DRAIN", "EMERGENCY_CHECKPOINT",
@@ -27,7 +29,8 @@ __all__ = [
     "CHECKPOINT_RESTORE", "CHECKPOINT_SAVED", "CLOCK_ANCHOR",
     "FAULT_INJECTED", "REPLICA_FROZEN", "RUN_COMPLETE", "JOB_CREATED",
     "GANG_RESTART", "PODS_READY", "FIRST_STEP_OBSERVED", "JOB_PACKED",
-    "JOB_RESIZED", "JOB_SUCCEEDED", "JOB_FAILED",
+    "JOB_RESIZED", "GANG_RESIZE", "FIRST_RESUME_STEP",
+    "JOB_SUCCEEDED", "JOB_FAILED",
     "CONTENT_TYPE", "TelemetryServer", "escape_label_value", "format_value",
     "histogram_lines", "render_registry",
     "span",

@@ -429,6 +429,119 @@ def goodput_ledger(records: Iterable[Dict]) -> Dict:
             "rollbacks": rollbacks}
 
 
+def resize_ledger(records: Iterable[Dict]) -> List[Dict]:
+    """Split each ``gang_resize`` into drain / restore / recompile phases.
+
+    drain     = the preemption_drain -> emergency_checkpoint wall time of
+                the drain that handed the gang over to the resize;
+    restore   = the first post-resize checkpoint_restore's ``seconds``
+                (shard read + assembly, resharded or not);
+    recompile = the first post-resume step's ``seconds``
+                (first_resume_step: restore-done -> step completion, i.e.
+                jit recompilation at the new world size plus one step);
+    total     = drain start -> first post-resume step completion — the
+                goodput hole the resize punched into the run.
+    Entries missing a phase (job died mid-resize) keep whatever phases
+    were observed; ``total_seconds`` is only set once the gang stepped."""
+    resizes: List[Dict] = []
+    drain_open: Optional[float] = None
+    last_drain: Optional[Tuple[float, float]] = None
+    current: Optional[Dict] = None
+    for rec in sorted(records, key=lambda r: r.get("ts", 0.0)):
+        kind = rec.get("event")
+        ts = rec.get("ts", 0.0)
+        if kind == ev.PREEMPTION_DRAIN:
+            drain_open = ts
+        elif kind == ev.EMERGENCY_CHECKPOINT and drain_open is not None:
+            last_drain = (drain_open, round(ts - drain_open, 3))
+            drain_open = None
+        elif kind == ev.GANG_RESIZE:
+            if current is not None:
+                resizes.append(current)
+            current = {"ts": ts}
+            for key in ("workers", "tpus", "replicas", "num_slices",
+                        "reason"):
+                if key in rec:
+                    current[key] = rec[key]
+            if last_drain is not None:
+                current["drain_start_ts"] = last_drain[0]
+                current["drain_seconds"] = last_drain[1]
+                last_drain = None
+        elif (current is not None and kind == ev.CHECKPOINT_RESTORE
+              and "restore_seconds" not in current):
+            try:
+                current["restore_seconds"] = float(rec["seconds"])
+            except (KeyError, TypeError, ValueError):
+                pass
+        elif current is not None and kind == ev.FIRST_RESUME_STEP:
+            try:
+                current["recompile_seconds"] = float(rec["seconds"])
+            except (KeyError, TypeError, ValueError):
+                pass
+            start = current.get("drain_start_ts", current["ts"])
+            current["total_seconds"] = round(ts - start, 3)
+            resizes.append(current)
+            current = None
+    if current is not None:
+        resizes.append(current)
+    return resizes
+
+
+#: log-spaced upper bounds for tpu_job_resize_seconds. A resize is drain
+#: + restore + recompile: sub-second on toy runs, minutes when a large
+#: model recompiles, so the buckets span both regimes.
+RESIZE_BUCKETS = (1.0, 2.5, 5.0, 10.0, 30.0, 60.0, 120.0, 300.0, 600.0)
+
+
+def resize_lines(job: str, resizes: List[Dict],
+                 extra_labels: Optional[Dict[str, str]] = None) -> List[str]:
+    """Render the resize ledger as Prometheus text: one
+    tpu_job_resize_seconds histogram over completed resizes plus
+    per-phase gauges for the most recent one."""
+    labels = {"job": job, **(extra_labels or {})}
+
+    def ls(extra: Optional[Dict[str, str]] = None) -> str:
+        merged = {**labels, **(extra or {})}
+        inner = ",".join(f'{k}="{escape_label_value(v)}"'
+                         for k, v in merged.items())
+        return "{" + inner + "}"
+
+    totals = sorted(float(r["total_seconds"]) for r in resizes
+                    if "total_seconds" in r)
+    lines = [
+        "# HELP tpu_job_resize_seconds wall time of a gang resize, drain "
+        "start to first post-resume step",
+        "# TYPE tpu_job_resize_seconds histogram",
+    ]
+    for bound in RESIZE_BUCKETS:
+        count = sum(1 for t in totals if t <= bound)
+        lines.append(f'tpu_job_resize_seconds_bucket{ls({"le": repr(bound)})}'
+                     f" {count}")
+    lines.append(f'tpu_job_resize_seconds_bucket{ls({"le": "+Inf"})}'
+                 f" {len(totals)}")
+    lines.append(f"tpu_job_resize_seconds_sum{ls()}"
+                 f" {format_value(round(sum(totals), 3))}")
+    lines.append(f"tpu_job_resize_seconds_count{ls()} {len(totals)}")
+    lines += [
+        "# HELP tpu_job_resizes_total gang resizes observed",
+        "# TYPE tpu_job_resizes_total counter",
+        f"tpu_job_resizes_total{ls()} {len(resizes)}",
+    ]
+    for phase in ("drain", "restore", "recompile"):
+        key = f"{phase}_seconds"
+        value = next((r[key] for r in reversed(resizes) if key in r), None)
+        if value is None:
+            continue
+        lines += [
+            f"# HELP tpu_job_resize_{key} {phase} phase of the most "
+            "recent gang resize",
+            f"# TYPE tpu_job_resize_{key} gauge",
+            f"tpu_job_resize_{key}{ls()} "
+            f"{format_value(round(float(value), 3))}",
+        ]
+    return lines
+
+
 def ledger_lines(job: str, ledger: Dict,
                  extra_labels: Optional[Dict[str, str]] = None) -> List[str]:
     labels = {"job": job, **(extra_labels or {})}
@@ -539,8 +652,12 @@ class JobObservatory:
             view["federation"].extra_labels.update(view["labels"])
             self.record(job, ev.JOB_PACKED, members=members, k=k)
 
-    def note_resize(self, job: str, **fields) -> None:
-        self.record(job, ev.JOB_RESIZED, **fields)
+    def note_resize(self, job: str, gang: bool = False, **fields) -> None:
+        # gang=True is a user-driven spec.resize (a deliberate gang
+        # resize: drain -> rescale -> resharded resume); False is the
+        # elastic controller shrinking/growing around capacity.
+        self.record(job, ev.GANG_RESIZE if gang else ev.JOB_RESIZED,
+                    **fields)
 
     def note_terminal(self, job: str, succeeded: bool, **fields) -> None:
         view = self.view(job)
@@ -665,10 +782,14 @@ class JobObservatory:
         lines: List[str] = []
         for job in sorted(self.jobs):
             view = self.jobs[job]
+            merged = self.merged_records(job)
             lines += view["federation"].render_lines()
-            lines += ledger_lines(job,
-                                  goodput_ledger(self.merged_records(job)),
+            lines += ledger_lines(job, goodput_ledger(merged),
                                   extra_labels=view["labels"])
+            resizes = resize_ledger(merged)
+            if resizes:
+                lines += resize_lines(job, resizes,
+                                      extra_labels=view["labels"])
         return lines
 
     def render(self) -> str:
@@ -742,11 +863,15 @@ def main(argv: Optional[List[str]] = None) -> int:
     offsets = {k: float(v) for k, v in _parse_kv(args.offset).items()}
     merged = merge_timeline(sources, offsets=offsets, out_path=args.out)
     ledger = goodput_ledger(merged)
+    resizes = resize_ledger(merged)
     if args.metrics_out:
+        lines = ledger_lines(args.job, ledger)
+        if resizes:
+            lines += resize_lines(args.job, resizes)
         with open(args.metrics_out, "w", encoding="utf-8") as fh:
-            fh.write("\n".join(ledger_lines(args.job, ledger)) + "\n")
+            fh.write("\n".join(lines) + "\n")
     print(json.dumps({"job": args.job, "records": len(merged),
-                      "timeline": args.out, **ledger}))
+                      "timeline": args.out, "resizes": resizes, **ledger}))
     return 0
 
 
@@ -756,4 +881,5 @@ if __name__ == "__main__":
 
 __all__ = ["parse_prometheus", "MetricsFederation", "ClockSync",
            "merge_timeline", "goodput_ledger", "ledger_lines",
+           "resize_ledger", "resize_lines", "RESIZE_BUCKETS",
            "JobObservatory", "latest_boot_id", "main"]

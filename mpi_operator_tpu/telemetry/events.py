@@ -43,6 +43,10 @@ SLOT_ADMIT = "slot_admit"
 SLOT_RETIRE = "slot_retire"
 CHECKPOINT_RESTORE = "checkpoint_restore"
 CHECKPOINT_SAVED = "checkpoint_saved"
+# first step completed after a restore (resilience.ResilienceContext):
+# carries seconds-since-restore, i.e. the recompile phase of a resume —
+# restore_done -> first post-resume step, compile time included
+FIRST_RESUME_STEP = "first_resume_step"
 CLOCK_ANCHOR = "clock_anchor"
 FAULT_INJECTED = "fault_injected"
 REPLICA_FROZEN = "replica_frozen"
@@ -58,6 +62,11 @@ PODS_READY = "pods_ready"
 FIRST_STEP_OBSERVED = "first_step_observed"
 JOB_PACKED = "packed"
 JOB_RESIZED = "resize"
+# user-driven gang resize (spec.resize / worker-count edit): the drain ->
+# rescale -> re-bootstrap cycle, distinct from the capacity-driven
+# elastic JOB_RESIZED shrink above. scripts/tier1.sh --elastic greps for
+# this literal.
+GANG_RESIZE = "gang_resize"
 JOB_SUCCEEDED = "job_succeeded"
 JOB_FAILED = "job_failed"
 
@@ -272,4 +281,5 @@ __all__ = ["EventLog", "BoundEventLog", "read_events", "event_files",
            "CHECKPOINT_SAVED", "CLOCK_ANCHOR", "FAULT_INJECTED",
            "REPLICA_FROZEN", "RUN_COMPLETE", "JOB_CREATED",
            "GANG_RESTART", "PODS_READY", "FIRST_STEP_OBSERVED",
-           "JOB_PACKED", "JOB_RESIZED", "JOB_SUCCEEDED", "JOB_FAILED"]
+           "JOB_PACKED", "JOB_RESIZED", "GANG_RESIZE",
+           "FIRST_RESUME_STEP", "JOB_SUCCEEDED", "JOB_FAILED"]

@@ -21,6 +21,12 @@ Train series (LMTrainer / Trainer / PipelineLMTrainer benchmark loops):
   last_checkpoint_step    gauge     — newest durable checkpoint step
   restore_step            gauge     — step this incarnation restored
                                       from (0 when fresh)
+  restore_seconds         gauge     — wall seconds the restore took
+                                      (parallel resharded reads included)
+  resume_step_seconds     gauge     — restore-done → first post-resume
+                                      step (the recompile phase of a
+                                      gang resize; collector folds it
+                                      into tpu_job_resize_seconds)
   steps_total             counter   — steps executed
   skipped_steps_total     counter   — divergence-guard skipped (lower
                                       bound: streaks are sampled at
@@ -122,6 +128,15 @@ class TrainTelemetry:
         self.restore_step = reg.gauge(
             "tpu_worker_restore_step",
             "global step this incarnation restored from (0 = fresh)",
+            labels=labels)
+        self.restore_seconds = reg.gauge(
+            "tpu_worker_restore_seconds",
+            "wall seconds this incarnation's checkpoint restore took",
+            labels=labels)
+        self.resume_step_seconds = reg.gauge(
+            "tpu_worker_resume_step_seconds",
+            "restore-done to first post-resume step wall seconds "
+            "(compile included)",
             labels=labels)
         self.steps_total = reg.counter(
             "tpu_worker_steps_total", "train steps executed",

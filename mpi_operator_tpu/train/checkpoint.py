@@ -12,9 +12,14 @@ from __future__ import annotations
 
 import os
 import shutil
-from typing import Any, List, Optional, Tuple
+import threading
+import time
+from concurrent.futures import ThreadPoolExecutor
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import jax
+import numpy as np
 import orbax.checkpoint as ocp
 
 from ..telemetry import span
@@ -164,21 +169,259 @@ def restore_checkpoint(directory_or_path: str, state):
     return state.replace(**fields)
 
 
-__all__ = ["save_checkpoint", "restore_checkpoint", "latest_checkpoint",
+# ---------------------------------------------------------------------------
+# Resharding restore — load a checkpoint saved on mesh (dp=N) into mesh
+# (dp=M) by resharding on READ
+# ---------------------------------------------------------------------------
+# orbax's StandardSave writes an OCDBT kvstore in which every pytree leaf
+# is its own zarr array, keyed by the dot-joined tree path
+# ("params.blocks_0.attn.kernel") and chunked exactly along the
+# SAVE-time shard boundaries. restore_resharded exploits that layout
+# directly through tensorstore: each host opens only the leaves it needs,
+# reads only the index domains of its NEW shards (tensorstore touches
+# just the chunks — byte ranges — that overlap), and assembles the
+# jax.Array from per-device buffers. No host ever materializes a full
+# replica of a sharded leaf, and a thread pool overlaps the per-leaf
+# reads — the fast-resume path a gang resize (4 -> 2 -> 4) rides.
+
+#: opt-in env knob for maybe_resume: "1"/"true" routes the shared resume
+#: path through restore_resharded (with a per-candidate orbax fallback)
+ENV_RESHARD_RESTORE = "TPU_RESHARD_RESTORE"
+#: thread-pool width for the per-leaf parallel reads (0/unset = auto)
+ENV_RESTORE_THREADS = "TPU_RESTORE_THREADS"
+
+
+@dataclass
+class ReadStats:
+    """Instrumentation for one restore_resharded call.
+    `peak_in_flight_bytes` is the memory contract a test can pin: the
+    high-water mark of shard bytes materialized on THIS host at any
+    instant, which must stay well under `total_bytes` (the full
+    unsharded tree) whenever the target is actually sharded."""
+    leaves: int = 0
+    reads: int = 0
+    bytes_read: int = 0
+    total_bytes: int = 0
+    in_flight_bytes: int = 0
+    peak_in_flight_bytes: int = 0
+    seconds: float = 0.0
+    _lock: threading.Lock = field(default_factory=threading.Lock,
+                                  repr=False)
+
+    def begin_read(self, nbytes: int) -> None:
+        with self._lock:
+            self.reads += 1
+            self.bytes_read += nbytes
+            self.in_flight_bytes += nbytes
+            self.peak_in_flight_bytes = max(self.peak_in_flight_bytes,
+                                            self.in_flight_bytes)
+
+    def end_read(self, nbytes: int) -> None:
+        with self._lock:
+            self.in_flight_bytes -= nbytes
+
+
+#: last restore's stats/info, for telemetry plumbing (the benchmark
+#: reports restore seconds + leaf count without threading a handle
+#: through every call site). Overwritten per restore; read via
+#: last_restore_info().
+_LAST_RESTORE_INFO: Dict[str, Any] = {}
+
+
+def last_restore_info() -> Dict[str, Any]:
+    """{"path", "seconds", "leaves", "resharded", ...} of the most recent
+    successful restore in this process (empty dict when none)."""
+    return dict(_LAST_RESTORE_INFO)
+
+
+def _path_components(key_path) -> Tuple[str, ...]:
+    """jax key path -> checkpoint tree path components, matching orbax's
+    OCDBT naming: dict keys by name, sequence entries by index,
+    namedtuple fields by field name."""
+    out = []
+    for entry in key_path:
+        if hasattr(entry, "key"):          # DictKey / FlattenedIndexKey
+            out.append(str(entry.key))
+        elif hasattr(entry, "idx"):        # SequenceKey
+            out.append(str(entry.idx))
+        elif hasattr(entry, "name"):       # GetAttrKey (namedtuple field)
+            out.append(str(entry.name))
+        else:
+            out.append(str(entry))
+    return tuple(out)
+
+
+def _restore_threads(n_leaves: int) -> int:
+    raw = os.environ.get(ENV_RESTORE_THREADS, "")
+    if raw.isdigit() and int(raw) > 0:
+        return int(raw)
+    return max(1, min(16, n_leaves, os.cpu_count() or 4))
+
+
+def _read_leaf_resharded(path: str, key: str, target, sharding, stats):
+    """Read ONE leaf from the checkpoint's OCDBT store into a jax.Array
+    with `sharding`: per addressable shard, read only that shard's index
+    domain (deduped — replicated devices share one read) and device_put
+    the buffer. Runs on a pool thread; tensorstore reads release the GIL
+    so leaves genuinely overlap."""
+    import tensorstore as ts
+
+    spec = {"driver": "zarr",
+            "kvstore": {"driver": "ocdbt", "base": f"file://{path}",
+                        "path": key + "/"}}
+    arr = ts.open(spec, open=True).result()
+    shape = tuple(target.shape)
+    if tuple(arr.shape) != shape:
+        raise ValueError(
+            f"checkpoint leaf {key!r} has shape {tuple(arr.shape)}, "
+            f"target expects {shape}")
+    if arr.dtype.numpy_dtype != np.dtype(target.dtype):
+        raise ValueError(
+            f"checkpoint leaf {key!r} has dtype {arr.dtype.numpy_dtype}, "
+            f"target expects {np.dtype(target.dtype)}")
+    itemsize = np.dtype(target.dtype).itemsize
+    index_map = sharding.addressable_devices_indices_map(shape)
+    buffers: Dict[Tuple, Any] = {}      # normalized index -> np shard
+    device_buffers = []
+    held = 0        # host bytes this leaf keeps alive until assembly
+    try:
+        for device, idx in index_map.items():
+            idx = idx if idx is not None else ()
+            norm = tuple((s.start, s.stop, s.step) for s in idx)
+            if norm not in buffers:
+                view = arr[idx] if idx else arr
+                nbytes = int(np.prod([max(0, d) for d in view.shape],
+                                     initial=1)) * itemsize
+                stats.begin_read(nbytes)
+                held += nbytes
+                buffers[norm] = np.asarray(view.read().result())
+            # replicated devices share one host buffer; each device_put
+            # copies onto its device
+            device_buffers.append(jax.device_put(buffers[norm], device))
+        return jax.make_array_from_single_device_arrays(
+            shape, sharding, device_buffers)
+    finally:
+        # the host-side shard buffers stay accounted until the device
+        # copies exist — that whole window is what the memory pin bounds
+        stats.end_read(held)
+
+
+def restore_resharded(directory_or_path: str, state, rules=None,
+                      max_workers: Optional[int] = None,
+                      log: Callable[[str], None] = print,
+                      stats: Optional[ReadStats] = None):
+    """Restore a checkpoint into `state` with RESHARD-ON-READ semantics:
+    every leaf lands in the sharding `state` carries on its CURRENT mesh
+    (typically a different world size than the save), overridable per
+    leaf by regex restore rules (parallel/sharding.path_match — patterns
+    windowed over the checkpoint tree path, first hit wins):
+
+        rules = [(("params", ".*kernel"), P("fsdp", "tp")),
+                 ((r"opt_state", ".*", "mu", ".*"), None)]   # replicate
+
+    Accepts a step_N path or a directory (takes newest). Each host reads
+    only the byte ranges its new shards cover (OCDBT chunks equal the
+    save-time shards, so tensorstore never pulls more than the chunks
+    overlapping a shard), across a thread pool of per-leaf reads.
+    `stats` (a ReadStats) is filled in for callers that pin the memory
+    contract. Raises on missing leaves, shape or dtype mismatch — the
+    caller's fallback chain (restore_with_fallback) treats that like any
+    corrupt candidate."""
+    from ..parallel.sharding import sharding_for_path
+
+    wait_for_checkpoints()
+    path = directory_or_path
+    if not os.path.basename(path).startswith("step_"):
+        latest = latest_checkpoint(path)
+        if latest is None:
+            raise FileNotFoundError(f"no checkpoints under {path!r}")
+        path = latest
+    path = os.path.abspath(path)
+    stats = stats if stats is not None else ReadStats()
+    t0 = time.monotonic()
+
+    payload = _state_payload(state)
+    flat, treedef = jax.tree_util.tree_flatten_with_path(payload)
+    stats.leaves = len(flat)
+    stats.total_bytes = sum(
+        int(np.prod(leaf.shape, initial=1))
+        * np.dtype(leaf.dtype).itemsize for _, leaf in flat)
+
+    jobs = []
+    for key_path, leaf in flat:
+        components = _path_components(key_path)
+        default = getattr(leaf, "sharding", None)
+        sharding = default
+        if rules:
+            mesh = getattr(default, "mesh", None)
+            if mesh is not None:
+                sharding = sharding_for_path(mesh, components, rules,
+                                             tuple(leaf.shape),
+                                             default=default)
+        if sharding is None:
+            raise ValueError(
+                f"leaf {'.'.join(components)!r} has no sharding and no "
+                f"restore rule matched — restore_resharded needs a "
+                f"target layout for every leaf")
+        jobs.append((".".join(components), leaf, sharding))
+
+    with ThreadPoolExecutor(
+            max_workers=max_workers or _restore_threads(len(jobs)),
+            thread_name_prefix="reshard-restore") as pool:
+        futures = [pool.submit(_read_leaf_resharded, path, key, leaf,
+                               sharding, stats)
+                   for key, leaf, sharding in jobs]
+        leaves = [f.result() for f in futures]
+
+    restored = jax.tree_util.tree_unflatten(treedef, leaves)
+    stats.seconds = time.monotonic() - t0
+    _LAST_RESTORE_INFO.update(path=path, seconds=round(stats.seconds, 3),
+                              leaves=stats.leaves, resharded=True,
+                              bytes_read=stats.bytes_read,
+                              peak_in_flight_bytes=stats.peak_in_flight_bytes)
+    fields = {k: restored[k] for k in ("step", "params", "opt_state")}
+    if hasattr(state, "batch_stats"):
+        fields["batch_stats"] = restored["batch_stats"]
+    return state.replace(**fields)
+
+
+def _resharded_with_orbax_fallback(log: Callable[[str], None]):
+    """Per-candidate restore fn for restore_with_fallback: try the
+    parallel resharding reader first; a mechanism failure (non-OCDBT
+    layout, tensorstore missing) falls back to the orbax restore for the
+    SAME candidate before the outer loop declares it corrupt."""
+    def _restore(path: str, state):
+        try:
+            return restore_resharded(path, state, log=log)
+        except (ValueError, FileNotFoundError):
+            raise               # genuine mismatch/corruption: next step_N
+        except Exception as exc:  # noqa: BLE001 — layout/driver surprises
+            log(f"WARNING: resharded restore of {path} failed ({exc!r}); "
+                f"retrying via orbax")
+            return restore_checkpoint(path, state)
+    return _restore
+
+
+__all__ = ["save_checkpoint", "restore_checkpoint", "restore_resharded",
+           "latest_checkpoint",
            "checkpoint_steps", "verify_checkpoint", "restore_with_fallback",
-           "gc_checkpoints", "reset_saved_state",
+           "gc_checkpoints", "reset_saved_state", "last_restore_info",
+           "ReadStats", "ENV_RESHARD_RESTORE",
            "wait_for_checkpoints", "periodic_saver"]
 
 
-def restore_with_fallback(train_dir, state, log=print
+def restore_with_fallback(train_dir, state, log=print, restore=None
                           ) -> Tuple[Any, Optional[str]]:
     """Newest-first restore with per-candidate fallback: a candidate that
     fails the integrity check OR raises during the actual restore (bytes
     scribbled inside a committed directory) logs a warning and falls back
     to the previous step_N. Returns (state, restored_path) —
     restored_path is None when nothing restorable exists (state returned
-    unchanged)."""
+    unchanged). `restore` swaps the per-candidate restore fn
+    ((path, state) -> state; default restore_checkpoint) — this is how
+    restore_resharded composes with the fallback chain."""
     wait_for_checkpoints()
+    restore_fn = restore if restore is not None else restore_checkpoint
     directory = os.path.abspath(train_dir)
     for step in reversed(checkpoint_steps(directory)):
         path = os.path.join(directory, f"step_{step}")
@@ -188,19 +431,37 @@ def restore_with_fallback(train_dir, state, log=print
                 f"previous step")
             continue
         try:
-            return restore_checkpoint(path, state), path
+            _LAST_RESTORE_INFO.pop("resharded", None)
+            t0 = time.monotonic()
+            restored = restore_fn(path, state)
+            seconds = time.monotonic() - t0
+            leaves = len(jax.tree.leaves(_state_payload(restored)))
+            # a slow restore must be visible outside the histogram: one
+            # INFO line with wall time + leaf count per restore
+            log(f"INFO: restored {path} in {seconds:.2f}s "
+                f"({leaves} leaves)")
+            _LAST_RESTORE_INFO.update(
+                path=path, seconds=round(seconds, 3), leaves=leaves,
+                resharded=_LAST_RESTORE_INFO.get("resharded", False))
+            return restored, path
         except Exception as exc:  # noqa: BLE001 — corruption shapes vary
             log(f"WARNING: checkpoint {path} is corrupt ({exc!r}); "
                 f"falling back to the previous step")
     return state, None
 
 
-def maybe_resume(train_dir, state, log=print):
+def maybe_resume(train_dir, state, log=print, reshard: Optional[bool] = None):
     """Restore the newest INTACT checkpoint under train_dir into `state`
     (no-op when train_dir is falsy or empty). A corrupted newest step_N
     falls back to the previous one with a logged warning instead of
     killing the restart (restore_with_fallback). The single resume path
     every benchmark entrypoint shares.
+
+    `reshard` routes the restore through restore_resharded (parallel
+    per-leaf shard reads, reshard-on-read onto the CURRENT mesh — what a
+    gang resized 4 -> 2 needs, since the recorded shardings reference a
+    world that no longer exists). Default: the TPU_RESHARD_RESTORE env
+    knob ("1"/"true"), off otherwise.
 
     Multi-host: train_dir MUST be a filesystem every host shares (PVC/
     NFS/GCS — the shipped manifests mount a PVC). Restore is a collective;
@@ -208,7 +469,12 @@ def maybe_resume(train_dir, state, log=print):
     and deadlock the ranks that enter against the ones that skip."""
     if not train_dir:
         return state
-    state, path = restore_with_fallback(train_dir, state, log)
+    if reshard is None:
+        reshard = os.environ.get(ENV_RESHARD_RESTORE, "").lower() \
+            in ("1", "true", "yes")
+    restore = _resharded_with_orbax_fallback(log) if reshard else None
+    state, path = restore_with_fallback(train_dir, state, log,
+                                        restore=restore)
     if path is not None:
         log(f"resumed from {path} (step {int(state.step)})")
     return state

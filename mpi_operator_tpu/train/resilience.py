@@ -40,7 +40,7 @@ import sys
 import threading
 import time
 from dataclasses import dataclass, field
-from typing import Callable, Optional
+from typing import Callable, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -49,7 +49,14 @@ import jax.numpy as jnp
 ENV_FAULT_INJECT = "TPU_FAULT_INJECT"
 #: env var default for ResilienceConfig.step_deadline (seconds)
 ENV_STEP_DEADLINE = "TPU_STEP_DEADLINE"
+#: stop-bit cadence: an integer, or "auto" to derive it from the last
+#: run's measured drain latency in <train-dir>/events.jsonl
 ENV_STOP_CHECK_EVERY = "TPU_STOP_CHECK_EVERY"
+#: drain-latency budget (seconds) the auto cadence targets
+ENV_DRAIN_TARGET = "TPU_DRAIN_TARGET_SECONDS"
+#: default drain budget: well inside the ~30s TPU preemption notice,
+#: leaving the emergency checkpoint write the rest of the grace window
+DRAIN_TARGET_SECONDS = 5.0
 
 # Exit codes in the reference's 128-255 "retryable" band (ref
 # common_types.go:150-155) — the controller's ExitCode restart policy
@@ -126,6 +133,79 @@ class PreemptionListener:
             except (ValueError, TypeError):  # non-main thread / weird prev
                 pass
         self._prev.clear()
+
+
+def suggest_stop_check_every(drain_seconds: float, cadence: int,
+                             target: Optional[float] = None,
+                             lo: int = 1, hi: int = 256) -> Optional[int]:
+    """The cadence that would have landed a measured drain inside the
+    target budget, assuming drain latency scales roughly linearly with
+    the cadence (the drain waits for the next stop-check boundary, so
+    expected latency ~ cadence/2 steps + checkpoint write). Returns None
+    when the inputs can't support a suggestion."""
+    if target is None:
+        raw = os.environ.get(ENV_DRAIN_TARGET, "")
+        try:
+            target = float(raw) if raw else DRAIN_TARGET_SECONDS
+        except ValueError:
+            target = DRAIN_TARGET_SECONDS
+    if drain_seconds <= 0 or cadence <= 0 or target <= 0:
+        return None
+    return max(lo, min(hi, int(round(cadence * target / drain_seconds))
+                       or lo))
+
+
+def drain_latency_from_events(events_path: str
+                              ) -> Tuple[Optional[float], Optional[int]]:
+    """(worst drain latency, its recorded cadence) from an events.jsonl:
+    each preemption_drain pairs with the next emergency_checkpoint, and
+    the drain record carries the stop_check_every it ran under (emitted
+    by emergency_save). (None, None) when no complete drain exists."""
+    from ..telemetry import events as ev
+
+    worst: Optional[float] = None
+    cadence: Optional[int] = None
+    open_ts: Optional[float] = None
+    open_cadence: Optional[int] = None
+    try:
+        records = ev.read_events(events_path)
+    except OSError:
+        return None, None
+    for rec in records:
+        kind = rec.get("event")
+        if kind == ev.PREEMPTION_DRAIN:
+            open_ts = rec.get("ts")
+            open_cadence = rec.get("stop_check_every")
+        elif kind == ev.EMERGENCY_CHECKPOINT and open_ts is not None:
+            latency = float(rec.get("ts", open_ts)) - float(open_ts)
+            if worst is None or latency > worst:
+                worst, cadence = latency, open_cadence
+            open_ts = None
+    return worst, (int(cadence) if cadence else None)
+
+
+def auto_stop_check_every(train_dir: Optional[str],
+                          default: int = 8,
+                          log: Callable[[str], None] = print) -> int:
+    """TPU_STOP_CHECK_EVERY=auto: derive the cadence from the LAST run's
+    drain latency in <train_dir>/events.jsonl (the file the next
+    incarnation of a preempted/resized gang inherits on the shared
+    train_dir). Falls back to `default` when no drain has been measured
+    yet — the first run of a fresh job has nothing to learn from."""
+    if not train_dir:
+        return default
+    path = os.path.join(os.path.abspath(train_dir), "events.jsonl")
+    if not os.path.exists(path):
+        return default
+    worst, cadence = drain_latency_from_events(path)
+    if worst is None:
+        return default
+    suggested = suggest_stop_check_every(worst, cadence or default)
+    if suggested is None:
+        return default
+    log(f"stop-check cadence auto-tuned to {suggested} (last drain "
+        f"{worst:.2f}s at cadence {cadence or default})")
+    return suggested
 
 
 def gang_should_stop(local: bool) -> bool:
@@ -415,7 +495,12 @@ class ResilienceConfig:
             overrides["step_deadline"] = float(env[ENV_STEP_DEADLINE])
         if ("stop_check_every" not in overrides
                 and env.get(ENV_STOP_CHECK_EVERY)):
-            overrides["stop_check_every"] = int(env[ENV_STOP_CHECK_EVERY])
+            raw = str(env[ENV_STOP_CHECK_EVERY]).strip()
+            if raw.lower() == "auto":
+                overrides["stop_check_every"] = auto_stop_check_every(
+                    overrides.get("train_dir"))
+            else:
+                overrides["stop_check_every"] = int(raw)
         return cls(**overrides)
 
 
@@ -454,6 +539,11 @@ class ResilienceContext:
             self.faults.events = events
         self._pending_stop = False
         self._rollbacks = 0
+        # resume-phase bookkeeping: record_restore arms these, the next
+        # on_step emits FIRST_RESUME_STEP (restore-done -> first step,
+        # compile included — the recompile phase of a gang resize)
+        self._resume_ts: Optional[float] = None
+        self._resume_step = 0
 
     def __enter__(self) -> "ResilienceContext":
         self.listener.install()
@@ -479,6 +569,19 @@ class ResilienceContext:
     # -- the hot-path call ---------------------------------------------------
 
     def on_step(self, step: int) -> bool:
+        if self._resume_ts is not None and step > self._resume_step:
+            # first completed step of this incarnation: the dispatch of
+            # the step above blocked on its compile, so wall time since
+            # the restore IS the recompile phase
+            seconds = round(time.time() - self._resume_ts, 3)
+            self._resume_ts = None
+            if self.events is not None:
+                from ..telemetry import events as ev
+                self.events.emit(ev.FIRST_RESUME_STEP, step=int(step),
+                                 seconds=seconds)
+            if self.telemetry is not None \
+                    and hasattr(self.telemetry, "resume_step_seconds"):
+                self.telemetry.resume_step_seconds.set(seconds)
         local = False
         if self.faults is not None:
             local = self.faults.check_step(step)
@@ -515,7 +618,11 @@ class ResilienceContext:
         step = int(state.step)
         if self.events is not None:
             from ..telemetry import events as ev
-            self.events.emit(ev.PREEMPTION_DRAIN, step=step)
+            # the cadence rides the drain record so the NEXT incarnation
+            # (TPU_STOP_CHECK_EVERY=auto) and the postmortem can relate
+            # the measured latency to the setting that produced it
+            self.events.emit(ev.PREEMPTION_DRAIN, step=step,
+                             stop_check_every=self.config.stop_check_every)
         maybe_save(self.config.train_dir, state, self.log)
         if self.events is not None:
             self.events.emit(ev.EMERGENCY_CHECKPOINT, step=step,
@@ -526,21 +633,41 @@ class ResilienceContext:
 
     # -- restart-aware goodput bookkeeping -----------------------------------
 
-    def record_restore(self, step: int, path: Optional[str] = None) -> None:
+    def record_restore(self, step: int, path: Optional[str] = None,
+                       seconds: Optional[float] = None,
+                       leaves: Optional[int] = None,
+                       resharded: Optional[bool] = None) -> None:
         """Report the step this incarnation restored from. The controller
         charges (last observed step − restore step) to the lost column of
         the job goodput ledger, so the restore step MUST be durable in the
         event log and visible on /metrics — call this right after
-        maybe_resume, with step 0 meaning a fresh start (no event)."""
+        maybe_resume, with step 0 meaning a fresh start (no event).
+        `seconds`/`leaves`/`resharded` (checkpoint.last_restore_info)
+        describe the restore itself — the restore phase of the
+        resize_seconds split."""
         step = int(step)
         if step > 0 and self.events is not None:
             from ..telemetry import events as ev
             fields = {"step": step}
             if path:
                 fields["path"] = path
+            if seconds is not None:
+                fields["seconds"] = round(float(seconds), 3)
+            if leaves is not None:
+                fields["leaves"] = int(leaves)
+            if resharded is not None:
+                fields["resharded"] = bool(resharded)
             self.events.emit(ev.CHECKPOINT_RESTORE, **fields)
+        if step > 0:
+            # arm the recompile-phase probe: the next completed step
+            # closes the restore -> first-step window (on_step)
+            self._resume_ts = time.time()
+            self._resume_step = step
         if self.telemetry is not None:
             self.telemetry.restore_step.set(step)
+            if seconds is not None \
+                    and hasattr(self.telemetry, "restore_seconds"):
+                self.telemetry.restore_seconds.set(round(float(seconds), 3))
             if step > 0:
                 self.telemetry.last_checkpoint_step.set(step)
                 self.telemetry.step.set(step)
@@ -597,7 +724,9 @@ class ResilienceContext:
 __all__ = [
     "PREEMPTED_EXIT", "WATCHDOG_STALL_EXIT", "FAULT_DIE_EXIT",
     "ENV_FAULT_INJECT", "ENV_STEP_DEADLINE", "ENV_STOP_CHECK_EVERY",
-    "is_retryable_exit",
+    "ENV_DRAIN_TARGET", "DRAIN_TARGET_SECONDS",
+    "is_retryable_exit", "suggest_stop_check_every",
+    "drain_latency_from_events", "auto_stop_check_every",
     "Preempted", "DivergenceError", "PreemptionListener", "gang_should_stop",
     "guard_nonfinite_update", "Watchdog", "FaultInjector",
     "corrupt_latest_checkpoint", "ResilienceConfig", "ResilienceContext",

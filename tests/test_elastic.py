@@ -1,0 +1,265 @@
+"""Elastic gang resize — control-plane and telemetry units.
+
+Covers the pieces the out-of-process smoke (scripts/tier1.sh --elastic)
+exercises end-to-end: the resize ledger phase split and its Prometheus
+rendering, spec.resize validation + serialization + controller sizing,
+the auto-tuned stop-check cadence, FIRST_RESUME_STEP emission, and the
+postmortem summary keys."""
+import pytest
+
+from mpi_operator_tpu.api.types import (
+    Container, ObjectMeta, PodTemplateSpec, TPUJob, TPUJobSpec,
+)
+from mpi_operator_tpu.api.validation import ValidationError, validate_spec
+from mpi_operator_tpu.cluster.serialize import from_manifest, to_manifest
+from mpi_operator_tpu.postmortem import summarize
+from mpi_operator_tpu.telemetry import events as ev
+from mpi_operator_tpu.telemetry.collector import (
+    JobObservatory, resize_ledger, resize_lines,
+)
+from mpi_operator_tpu.telemetry.events import EventLog
+from mpi_operator_tpu.train.resilience import (
+    ResilienceConfig, ResilienceContext, auto_stop_check_every,
+    drain_latency_from_events, suggest_stop_check_every,
+)
+
+
+def _rec(event, ts, **fields):
+    return {"ts": ts, "event": event, **fields}
+
+
+#: one clean 4->2 resize: drain 0.4s, restore 0.7s, recompile 1.5s,
+#: total 3.5s (drain start 10.0 -> first resume step 13.5)
+_RESIZE_RECORDS = [
+    _rec(ev.JOB_CREATED, 9.0, job="j"),
+    _rec(ev.PREEMPTION_DRAIN, 10.0, step=5, stop_check_every=8),
+    _rec(ev.EMERGENCY_CHECKPOINT, 10.4, step=5),
+    _rec(ev.GANG_RESIZE, 11.0, job="j", workers=2, tpus=4, replicas=2),
+    _rec(ev.CHECKPOINT_RESTORE, 12.0, step=5, seconds=0.7,
+         resharded=True),
+    _rec(ev.FIRST_RESUME_STEP, 13.5, step=7, seconds=1.5),
+]
+
+
+# ---------------------------------------------------------------------------
+# resize ledger (telemetry/collector.py)
+# ---------------------------------------------------------------------------
+
+def test_resize_ledger_phase_split():
+    (resize,) = resize_ledger(_RESIZE_RECORDS)
+    assert resize["drain_seconds"] == 0.4
+    assert resize["restore_seconds"] == 0.7
+    assert resize["recompile_seconds"] == 1.5
+    assert resize["total_seconds"] == 3.5      # drain start -> resume step
+    assert resize["workers"] == 2 and resize["tpus"] == 4
+
+
+def test_resize_ledger_incomplete_entry_kept():
+    """A gang that died mid-resize still shows up — with only the phases
+    it reached and no total."""
+    records = _RESIZE_RECORDS[:4]              # no restore, no resume
+    (resize,) = resize_ledger(records)
+    assert resize["drain_seconds"] == 0.4
+    assert "restore_seconds" not in resize
+    assert "total_seconds" not in resize
+
+
+def test_resize_ledger_ignores_plain_restores():
+    """checkpoint_restore outside a resize window (ordinary restart)
+    never opens a ledger entry."""
+    records = [
+        _rec(ev.CHECKPOINT_RESTORE, 5.0, step=3, seconds=0.2),
+        _rec(ev.FIRST_RESUME_STEP, 6.0, step=4, seconds=0.9),
+    ]
+    assert resize_ledger(records) == []
+
+
+def test_resize_lines_prometheus_text():
+    lines = resize_lines("j", resize_ledger(_RESIZE_RECORDS))
+    text = "\n".join(lines)
+    # total 3.5 lands in the le=5.0 bucket and above, not le=2.5
+    assert 'tpu_job_resize_seconds_bucket{job="j",le="2.5"} 0' in text
+    assert 'tpu_job_resize_seconds_bucket{job="j",le="5.0"} 1' in text
+    assert 'tpu_job_resize_seconds_bucket{job="j",le="+Inf"} 1' in text
+    assert 'tpu_job_resize_seconds_count{job="j"} 1' in text
+    assert 'tpu_job_resizes_total{job="j"} 1' in text
+    assert 'tpu_job_resize_drain_seconds{job="j"} 0.4' in text
+    assert 'tpu_job_resize_restore_seconds{job="j"} 0.7' in text
+    assert 'tpu_job_resize_recompile_seconds{job="j"} 1.5' in text
+
+
+def test_note_resize_gang_flag_picks_event():
+    obs = JobObservatory()
+    obs.note_resize("j", gang=True, workers=2, tpus=4)
+    obs.note_resize("j", replicas=4)           # elastic shrink/grow
+    events = [r["event"] for r in obs.view("j")["controller_records"]]
+    assert events == [ev.GANG_RESIZE, ev.JOB_RESIZED]
+
+
+# ---------------------------------------------------------------------------
+# spec.resize (api + serialize + controller)
+# ---------------------------------------------------------------------------
+
+def _spec(**kw):
+    return TPUJobSpec(
+        template=PodTemplateSpec(
+            containers=[Container(name="train", image="tpu-bench:latest")]
+        ),
+        **kw,
+    )
+
+
+def test_spec_resize_valid():
+    validate_spec(_spec(tpus=8, resize=4))
+
+
+@pytest.mark.parametrize("kw", [
+    dict(replicas=2, resize=4),                # needs tpus sizing mode
+    dict(tpus=8, num_slices=2, resize=4),      # single-slice only
+    dict(tpus=8, resize=3),                    # not a valid chip count
+    dict(tpus=8, elastic=True, resize=4),      # elastic owns sizing
+    dict(tpus=8, resize=4, pack_group="g"),    # packed jobs are pinned
+], ids=["mode", "slices", "ladder", "elastic", "packed"])
+def test_spec_resize_rejected(kw):
+    with pytest.raises(ValidationError):
+        validate_spec(_spec(**kw))
+
+
+def test_spec_resize_serialize_round_trip():
+    job = TPUJob(metadata=ObjectMeta(name="j", namespace="default"),
+                 spec=_spec(tpus=8, resize=4))
+    manifest = to_manifest(job)
+    assert manifest["spec"]["resize"] == 4
+    assert from_manifest(manifest).spec.resize == 4
+    # absent stays absent
+    job.spec.resize = None
+    assert from_manifest(to_manifest(job)).spec.resize is None
+
+
+def test_controller_allocation_follows_resize():
+    """spec.resize replaces the spec size in Mode A sizing — the edited
+    target drives the next gang bootstrap."""
+    from tests.test_controller import Fixture, new_job
+
+    f = Fixture()
+    job = new_job(tpus=8)
+    f.seed(job)
+    base = f.controller.allocate_processing_units(job, False)
+    job.spec.resize = 4
+    shrunk = f.controller.allocate_processing_units(job, False)
+    assert shrunk.worker_replicas == base.worker_replicas // 2
+    assert shrunk.units_per_worker == base.units_per_worker
+
+
+# ---------------------------------------------------------------------------
+# auto-tuned stop-check cadence (train/resilience.py)
+# ---------------------------------------------------------------------------
+
+def test_suggest_stop_check_every_scales_and_clamps():
+    # 0.4s drain at cadence 8 with a 5s target -> 100
+    assert suggest_stop_check_every(0.4, 8, target=5.0) == 100
+    # slow drain shrinks the cadence, floor 1
+    assert suggest_stop_check_every(80.0, 8, target=5.0) == 1
+    # fast drain is capped at 256
+    assert suggest_stop_check_every(0.001, 8, target=5.0) == 256
+    assert suggest_stop_check_every(0.0, 8) is None
+    assert suggest_stop_check_every(1.0, 0) is None
+
+
+def _write_drain_events(tmp_path, drain_seconds=0.4, cadence=8):
+    t = iter([100.0, 100.0 + drain_seconds])
+    log = EventLog(str(tmp_path / "events.jsonl"), clock=lambda: next(t))
+    log.emit(ev.PREEMPTION_DRAIN, step=5, stop_check_every=cadence)
+    log.emit(ev.EMERGENCY_CHECKPOINT, step=5)
+    log.close()
+    return str(tmp_path / "events.jsonl")
+
+
+def test_drain_latency_from_events(tmp_path):
+    path = _write_drain_events(tmp_path)
+    worst, cadence = drain_latency_from_events(path)
+    assert worst == pytest.approx(0.4)
+    assert cadence == 8
+    assert drain_latency_from_events(str(tmp_path / "none.jsonl")) \
+        == (None, None)
+
+
+def test_auto_stop_check_every(tmp_path):
+    _write_drain_events(tmp_path)
+    logs = []
+    assert auto_stop_check_every(str(tmp_path), log=logs.append) == 100
+    assert any("auto-tuned to 100" in l for l in logs)
+    # nothing measured yet -> default
+    assert auto_stop_check_every(None) == 8
+    assert auto_stop_check_every(str(tmp_path / "fresh")) == 8
+
+
+def test_from_env_auto_cadence(tmp_path):
+    _write_drain_events(tmp_path)
+    cfg = ResilienceConfig.from_env(
+        env={"TPU_STOP_CHECK_EVERY": "auto"}, train_dir=str(tmp_path))
+    assert cfg.stop_check_every == 100
+    cfg = ResilienceConfig.from_env(env={"TPU_STOP_CHECK_EVERY": "16"})
+    assert cfg.stop_check_every == 16
+
+
+# ---------------------------------------------------------------------------
+# FIRST_RESUME_STEP (recompile-phase probe)
+# ---------------------------------------------------------------------------
+
+def test_first_resume_step_emitted_once(tmp_path):
+    path = str(tmp_path / "events.jsonl")
+    ctx = ResilienceContext(ResilienceConfig(train_dir=str(tmp_path)),
+                            log=lambda s: None, events=EventLog(path))
+    with ctx:
+        ctx.record_restore(5, seconds=0.7, leaves=7, resharded=True)
+        ctx.on_step(6)                 # first completed post-resume step
+        ctx.on_step(7)
+    records = ev.read_events(path)
+    restores = [r for r in records if r["event"] == ev.CHECKPOINT_RESTORE]
+    resumes = [r for r in records if r["event"] == ev.FIRST_RESUME_STEP]
+    assert restores[0]["seconds"] == 0.7 and restores[0]["resharded"]
+    assert len(resumes) == 1           # one-shot: step 7 emits nothing
+    assert resumes[0]["step"] == 6 and resumes[0]["seconds"] >= 0
+
+
+def test_fresh_start_emits_no_resume_events(tmp_path):
+    path = str(tmp_path / "events.jsonl")
+    ctx = ResilienceContext(ResilienceConfig(train_dir=str(tmp_path)),
+                            log=lambda s: None, events=EventLog(path))
+    with ctx:
+        ctx.record_restore(0)          # step 0 == fresh start
+        ctx.on_step(1)
+    kinds = {r["event"] for r in ev.read_events(path)}
+    assert ev.CHECKPOINT_RESTORE not in kinds
+    assert ev.FIRST_RESUME_STEP not in kinds
+
+
+# ---------------------------------------------------------------------------
+# postmortem (satellites 1 + 2)
+# ---------------------------------------------------------------------------
+
+def test_postmortem_summary_resizes_and_suggestion():
+    summary = summarize(_RESIZE_RECORDS)
+    (resize,) = summary["resizes"]
+    assert resize["t"] == 2.0          # rebased to the first record
+    assert resize["total_seconds"] == 3.5
+    assert "drain_start_ts" not in resize
+    assert summary["suggested_stop_check_every"] == \
+        suggest_stop_check_every(0.4, 8)
+    # gang_resize is a milestone, first_resume_step an incident marker
+    assert any(m["event"] == ev.GANG_RESIZE for m in summary["milestones"])
+    assert any(i["event"] == ev.FIRST_RESUME_STEP
+               for i in summary["incidents"])
+
+
+def test_postmortem_render_mentions_resize():
+    import io
+
+    from mpi_operator_tpu.postmortem import render
+
+    out = io.StringIO()
+    render(summarize(_RESIZE_RECORDS), out)
+    text = out.getvalue()
+    assert "gang resizes:" in text
+    assert "suggested --stop-check-every" in text
