@@ -204,6 +204,16 @@ class TPUJobSpec:
     backoff_limit: Optional[int] = None
     active_deadline_seconds: Optional[int] = None
 
+    # progress lease (stuck-gang detection; no reference analogue): if a
+    # Running job's federated step frontier (max tpu_worker_step /
+    # last_checkpoint_step over the worker scrapes) advances by ZERO for
+    # this many seconds — a hung host, stalled ICI, or every scrape gone
+    # stale — the controller records a StuckGang condition, emits a
+    # gang_stuck event, and takes the ordinary restart-policy path
+    # (counted against backoffLimit). None (default) disables the lease;
+    # it needs the observatory scraping worker metrics to mean anything.
+    progress_deadline_seconds: Optional[int] = None
+
     # gang scheduling opt-in recorded per job (operator flag in the reference,
     # cmd/mpi-operator/main.go:112-113)
     gang_scheduling: bool = False
@@ -279,6 +289,11 @@ COND_FAILED = "Failed"
 # beyond the reference: True while elastic shrink has the job running
 # below its spec size (status.elastic_tpus set)
 COND_DEGRADED = "Degraded"
+# beyond the reference: True while the progress lease
+# (spec.progressDeadlineSeconds) has expired with zero observed step
+# progress; flipped False with reason ProgressResumed once the federated
+# step frontier moves again
+COND_STUCK = "StuckGang"
 
 # v1alpha1 launcher status surface kept for parity (ref types.go:102-116)
 LAUNCHER_ACTIVE = "Active"
@@ -408,7 +423,7 @@ __all__ = [
     "ServingSpec", "TPUJobSpec", "JobCondition", "ReplicaStatus",
     "TPUJobStatus", "TPUJob",
     "COND_CREATED", "COND_RUNNING", "COND_RESTARTING", "COND_SUCCEEDED",
-    "COND_FAILED", "COND_DEGRADED",
+    "COND_FAILED", "COND_DEGRADED", "COND_STUCK",
     "LAUNCHER_ACTIVE", "LAUNCHER_SUCCEEDED", "LAUNCHER_FAILED",
     "new_tpu_job", "deepcopy_obj",
 ]

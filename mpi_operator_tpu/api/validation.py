@@ -332,6 +332,15 @@ def validate_spec(spec: TPUJobSpec,
             f"{spec.active_deadline_seconds}"
         )
 
+    if (
+        spec.progress_deadline_seconds is not None
+        and spec.progress_deadline_seconds < 1
+    ):
+        errs.append(
+            f"spec.progressDeadlineSeconds must be >= 1, got "
+            f"{spec.progress_deadline_seconds}"
+        )
+
     if spec.clean_pod_policy not in ("Running", "All", "None"):
         # ref: v1alpha2/types.go:55-66 CleanPodPolicy
         errs.append(

@@ -50,6 +50,33 @@ class ConflictError(ApiError):
         super().__init__("Conflict", f"{kind} {key!r} conflict: {msg}")
 
 
+#: reasons the real API server hands back for failures that are safe to
+#: retry verbatim (apimachinery errors.SuggestsClientDelay /
+#: IsServerTimeout / IsTooManyRequests / IsServiceUnavailable): nothing
+#: about the request was wrong, the server just couldn't take it now.
+TRANSIENT_REASONS = ("ServerTimeout", "TooManyRequests", "ServiceUnavailable")
+
+
+class TransientApiError(ApiError):
+    """A retryable server-side failure (timeout / overload / unavailable).
+
+    The chaos layer (cluster/chaos.py) raises these; the controller's
+    discipline is client-go's: never give up the key, requeue it via
+    RateLimitingQueue.add_rate_limited and let backoff absorb the storm.
+    """
+
+    def __init__(self, reason: str, message: str):
+        if reason not in TRANSIENT_REASONS:
+            raise ValueError(f"not a transient reason: {reason!r}")
+        super().__init__(reason, message)
+
+
+def is_transient(err: BaseException) -> bool:
+    """True when `err` is a retry-verbatim API failure (see TRANSIENT_REASONS).
+    Classification helper for requeue metrics and retry loops."""
+    return isinstance(err, ApiError) and err.reason in TRANSIENT_REASONS
+
+
 @dataclass(frozen=True)
 class Action:
     """ref: k8stesting.Action — verbs observed by checkAction
@@ -161,6 +188,15 @@ class InMemoryAPIServer:
         with self._lock:
             self._watchers.setdefault(kind, []).append(handler)
 
+    def drop_watchers(self) -> None:
+        """Sever every watch connection — the analogue of the watching
+        client dying (or the API server restarting its watch streams).
+        Nothing is delivered to dropped handlers afterwards; informers
+        recover by re-listing. The chaos harness calls this when it kills
+        a controller so zombie informers stop receiving fan-out."""
+        with self._lock:
+            self._watchers.clear()
+
     # -- CRUD (ref clientset verbs, mpijob.go:37-48) ------------------------
 
     def create(self, obj):
@@ -267,4 +303,5 @@ class InMemoryAPIServer:
 __all__ = [
     "InMemoryAPIServer", "Action",
     "ApiError", "NotFoundError", "AlreadyExistsError", "ConflictError",
+    "TransientApiError", "is_transient", "TRANSIENT_REASONS",
 ]

@@ -89,11 +89,25 @@ class Informer:
     # -- sync (ref cache.WaitForCacheSync, mpi_job_controller.go:339) -------
 
     def start(self) -> None:
-        """Initial list: populate the cache from the server."""
+        """Full re-list: REPLACE the cache with the server's current state
+        (client-go Reflector relist + store Replace). Called at startup and
+        as the periodic resync that heals dropped watch events: an object
+        whose DELETED event was lost would otherwise linger in the cache
+        forever (and keep getting reconciled back into existence), so
+        evicted objects fire their delete handlers — the owning job is
+        re-queued and per-job controller state released."""
         with self._lock:
-            for obj in self.api.list(self.kind, self.namespace):
-                self._cache[(obj.metadata.namespace, obj.metadata.name)] = obj
+            fresh = {
+                (obj.metadata.namespace, obj.metadata.name): obj
+                for obj in self.api.list(self.kind, self.namespace)
+            }
+            evicted = [obj for key, obj in self._cache.items()
+                       if key not in fresh]
+            self._cache = fresh
             self._synced = True
+        for obj in evicted:
+            for h in self._delete_handlers:
+                h(obj)
 
     def has_synced(self) -> bool:
         return self._synced

@@ -110,6 +110,21 @@ class RateLimitingQueue:
         with self._lock:
             return self._failures.get(key, 0)
 
+    def snapshot(self) -> Dict[str, object]:
+        """Introspection for the chaos soak's wedge detector: a key is
+        permanently wedged when it sits in `failures` (backoff still
+        growing, never forgotten) or `processing` (done() never called)
+        after the controller has gone quiet. Returns copies; safe to
+        inspect without holding up workers."""
+        with self._lock:
+            return {
+                "queue": list(self._queue),
+                "waiting": sorted(key for _, key in self._waiting),
+                "processing": set(self._processing),
+                "dirty": set(self._dirty),
+                "failures": dict(self._failures),
+            }
+
     # -- lifecycle ----------------------------------------------------------
 
     def shut_down(self) -> None:

@@ -1,0 +1,495 @@
+"""Chaos harness: crash-consistent reconcile + fault-injection soak.
+
+The reconcile loop's central claim — level-triggered, idempotent, safe to
+kill at ANY point — is exactly the claim ordinary unit tests never
+exercise: they drive `sync_handler` start-to-finish against a healthy API
+server. This harness drives whole job lifecycles while
+
+  - the API server injects seeded transient errors, conflicts, stale
+    reads, and dropped watch events (cluster/chaos.py FaultingAPIServer),
+  - the controller is KILLED at every write boundary (ControllerCrash,
+    a BaseException ≈ SIGKILL raised after the write lands but before
+    the controller sees the response) and replaced with a fresh process
+    image (new informers, new workqueue, no in-memory state),
+
+then asserts the ORACLE property: the chaos run converges to the same
+terminal conditions, the same restart count, and the same owned-resource
+set as the identical lifecycle run uninterrupted against a healthy
+server — with zero leaked resources after teardown and zero wedged
+workqueue keys.
+
+The ClusterSim half plays kubelet + batch-Job controller: it writes pod
+readiness and launcher completion directly to the INNER server (the
+cluster's own state changes are not subject to faults aimed at the
+controller's client).
+
+Run the standalone soak (scripts/tier1.sh --chaos uses this)::
+
+    python -m mpi_operator_tpu.controller.chaos --seed 42 --lifecycles 25
+
+On failure the reproducer seed is printed; rerunning with that seed
+replays the identical fault sequence.
+"""
+from __future__ import annotations
+
+import json
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+from ..api import types as api
+from ..api.types import (
+    COND_RUNNING, COND_SUCCEEDED, Container, ObjectMeta,
+    PodTemplateSpec, ServingSpec, TPUJob, TPUJobSpec,
+)
+from ..cluster.apiserver import ApiError, InMemoryAPIServer
+from ..cluster.chaos import ControllerCrash, FaultingAPIServer
+from ..cluster.workqueue import RateLimitingQueue
+from .controller import (
+    LAUNCHER_SUFFIX, ControllerConfig, TPUJobController,
+)
+from .packing import COND_PACKED
+
+#: every kind the controller materializes — enumerated for owned-resource
+#: accounting (leak detection scans each kind's store)
+OWNED_KINDS = (
+    "ConfigMap", "Service", "ServiceAccount", "Role", "RoleBinding",
+    "StatefulSet", "Job", "PodDisruptionBudget", "Pod",
+)
+
+#: the acceptance-bar fault mix: >=10% transient on every mutating verb,
+#: conflicts on TPUJob status updates, stale reads, dropped watch events
+DEFAULT_RULES = (
+    "mutate/*=0.10:transient",
+    "update-status/TPUJob=0.25:conflict",
+    "get/*=0.05:stale",
+    "watch/*=0.02:drop",
+)
+
+#: lifecycle mix the soak cycles through (ISSUE: create, restart, resize,
+#: pack, disagg split, teardown — teardown ends every lifecycle)
+LIFECYCLES = ("train", "restart", "resize", "pack", "serving")
+
+
+class ConvergenceError(AssertionError):
+    """A lifecycle failed to converge (or converged to the wrong state)
+    under chaos. Carries the reproducer seed."""
+
+    def __init__(self, message: str, seed: int):
+        super().__init__(f"{message} (reproduce with seed={seed})")
+        self.seed = seed
+
+
+class ChaosHarness:
+    """One chaos (or oracle) universe: inner store + faulting wrapper +
+    a controller that can be killed and rebuilt at will.
+
+    With ``crash_every_write=True`` every controller incarnation is armed
+    to die the instant its next non-Event write lands, so every write
+    boundary in every sync path gets a kill/replay — the strongest
+    crash-consistency schedule expressible against a synchronous store.
+    """
+
+    def __init__(self, rules: Sequence = (), seed: int = 0,
+                 crash_every_write: bool = False,
+                 config: Optional[ControllerConfig] = None):
+        self.inner = InMemoryAPIServer()
+        self.api = FaultingAPIServer(self.inner, rules=rules, seed=seed)
+        self.seed = seed
+        self.crash_every_write = crash_every_write
+        self.config = config or ControllerConfig()
+        self.ns = self.config.namespace or "default"
+        self.controller_restarts = 0
+        self.controller: Optional[TPUJobController] = None
+        self._build_controller()
+
+    # -- controller lifecycle ------------------------------------------------
+
+    def _build_controller(self) -> None:
+        self.controller = TPUJobController(self.api, config=self.config)
+        # chaos timing: keep client-go backoff SEMANTICS (exponential,
+        # forgettable) but compress the clock so a fault storm doesn't
+        # stall the soak's wall time
+        self.controller.queue = RateLimitingQueue(base_delay=0.001,
+                                                  max_delay=0.05)
+        try:
+            self.controller.factory.start_all()
+        except ApiError:
+            # injected transient on the initial list: the informer cache
+            # starts empty/partial; the next resync() re-lists
+            pass
+        self.resync()
+
+    def kill_controller(self) -> None:
+        """The process died: its watch connections, informer caches, and
+        workqueue die with it. A fresh incarnation re-lists and resyncs."""
+        self.controller_restarts += 1
+        self.inner.drop_watchers()
+        self._build_controller()
+
+    def resync(self) -> None:
+        """Periodic resync (client-go resyncPeriod): full re-list of every
+        informer cache — the recovery path for dropped watch events —
+        then re-enqueue every live job."""
+        try:
+            self.controller.factory.start_all()
+        except ApiError:
+            pass
+        for job in self.inner.list(api.KIND):
+            self.controller.enqueue_tpu_job(job)
+
+    # -- drive loop ----------------------------------------------------------
+
+    def drive(self, max_items: int = 2000) -> None:
+        """Process queued work until quiescent (empty queue, nothing
+        waiting), surviving injected crashes by rebuilding the controller.
+        Bounded so a pathological requeue storm terminates the call; the
+        caller's drive_until applies the real convergence deadline."""
+        for _ in range(max_items):
+            if self.crash_every_write:
+                self.api.arm_crash(after_writes=1)
+            try:
+                processed = self.controller.process_next_work_item(
+                    timeout=0.02)
+            except ControllerCrash:
+                self.kill_controller()
+                continue
+            if not processed and len(self.controller.queue) == 0:
+                break
+        self.api.disarm_crash()
+
+    def drive_until(self, predicate: Callable[[], bool], desc: str,
+                    rounds: int = 60) -> None:
+        """Drive + resync until `predicate` holds; every failure names the
+        reproducer seed."""
+        for i in range(rounds):
+            self.drive()
+            if predicate():
+                return
+            # resync heals dropped watch events (re-list) and re-enqueues;
+            # without it a dropped event could stall the predicate forever
+            self.resync()
+        raise ConvergenceError(f"did not converge: {desc}", self.seed)
+
+    # -- user actions (writes go through the INNER server: the user's
+    #    kubectl is not the controller's faulted client) ----------------------
+
+    def create_job(self, name: str, tpus: int = 8, **spec_kw) -> TPUJob:
+        job = TPUJob(
+            metadata=ObjectMeta(name=name, namespace=self.ns),
+            spec=TPUJobSpec(
+                tpus=tpus,
+                template=PodTemplateSpec(containers=[
+                    Container(name="train", image="tpu-bench:latest")]),
+                **spec_kw,
+            ),
+        )
+        return self.inner.create(job)
+
+    def edit_spec(self, name: str, **changes) -> TPUJob:
+        job = self.inner.get(api.KIND, self.ns, name)
+        for field_name, value in changes.items():
+            setattr(job.spec, field_name, value)
+        return self.inner.update(job)
+
+    # -- cluster simulation (kubelet / batch-Job controller) -----------------
+
+    def worker_sets(self, name: str) -> List:
+        uid = self.inner.get(api.KIND, self.ns, name).metadata.uid
+        return [
+            s for s in self.inner.list("StatefulSet", namespace=self.ns)
+            if any(r.controller and r.uid == uid
+                   for r in s.metadata.owner_references)
+        ]
+
+    def make_workers_ready(self, name: str) -> None:
+        for sts in self.worker_sets(name):
+            sts.status.ready_replicas = sts.spec.replicas
+            sts.status.replicas = sts.spec.replicas
+            self.inner.update(sts)
+
+    def launcher(self, name: str):
+        return self.inner.try_get("Job", self.ns, name + LAUNCHER_SUFFIX)
+
+    def set_launcher_active(self, name: str) -> None:
+        launcher = self.inner.get("Job", self.ns, name + LAUNCHER_SUFFIX)
+        launcher.status.active = 1
+        self.inner.update(launcher)
+
+    def finish_launcher(self, name: str, exit_code: int = 0) -> None:
+        launcher = self.inner.get("Job", self.ns, name + LAUNCHER_SUFFIX)
+        launcher.status.active = 0
+        if exit_code == 0:
+            launcher.status.succeeded = 1
+        else:
+            launcher.status.failed = 1
+            launcher.status.exit_code = exit_code
+        self.inner.update(launcher)
+
+    # -- observation ---------------------------------------------------------
+
+    def job(self, name: str) -> TPUJob:
+        return self.inner.get(api.KIND, self.ns, name)
+
+    def cond(self, name: str, cond_type: str) -> Optional[str]:
+        cond = self.job(name).status.get_condition(cond_type)
+        return None if cond is None else cond.status
+
+    def owned(self, uid: str) -> List[Tuple[str, str]]:
+        """Every live object whose controller ownerReference is `uid` —
+        the resource set the oracle compares and teardown must empty."""
+        out = []
+        for kind in OWNED_KINDS:
+            for obj in self.inner.list(kind, namespace=self.ns):
+                if any(r.controller and r.uid == uid
+                       for r in obj.metadata.owner_references):
+                    out.append((kind, obj.metadata.name))
+        return sorted(out)
+
+    def snapshot_job(self, name: str) -> Dict:
+        """The oracle-comparable fingerprint of a converged job."""
+        job = self.job(name)
+        return {
+            "conditions": {c.type: (c.status, c.reason)
+                           for c in job.status.conditions},
+            "restart_count": job.status.restart_count,
+            "resources": self.owned(job.metadata.uid),
+        }
+
+    def queue_wedged(self) -> Dict:
+        """Nonempty fields here after convergence = a wedged key: stuck
+        in-flight, or permanently rate-limited with no forget."""
+        snap = self.controller.queue.snapshot()
+        return {k: v for k, v in snap.items() if v and k != "dirty"}
+
+    def teardown(self, name: str) -> List[Tuple[str, str]]:
+        """User deletes the job; cluster GC cascades; controller observes.
+        Returns whatever is STILL owned by the dead uid afterwards — the
+        leak set, [] on a clean teardown. A second GC pass runs after the
+        controller quiesces: a sync replaying against a stale cache may
+        legitimately recreate a dependent for a moment (real GC reaps
+        those orphans the same way), but nothing may survive the final
+        pass + resync."""
+        uid = self.job(name).metadata.uid
+        self.inner.delete(api.KIND, self.ns, name)
+        self.inner.cascade_delete(uid)
+        self.drive()
+        self.resync()
+        self.drive()
+        self.inner.cascade_delete(uid)
+        self.resync()
+        self.drive()
+        return self.owned(uid)
+
+
+# ---------------------------------------------------------------------------
+# lifecycle scenarios — each drives ONE job (or pack pair) birth-to-teardown
+# and returns {job_name: snapshot} for oracle comparison. Identical code
+# runs against the chaos harness and the pristine oracle harness.
+# ---------------------------------------------------------------------------
+
+def _run_to_running(h: ChaosHarness, name: str) -> None:
+    h.drive_until(lambda: h.worker_sets(name), f"{name}: worker sts")
+    h.make_workers_ready(name)
+    h.drive_until(lambda: h.launcher(name) is not None, f"{name}: launcher")
+    h.set_launcher_active(name)
+    h.drive_until(lambda: h.cond(name, COND_RUNNING) == "True",
+                  f"{name}: Running")
+
+
+def _finish_and_snapshot(h: ChaosHarness, name: str) -> Dict:
+    h.finish_launcher(name)
+    h.drive_until(lambda: h.cond(name, COND_SUCCEEDED) == "True",
+                  f"{name}: Succeeded")
+    snap = h.snapshot_job(name)
+    snap["leaked"] = h.teardown(name)
+    return snap
+
+
+def scenario_train(h: ChaosHarness, name: str) -> Dict[str, Dict]:
+    h.create_job(name)
+    _run_to_running(h, name)
+    return {name: _finish_and_snapshot(h, name)}
+
+
+def scenario_restart(h: ChaosHarness, name: str) -> Dict[str, Dict]:
+    h.create_job(name, restart_policy="OnFailure")
+    _run_to_running(h, name)
+    h.finish_launcher(name, exit_code=137)      # the gang dies
+
+    def restarted() -> bool:
+        launcher = h.launcher(name)
+        return (h.job(name).status.restart_count >= 1
+                and launcher is not None and not launcher.failed())
+
+    h.drive_until(restarted, f"{name}: gang restart")
+    h.set_launcher_active(name)
+    return {name: _finish_and_snapshot(h, name)}
+
+
+def scenario_resize(h: ChaosHarness, name: str) -> Dict[str, Dict]:
+    h.create_job(name, tpus=8)                   # 2 workers
+    _run_to_running(h, name)
+    h.edit_spec(name, resize=4)                  # -> 1 worker
+
+    def resized() -> bool:
+        sets = h.worker_sets(name)
+        return bool(sets) and all(s.spec.replicas == 1 for s in sets)
+
+    h.drive_until(resized, f"{name}: resize to 1 worker")
+    h.make_workers_ready(name)
+    h.drive_until(lambda: h.launcher(name) is not None,
+                  f"{name}: post-resize launcher")
+    h.set_launcher_active(name)
+    return {name: _finish_and_snapshot(h, name)}
+
+
+def scenario_pack(h: ChaosHarness, name: str) -> Dict[str, Dict]:
+    first, second = name + "-a", name + "-b"
+    group = name + "-grp"
+    h.create_job(first, pack_group=group)
+    h.create_job(second, pack_group=group)
+    h.drive_until(
+        lambda: (h.cond(first, COND_PACKED) == "True"
+                 and h.cond(second, COND_PACKED) == "True"),
+        f"{name}: pack membership")
+    leaders = [n for n in (first, second)
+               if h.job(n).status.get_condition(COND_PACKED).reason
+               == "PackLeader"]
+    if len(leaders) != 1:
+        raise ConvergenceError(f"{name}: expected one pack leader, "
+                               f"got {leaders}", h.seed)
+    leader = leaders[0]
+    member = second if leader == first else first
+    _run_to_running(h, leader)
+    out = {leader: _finish_and_snapshot(h, leader)}
+    member_snap = h.snapshot_job(member)
+    member_snap["leaked"] = h.teardown(member)
+    out[member] = member_snap
+    return out
+
+
+def scenario_serving(h: ChaosHarness, name: str) -> Dict[str, Dict]:
+    h.create_job(name, tpus=8,
+                 serving=ServingSpec(prefill_replicas=1, decode_replicas=1))
+    h.drive_until(lambda: len(h.worker_sets(name)) == 2,
+                  f"{name}: prefill+decode pools")
+    _run_to_running(h, name)
+    return {name: _finish_and_snapshot(h, name)}
+
+
+SCENARIOS: Dict[str, Callable[[ChaosHarness, str], Dict[str, Dict]]] = {
+    "train": scenario_train,
+    "restart": scenario_restart,
+    "resize": scenario_resize,
+    "pack": scenario_pack,
+    "serving": scenario_serving,
+}
+
+
+# ---------------------------------------------------------------------------
+# oracle comparison + soak
+# ---------------------------------------------------------------------------
+
+def oracle_snapshots(kind: str, name: str) -> Dict[str, Dict]:
+    """The uninterrupted run: same scenario, healthy server, no crashes."""
+    return SCENARIOS[kind](ChaosHarness(), name)
+
+
+def _normalize(snaps: Dict[str, Dict], prefix: str) -> Dict:
+    """Strip the per-lifecycle name prefix so chaos and oracle runs with
+    different job names compare equal."""
+    out = {}
+    for job_name, snap in snaps.items():
+        out[job_name.replace(prefix, "<job>", 1)] = {
+            **snap,
+            "resources": [(k, n.replace(prefix, "<job>", 1))
+                          for k, n in snap["resources"]],
+        }
+    return out
+
+
+def soak(seed: int = 0, lifecycles: int = 25,
+         rules: Sequence = DEFAULT_RULES,
+         crash_every_write: bool = True) -> Dict:
+    """Drive `lifecycles` mixed job lifecycles under the full fault +
+    crash schedule; every lifecycle must match its oracle, leak nothing,
+    and leave no wedged workqueue key. Returns the soak report; raises
+    ConvergenceError (with the reproducer seed) on any violation."""
+    chaos = ChaosHarness(rules=rules, seed=seed,
+                         crash_every_write=crash_every_write)
+    oracles: Dict[str, Dict] = {}
+    completed = []
+    for i in range(lifecycles):
+        kind = LIFECYCLES[i % len(LIFECYCLES)]
+        name = f"soak{i}-{kind}"
+        snaps = SCENARIOS[kind](chaos, name)
+        got = _normalize(snaps, name)
+        if kind not in oracles:
+            oracles[kind] = _normalize(
+                oracle_snapshots(kind, f"oracle-{kind}"), f"oracle-{kind}")
+        want = oracles[kind]
+        if got != want:
+            raise ConvergenceError(
+                f"lifecycle {i} ({kind}) diverged from oracle:\n"
+                f"  chaos:  {json.dumps(got, sort_keys=True)}\n"
+                f"  oracle: {json.dumps(want, sort_keys=True)}", seed)
+        leaked = {n: s["leaked"] for n, s in snaps.items() if s["leaked"]}
+        if leaked:
+            raise ConvergenceError(
+                f"lifecycle {i} ({kind}) leaked resources: {leaked}", seed)
+        wedged = chaos.queue_wedged()
+        if wedged:
+            raise ConvergenceError(
+                f"lifecycle {i} ({kind}) left wedged workqueue keys: "
+                f"{wedged}", seed)
+        completed.append(name)
+    faults = {f"{verb}:{error}": n
+              for (verb, error), n in sorted(chaos.api.faults_injected.items())}
+    return {
+        "seed": seed,
+        "lifecycles": lifecycles,
+        "completed": len(completed),
+        "faults_injected": faults,
+        "total_faults": chaos.api.fault_count(),
+        "crashes": chaos.api.crashes,
+        "controller_restarts": chaos.controller_restarts,
+        "writes": chaos.api.writes,
+    }
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    import argparse
+    import logging
+    import sys
+
+    # injected faults are logged as sync errors by design; the soak's
+    # verdict is the JSON report, not the per-retry noise
+    logging.getLogger("tpujob-controller").setLevel(logging.CRITICAL)
+
+    parser = argparse.ArgumentParser(
+        description="chaos soak: fault-injected, crash-interrupted job "
+                    "lifecycles vs. the uninterrupted oracle")
+    parser.add_argument("--seed", type=int, default=0)
+    parser.add_argument("--lifecycles", type=int, default=25)
+    parser.add_argument("--rule", action="append", default=None,
+                        metavar="VERB/KIND=RATE:ERROR",
+                        help="fault rule (repeatable); default: "
+                             + " ".join(DEFAULT_RULES))
+    parser.add_argument("--no-crash", action="store_true",
+                        help="faults only, no kill at write boundaries")
+    opts = parser.parse_args(argv)
+    rules = opts.rule if opts.rule is not None else DEFAULT_RULES
+    try:
+        report = soak(seed=opts.seed, lifecycles=opts.lifecycles,
+                      rules=rules, crash_every_write=not opts.no_crash)
+    except ConvergenceError as exc:
+        print(f"CHAOS SOAK FAILED: {exc}", file=sys.stderr)
+        print(f"reproduce: python -m mpi_operator_tpu.controller.chaos "
+              f"--seed {opts.seed} --lifecycles {opts.lifecycles}",
+              file=sys.stderr)
+        return 1
+    print(json.dumps(report, indent=2, sort_keys=True))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -43,7 +43,8 @@ from ..api.types import (
     is_controlled_by,
 )
 from ..cluster.apiserver import (
-    AlreadyExistsError, InMemoryAPIServer, NotFoundError)
+    AlreadyExistsError, ApiError, ConflictError, InMemoryAPIServer,
+    NotFoundError, is_transient)
 from ..cluster.informers import InformerFactory
 from ..cluster.resources import (
     ConfigMap,
@@ -122,6 +123,23 @@ def _template_hash(template) -> str:
 
 ERR_RESOURCE_EXISTS = "ErrResourceExists"   # ref :88-96
 MSG_RESOURCE_EXISTS = "Resource %s already exists and is not managed by TPUJob"
+
+#: bounded RetryOnConflict attempts per status write (client-go's
+#: retry.DefaultRetry runs 5 steps); past this the sync raises and the
+#: key takes the ordinary rate-limited requeue instead of spinning
+MAX_CONFLICT_RETRIES = 4
+
+
+def _classify_requeue_reason(exc: BaseException) -> str:
+    """Label for tpu_operator_requeues_total{reason=...}: why a key went
+    back through the rate limiter."""
+    if isinstance(exc, ConflictError):
+        return "conflict"
+    if is_transient(exc):
+        return "transient"
+    if isinstance(exc, ApiError):
+        return "api_error"
+    return "error"
 
 
 def _probe_subset(desired: Optional[dict], existing: Optional[dict]) -> bool:
@@ -501,10 +519,16 @@ class TPUJobController:
             self.sync_handler(key)
             self.queue.forget(key)          # ref :399-404
             self.sync_counters.record(ok=True)
-        except Exception:                   # noqa: BLE001
-            logger.exception("error syncing %s; requeuing", key)
+        except Exception as exc:            # noqa: BLE001
+            # client-go discipline: NEVER give up the key. Transient API
+            # failures, conflicts that exhausted their in-place retries,
+            # and plain bugs all take the same rate-limited requeue; the
+            # per-reason counter keeps the causes distinguishable.
+            reason = _classify_requeue_reason(exc)
+            logger.exception("error syncing %s; requeuing (%s)", key, reason)
             self.queue.add_rate_limited(key)
             self.sync_counters.record_retry()
+            self.sync_counters.record_requeue(reason)
             self.sync_counters.record(ok=False)
         finally:
             # failure durations observed too: the slow FAILING sync is the
@@ -571,6 +595,17 @@ class TPUJobController:
                 and not invalid_spec)
         )
 
+        if (terminal and launcher is not None
+                and not (launcher.succeeded() or launcher.failed())
+                and failed_cond is not None
+                and failed_cond.reason == "StuckGang"):
+            # a StuckGang terminal verdict landed but the crash lost the
+            # launcher delete: finish the teardown, level-triggered — a
+            # wedged launcher holds the gang rendezvous open forever
+            self._delete_ignore_missing("Job", launcher.metadata.namespace,
+                                        launcher.metadata.name)
+            launcher = None
+
         # job packing (controller/packing.py): resolve this job's pack
         # from the informer view. A non-leader member short-circuits —
         # it creates NO pods; the leader's gang is its data plane.
@@ -590,29 +625,17 @@ class TPUJobController:
         # checkpoint.
         if (launcher is not None and launcher.failed() and not terminal
                 and self._should_restart(job, launcher)):
-            self.api.delete("Job", launcher.metadata.namespace,
-                            launcher.metadata.name)
-            job.status.restart_count += 1
-            job.status.set_condition(api.JobCondition(
-                api.COND_RESTARTING, "True", "TPUJobRestarting",
-                f"launcher failed (exit_code="
-                f"{launcher.status.exit_code}); restart "
-                f"{job.status.restart_count}"))
-            # keep the returned object: a second status PUT in this same
-            # sync (update_tpu_job_status) must carry the fresh RV or a
-            # real API server 409s it
-            job = self.api.update_status(job)
-            self.recorder.event(
-                job, "Normal", "TPUJobRestarting",
-                f"gang restart {job.status.restart_count}")
-            if self.observatory is not None:
-                # the timeline record carries the launcher exit code AND
-                # the last step frontier this controller observed — the
-                # goodput ledger charges restart-lost steps against it
-                self.observatory.note_restart(
-                    job.metadata.name,
-                    exit_code=launcher.status.exit_code,
-                    restart=job.status.restart_count)
+            # Crash-consistent ordering: count the restart in status FIRST
+            # (stamped with the failed launcher's uid so a crash-replayed
+            # sync never double-counts), THEN delete the launcher. The old
+            # delete-first order lost the count entirely when the process
+            # died between the two writes — the restarted controller found
+            # no failed launcher left to account for.
+            job = self._count_gang_restart(
+                job, launcher, "TPUJobRestarting",
+                f"launcher failed (exit_code={launcher.status.exit_code})")
+            self._delete_ignore_missing("Job", launcher.metadata.namespace,
+                                        launcher.metadata.name)
             launcher = None
 
         done = terminal or (launcher is not None and (
@@ -651,7 +674,7 @@ class TPUJobController:
             job.status.set_condition(api.JobCondition(
                 api.COND_FAILED, "False", "SpecValidated",
                 "spec is valid again; resuming reconciliation"))
-            job = self.api.update_status(job)
+            job = self._update_status_apply(job)
             self.recorder.event(job, "Normal", "SpecValidated",
                                 "spec is valid again")
 
@@ -677,8 +700,8 @@ class TPUJobController:
             # terminally fail a restart_policy=Never job — the readiness
             # gate below recreates it with the new env once the restarted
             # gang is Ready
-            self.api.delete("Job", launcher.metadata.namespace,
-                            launcher.metadata.name)
+            self._delete_ignore_missing("Job", launcher.metadata.namespace,
+                                        launcher.metadata.name)
             launcher = None
 
         # THE GATE: launcher starts only once ALL workers of ALL slices
@@ -714,7 +737,18 @@ class TPUJobController:
                 job.metadata.name, replicas=alloc.worker_replicas)
             self._observe_job(job, alloc)
 
-        if not done and workers_ready and launcher is None and not resized:
+        # progress lease (spec.progressDeadlineSeconds): consumes the
+        # scrape the observatory just took; a restart here deletes the
+        # gang, so launcher re-creation waits for the next sync's
+        # readiness gate
+        stuck_restarted = False
+        if not done and not resized and launcher is not None:
+            job, launcher, stuck_restarted = self._check_stuck_gang(
+                job, launcher, key)
+            done = done or job.status.is_done()
+
+        if (not done and workers_ready and launcher is None
+                and not resized and not stuck_restarted):
             launcher, _ = self._create_or_get(
                 self.new_launcher(job, alloc, pack=pack), job)
 
@@ -726,8 +760,8 @@ class TPUJobController:
         if (done and job.spec.clean_pod_policy == "All"
                 and launcher is not None
                 and (launcher.succeeded() or launcher.failed())):
-            self.api.delete("Job", launcher.metadata.namespace,
-                            launcher.metadata.name)
+            self._delete_ignore_missing("Job", launcher.metadata.namespace,
+                                        launcher.metadata.name)
 
         self.recorder.event(job, "Normal", "Synced", "TPUJob synced successfully")
 
@@ -744,25 +778,19 @@ class TPUJobController:
                f"replica {pack.index(member)} of {pack.k} "
                f"(group {pack.group!r})")
         if launcher is not None:
-            try:
-                self.api.delete("Job", launcher.metadata.namespace,
-                                launcher.metadata.name)
-            except NotFoundError:
-                pass
+            self._delete_ignore_missing("Job", launcher.metadata.namespace,
+                                        launcher.metadata.name)
         for sts in self.statefulset_lister.list(job.metadata.namespace):
             if (is_controlled_by(sts.metadata, job.metadata)
                     and sts.metadata.labels.get(LABEL_GROUP) == member):
-                try:
-                    self.api.delete("StatefulSet", sts.metadata.namespace,
-                                    sts.metadata.name)
-                except NotFoundError:
-                    pass
+                self._delete_ignore_missing(
+                    "StatefulSet", sts.metadata.namespace, sts.metadata.name)
         cond = job.status.get_condition(COND_PACKED)
         if not (cond is not None and cond.status == "True"
                 and cond.message == msg):
             job.status.set_condition(api.JobCondition(
                 COND_PACKED, "True", "PackedWithLeader", msg))
-            job = self.api.update_status(job)
+            job = self._update_status_apply(job)
             self.recorder.event(job, "Normal", "Packed", msg)
         leader = self.job_lister.try_get(job.metadata.namespace, pack.leader)
         if leader is not None:
@@ -780,7 +808,7 @@ class TPUJobController:
             return job
         job.status.set_condition(api.JobCondition(
             COND_PACKED, "True", "PackLeader", msg))
-        job = self.api.update_status(job)
+        job = self._update_status_apply(job)
         self.recorder.event(job, "Normal", "PackLeader", msg)
         if self.observatory is not None:
             self.observatory.note_packed(job.metadata.name,
@@ -821,17 +849,14 @@ class TPUJobController:
         if fresh:
             job.status.set_condition(api.JobCondition(
                 COND_FAILED, "True", "InvalidTPUJobSpec", message))
-            job = self.api.update_status(job)
+            job = self._update_status_apply(job)
             self.recorder.event(job, "Warning", "InvalidTPUJobSpec",
                                 message)
         if job.spec.clean_pod_policy == "None":
             return
         if launcher is not None:
-            try:
-                self.api.delete("Job", launcher.metadata.namespace,
-                                launcher.metadata.name)
-            except NotFoundError:
-                pass
+            self._delete_ignore_missing("Job", launcher.metadata.namespace,
+                                        launcher.metadata.name)
         for sts in self.statefulset_lister.list(job.metadata.namespace):
             if (is_controlled_by(sts.metadata, job.metadata)
                     and sts.metadata.labels.get(LABEL_GROUP)
@@ -888,7 +913,7 @@ class TPUJobController:
                 api.COND_DEGRADED, "False", "ElasticRestore",
                 f"retrying the full size (tpus={job.spec.tpus}) after the "
                 f"recovery window"))
-            job = self.api.update_status(job)
+            job = self._update_status_apply(job)
             self.recorder.event(
                 job, "Normal", "ElasticRestore",
                 f"restoring to spec size tpus={job.spec.tpus}")
@@ -921,7 +946,7 @@ class TPUJobController:
             f"{self.config.elastic_degraded_seconds}s; shrinking "
             f"{current} -> {next_total} chips (resumes from the latest "
             f"checkpoint)"))
-        job = self.api.update_status(job)
+        job = self._update_status_apply(job)
         self.recorder.event(
             job, "Warning", "ElasticShrink",
             f"shrinking to tpus={next_total} after persistent worker "
@@ -951,12 +976,15 @@ class TPUJobController:
     # gang-restart decision (v1alpha2 RestartPolicy, common_types.go:131-156)
     # ------------------------------------------------------------------
 
-    def _should_restart(self, job: TPUJob, launcher: Job) -> bool:
-        policy = job.spec.restart_policy
+    def _restart_budget_left(self, job: TPUJob) -> bool:
         cap = (job.spec.backoff_limit
                if job.spec.backoff_limit is not None
                else api.DEFAULT_BACKOFF_LIMIT)
-        if job.status.restart_count >= cap:
+        return job.status.restart_count < cap
+
+    def _should_restart(self, job: TPUJob, launcher: Job) -> bool:
+        policy = job.spec.restart_policy
+        if not self._restart_budget_left(job):
             return False
         if policy == "OnFailure":
             return True
@@ -967,6 +995,121 @@ class TPUJobController:
             # unknown code means the pod vanished — treat as retryable
             return code is None or code >= 128
         return False          # "Never" (v1alpha1 behavior)
+
+    def _count_gang_restart(self, job: TPUJob, launcher: Job,
+                            reason: str, detail: str) -> TPUJob:
+        """Record a gang restart in status exactly once per launcher
+        incarnation. The Restarting condition message carries the doomed
+        launcher's uid; a sync replayed after a mid-flight crash (status
+        write landed, launcher delete didn't) sees its own marker and
+        skips the increment — restart_count stays an honest count of
+        restarts against backoffLimit, not of sync attempts."""
+        marker = f"uid={launcher.metadata.uid}"
+        cond = job.status.get_condition(api.COND_RESTARTING)
+        if (cond is not None and cond.status == "True"
+                and marker in cond.message):
+            if self.observatory is not None:
+                # the crash may have landed the count but not the lease
+                # reset; re-arming is idempotent either way
+                self.observatory.reset_progress_lease(job.metadata.name)
+            return job
+        job.status.restart_count += 1
+        job.status.set_condition(api.JobCondition(
+            api.COND_RESTARTING, "True", reason,
+            f"{detail} (launcher {marker}); restart "
+            f"{job.status.restart_count}"))
+        # keep the returned object: a second status PUT in this same
+        # sync (update_tpu_job_status) must carry the fresh RV or a
+        # real API server 409s it
+        job = self._update_status_apply(job)
+        self.recorder.event(
+            job, "Normal", reason,
+            f"gang restart {job.status.restart_count}: {detail}")
+        if self.observatory is not None:
+            # the timeline record carries the launcher exit code AND
+            # the last step frontier this controller observed — the
+            # goodput ledger charges restart-lost steps against it
+            self.observatory.note_restart(
+                job.metadata.name,
+                exit_code=launcher.status.exit_code,
+                restart=job.status.restart_count)
+        return job
+
+    # ------------------------------------------------------------------
+    # stuck-gang detection (spec.progressDeadlineSeconds progress lease)
+    # ------------------------------------------------------------------
+
+    def _check_stuck_gang(self, job: TPUJob, launcher: Job,
+                          key: str) -> Tuple[TPUJob, Optional[Job], bool]:
+        """Progress lease: a Running job whose federated step frontier
+        (max of tpu_worker_step / last_checkpoint_step over every worker's
+        latest scrape — all-scrapes-stale freezes it too) advances by zero
+        across spec.progressDeadlineSeconds is declared stuck — a hung
+        host or stalled ICI that activeDeadlineSeconds would eventually
+        kill undiagnosed. The verdict emits a gang_stuck timeline record +
+        Warning event, records a StuckGang condition, and rides the
+        ordinary restart-policy path: the gang restart is counted against
+        backoffLimit, and an exhausted budget (or restartPolicy Never)
+        fails the job with reason StuckGang. Returns (job, launcher,
+        restarted); `restarted` gates launcher re-creation this sync.
+
+        Wake-ups ride queue.add_after, so the lease expires on schedule
+        even with no cluster events."""
+        deadline = job.spec.progress_deadline_seconds
+        if self.observatory is None or not deadline:
+            return job, launcher, False
+        running = job.status.get_condition(COND_RUNNING)
+        if running is None or running.status != "True":
+            return job, launcher, False
+        stall = self.observatory.stall_seconds(job.metadata.name)
+        if stall is None:       # lease not armed (gang not observed yet)
+            return job, launcher, False
+        if stall < deadline:
+            stuck_cond = job.status.get_condition(api.COND_STUCK)
+            if stuck_cond is not None and stuck_cond.status == "True":
+                # progress resumed: retire the verdict so the condition
+                # reads level-triggered truth, not history
+                job.status.set_condition(api.JobCondition(
+                    api.COND_STUCK, "False", "ProgressResumed",
+                    "step frontier advancing again"))
+                job = self._update_status_apply(job)
+            self.queue.add_after(key, deadline - stall)
+            return job, launcher, False
+        msg = (f"no observed step progress for {stall:.0f}s "
+               f"(progressDeadlineSeconds={deadline})")
+        stuck_cond = job.status.get_condition(api.COND_STUCK)
+        if not (stuck_cond is not None and stuck_cond.status == "True"):
+            job.status.set_condition(api.JobCondition(
+                api.COND_STUCK, "True", "ProgressDeadlineExceeded", msg))
+            self.recorder.event(job, "Warning", "GangStuck", msg)
+            self.observatory.note_stuck(
+                job.metadata.name, stall_seconds=round(stall, 3),
+                deadline=deadline)
+        if (job.spec.restart_policy in ("OnFailure", "ExitCode")
+                and self._restart_budget_left(job)):
+            # a hang is infra-shaped, not an application exit code:
+            # ExitCode policy treats it as retryable
+            job = self._count_gang_restart(job, launcher, "GangStuck", msg)
+            self._delete_ignore_missing("Job", launcher.metadata.namespace,
+                                        launcher.metadata.name)
+            # unlike a launcher failure, the wedged processes live in the
+            # WORKER pods — kubelet sees them Running and will never
+            # restart them on its own; the gang delete forces it
+            self._delete_worker_pods(job)
+            return job, None, True
+        # budget exhausted (or restartPolicy Never): the stall is terminal
+        job.status.set_condition(api.JobCondition(
+            COND_FAILED, "True", "StuckGang", msg))
+        job = self._update_status_apply(job)
+        self.recorder.event(job, "Warning", "StuckGang",
+                            f"job failed: {msg}")
+        if self.observatory is not None:
+            self.observatory.note_terminal(job.metadata.name,
+                                           succeeded=False,
+                                           reason="StuckGang")
+        self._delete_ignore_missing("Job", launcher.metadata.namespace,
+                                    launcher.metadata.name)
+        return job, None, True
 
     # ------------------------------------------------------------------
     # launcher lookup (ref getLauncherJob :522-544)
@@ -1126,6 +1269,40 @@ class TPUJobController:
             fetched = self.api.get(desired.kind, desired.metadata.namespace,
                                    desired.metadata.name)
             return self._check_ownership(fetched, job), False
+
+    def _update_status_apply(self, job: TPUJob) -> TPUJob:
+        """Status PUT with client-go's RetryOnConflict discipline: a 409
+        means our resourceVersion went stale, so re-read the object, graft
+        our computed status onto the fresh read, and retry — bounded, so a
+        persistently conflicting server degrades to the ordinary
+        rate-limited requeue instead of a hot loop. The graft is safe
+        because sync_handler holds this key exclusively (workqueue
+        processing-set semantics): nobody else computes status for it
+        concurrently. Every in-place retry is visible as
+        tpu_operator_requeues_total{reason="conflict"}."""
+        for _ in range(MAX_CONFLICT_RETRIES):
+            try:
+                return self.api.update_status(job)
+            except ConflictError:
+                self.sync_counters.record_requeue("conflict")
+                fresh = self.api.try_get(job.kind, job.metadata.namespace,
+                                         job.metadata.name)
+                if fresh is None:
+                    raise       # deleted under us; the requeued sync drops it
+                fresh.status = job.status
+                job = fresh
+        return self.api.update_status(job)
+
+    def _delete_ignore_missing(self, kind: str, namespace: str,
+                               name: str) -> bool:
+        """Idempotent delete: NotFound means an earlier attempt (possibly
+        one a crashed sync never saw the response to) already won. Returns
+        whether this call did the deleting."""
+        try:
+            self.api.delete(kind, namespace, name)
+            return True
+        except NotFoundError:
+            return False
 
     def get_or_create_config_map(self, job: TPUJob, alloc: AllocationResult) -> ConfigMap:
         """ref: getOrCreateConfigMap (:627-648) + newConfigMap (:849-885).
@@ -1323,8 +1500,8 @@ class TPUJobController:
                     and is_controlled_by(sts.metadata, job.metadata)
                     and sts.metadata.labels.get(LABEL_GROUP)
                     == job.metadata.name):
-                self.api.delete("StatefulSet", sts.metadata.namespace,
-                                sts.metadata.name)
+                self._delete_ignore_missing(
+                    "StatefulSet", sts.metadata.namespace, sts.metadata.name)
                 pruned = True
         resized = pruned or bool(stale_groups)
         if resized:
@@ -1745,8 +1922,8 @@ class TPUJobController:
                 label_selector=f"{LABEL_GROUP}={job.metadata.name},"
                                f"tpu_job_role=worker")
             for pod in pods:
-                self.api.delete("Pod", pod.metadata.namespace,
-                                pod.metadata.name)
+                self._delete_ignore_missing("Pod", pod.metadata.namespace,
+                                            pod.metadata.name)
             return True
         except Exception as exc:  # noqa: BLE001
             logger.warning("gang pod deletion failed (will retry): %s", exc)
@@ -2014,7 +2191,7 @@ class TPUJobController:
             # server STRIPS .status from plain PUTs — the reference could
             # use full Update (ref :789) only because its v1beta1 CRD
             # predates subresources.
-            self.api.update_status(job)
+            self._update_status_apply(job)
         # commit the crash baselines only now: if the status write above
         # raised (409 against a real server), the observed deltas stay
         # unconsumed and the requeued sync re-counts them

@@ -48,6 +48,11 @@ from ..telemetry.prometheus import escape_label_value, histogram_lines
 _PHASES = (api.COND_SUCCEEDED, api.COND_FAILED, api.COND_RESTARTING,
            api.COND_RUNNING, api.COND_CREATED)
 
+#: requeue reasons the run loop classifies (controller.py
+#: _classify_requeue_reason) — rendered zero-included so the series
+#: exist before the first fault ever fires
+_REQUEUE_REASONS = ("conflict", "transient", "api_error", "error")
+
 
 class SyncCounters:
     """Thread-safe sync outcome counters + the sync-duration histogram
@@ -58,6 +63,10 @@ class SyncCounters:
         self.syncs_total = 0
         self.sync_errors_total = 0
         self.workqueue_retries_total = 0
+        # reason -> count of retries, both queue-level requeues
+        # ("transient", "api_error", "error") and in-place conflict
+        # re-read-retries ("conflict") — every retry visible, by cause
+        self.requeues_by_reason: dict = {}
         # syncs are API-server round trips: µs buckets are dead weight,
         # but a wedged informer can stretch one past a minute
         self.sync_duration = Histogram(
@@ -75,12 +84,21 @@ class SyncCounters:
         with self._lock:
             self.workqueue_retries_total += 1
 
+    def record_requeue(self, reason: str) -> None:
+        with self._lock:
+            self.requeues_by_reason[reason] = \
+                self.requeues_by_reason.get(reason, 0) + 1
+
     def observe_sync(self, seconds: float) -> None:
         self.sync_duration.observe(seconds)
 
     def snapshot(self):
         with self._lock:
             return self.syncs_total, self.sync_errors_total
+
+    def requeues_snapshot(self) -> dict:
+        with self._lock:
+            return dict(self.requeues_by_reason)
 
 
 def job_phase(job) -> str:
@@ -116,7 +134,18 @@ def render_metrics(controller) -> str:
         "# TYPE tpu_operator_workqueue_retries_total counter",
         f"tpu_operator_workqueue_retries_total "
         f"{controller.sync_counters.workqueue_retries_total}",
+        "# HELP tpu_operator_requeues_total retries by cause: queue-level "
+        "requeues and in-place conflict re-read-retries",
+        "# TYPE tpu_operator_requeues_total counter",
     ]
+    # same zero-included discipline as jobs{phase}: the known reasons are
+    # always present so rate() never sees a series appear from nowhere;
+    # unknown reasons (future classifications) still render
+    by_reason = controller.sync_counters.requeues_snapshot()
+    for reason in sorted({*_REQUEUE_REASONS, *by_reason}):
+        lines.append(
+            f'tpu_operator_requeues_total{{reason="'
+            f'{escape_label_value(reason)}"}} {by_reason.get(reason, 0)}')
     lines += histogram_lines(controller.sync_counters.sync_duration)
     lines += [
         "# HELP tpu_operator_workqueue_depth queued + rate-limit-delayed keys",

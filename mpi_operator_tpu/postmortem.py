@@ -52,12 +52,14 @@ INCIDENTS = (
     ev.PREEMPTION_DRAIN, ev.EMERGENCY_CHECKPOINT, ev.CHECKPOINT_RESTORE,
     ev.CHECKPOINT_SAVED, ev.FIRST_RESUME_STEP, ev.DIVERGENCE_ROLLBACK,
     ev.FAULT_INJECTED, ev.REPLICA_FROZEN, ev.INIT_RETRY, ev.CLOCK_ANCHOR,
+    ev.GANG_STUCK,
 )
 
 _DETAIL_FIELDS = ("step", "from_step", "to_step", "last_observed_step",
                   "exit_code", "restart", "replicas", "num_slices", "tpus",
                   "workers", "k", "fault", "signal", "seconds", "leaves",
-                  "resharded", "stop_check_every", "path", "boot_id")
+                  "resharded", "stop_check_every", "path", "boot_id",
+                  "stall_seconds", "progress_deadline_seconds")
 
 
 def read_timeline(path: str) -> List[Dict]:
@@ -122,6 +124,10 @@ def summarize(records: Sequence[Dict]) -> Dict:
     # report can suggest a better one (see render).
     drain_open: Dict[str, Dict] = {}
     drain_latencies: List[Dict] = []
+    # stuck->restart pairing: a gang_stuck verdict opens a stall; the next
+    # gang_restart (or terminal job_failed) names how it was resolved —
+    # the incident a postmortem reader needs as ONE line, not two greps
+    stalls: List[Dict] = []
     for rec in records:
         kind = rec.get("event")
         entry = {
@@ -130,6 +136,17 @@ def summarize(records: Sequence[Dict]) -> Dict:
             "event": kind,
             "detail": _fmt_detail(rec),
         }
+        if kind == ev.GANG_STUCK:
+            stall = {"t": entry["t"],
+                     "stall_seconds": rec.get("stall_seconds"),
+                     "deadline": rec.get("progress_deadline_seconds"),
+                     "last_observed_step": rec.get("last_observed_step"),
+                     "resolution": None}
+            stalls.append(stall)
+        elif kind in (ev.GANG_RESTART, ev.JOB_FAILED) and stalls \
+                and stalls[-1]["resolution"] is None:
+            stalls[-1]["resolution"] = kind
+            stalls[-1]["resolution_t"] = entry["t"]
         if kind == ev.PREEMPTION_DRAIN:
             drain_open[entry["host"]] = {
                 "ts": rec.get("ts", t0),
@@ -178,6 +195,7 @@ def summarize(records: Sequence[Dict]) -> Dict:
         "incidents": incidents,
         "drain_latencies": drain_latencies,
         "suggested_stop_check_every": suggested,
+        "stalls": stalls,
         "resizes": resizes,
         "other_events": other,
         "ledger": goodput_ledger(records),
@@ -213,6 +231,27 @@ def render(summary: Dict, out: TextIO) -> None:
             out.write(f"  suggested --stop-check-every: {suggested}  "
                       f"(or TPU_STOP_CHECK_EVERY=auto to derive it from "
                       f"this run's events.jsonl)\n")
+
+    stalls = summary.get("stalls") or []
+    if stalls:
+        out.write("\nstuck gangs:\n")
+        for s in stalls:
+            window = (f"no step progress for "
+                      f"{_fmt_duration(float(s['stall_seconds']))}"
+                      if s.get("stall_seconds") is not None
+                      else "no step progress")
+            deadline = (f" (deadline {s['deadline']}s)"
+                        if s.get("deadline") is not None else "")
+            step = (f", last step {s['last_observed_step']}"
+                    if s.get("last_observed_step") is not None else "")
+            if s.get("resolution") == ev.GANG_RESTART:
+                fate = (f" -> gang restart at t={s['resolution_t']:.3f}s")
+            elif s.get("resolution") == ev.JOB_FAILED:
+                fate = (f" -> job failed at t={s['resolution_t']:.3f}s")
+            else:
+                fate = "  (unresolved)"
+            out.write(f"  stalled at t={s['t']:.3f}s: {window}{deadline}"
+                      f"{step}{fate}\n")
 
     resizes = summary.get("resizes") or []
     if resizes:

@@ -607,7 +607,12 @@ class JobObservatory:
             "federation": MetricsFederation(job, clock=self.clock),
             "clock_sync": ClockSync(),
             "controller_records": [], "worker_records": {},
-            "last_scrape": 0.0})
+            "last_scrape": 0.0,
+            # progress lease (stuck-gang detection): the highest step
+            # frontier ever observed for this gang incarnation and WHEN it
+            # last moved. progress_ts None = lease disarmed (not observed
+            # yet, or reset by a gang restart).
+            "progress_step": -1, "progress_ts": None})
 
     # -- controller lifecycle events ------------------------------------
     def record(self, job: str, event: str, **fields) -> Dict:
@@ -640,6 +645,36 @@ class JobObservatory:
         self.record(job, ev.GANG_RESTART, exit_code=exit_code,
                     restart=restart,
                     last_observed_step=view["federation"].observed_step())
+        # the restarted gang re-executes from its checkpoint: the old
+        # frontier must not keep an expired lease armed against it
+        self.reset_progress_lease(job)
+
+    def reset_progress_lease(self, job: str) -> None:
+        """Disarm the progress lease; the next observe() re-arms it at
+        whatever frontier the restarted gang actually reports. Idempotent
+        — crash-replayed restart syncs call this again harmlessly."""
+        view = self.view(job)
+        view["progress_step"] = -1
+        view["progress_ts"] = None
+
+    def stall_seconds(self, job: str) -> Optional[float]:
+        """Seconds since this job's observed step frontier last advanced
+        (all scrapes failing keeps the frontier frozen, so a dead metrics
+        plane reads as a stall too — by design: an unobservable gang
+        cannot prove liveness). None while the lease is disarmed."""
+        view = self.jobs.get(job)
+        if view is None or view.get("progress_ts") is None:
+            return None
+        return max(0.0, self.clock() - view["progress_ts"])
+
+    def note_stuck(self, job: str, stall_seconds: float,
+                   deadline: int) -> None:
+        """Record the gang_stuck verdict on the timeline with its stall
+        window — the postmortem renders stuck -> restart as an incident."""
+        view = self.view(job)
+        self.record(job, ev.GANG_STUCK, stall_seconds=stall_seconds,
+                    progress_deadline_seconds=deadline,
+                    last_observed_step=self._observed_step(view))
 
     def note_packed(self, job: str, group: str, members: List[str],
                     k: int,
@@ -707,6 +742,13 @@ class JobObservatory:
         if step > 0 and not view["first_step"]:
             view["first_step"] = True
             self.record(job, ev.FIRST_STEP_OBSERVED, step=step)
+        # progress lease: (re-)arm on the first scrape of an incarnation,
+        # then slide forward only when the frontier actually moves — zero
+        # advance (or every scrape failing) leaves progress_ts frozen and
+        # stall_seconds() growing
+        if step > view["progress_step"]:
+            view["progress_step"] = step
+            view["progress_ts"] = now
 
     def _observed_step(self, view: Dict) -> int:
         best = view["federation"].observed_step()

@@ -58,6 +58,11 @@ KV_HANDOFF = "kv_handoff"
 # "job" field and merged with worker records into <job>/timeline.jsonl):
 JOB_CREATED = "job_created"
 GANG_RESTART = "gang_restart"
+# progress lease expired (spec.progressDeadlineSeconds): a Running gang
+# whose federated step frontier advanced by zero across the window —
+# carries stall_seconds + last_observed_step; a GANG_RESTART (or
+# job_failed with reason StuckGang) ordinarily follows
+GANG_STUCK = "gang_stuck"
 PODS_READY = "pods_ready"
 FIRST_STEP_OBSERVED = "first_step_observed"
 JOB_PACKED = "packed"
@@ -280,6 +285,6 @@ __all__ = ["EventLog", "BoundEventLog", "read_events", "event_files",
            "SLOT_ADMIT", "SLOT_RETIRE", "CHECKPOINT_RESTORE",
            "CHECKPOINT_SAVED", "CLOCK_ANCHOR", "FAULT_INJECTED",
            "REPLICA_FROZEN", "RUN_COMPLETE", "JOB_CREATED",
-           "GANG_RESTART", "PODS_READY", "FIRST_STEP_OBSERVED",
+           "GANG_RESTART", "GANG_STUCK", "PODS_READY", "FIRST_STEP_OBSERVED",
            "JOB_PACKED", "JOB_RESIZED", "GANG_RESIZE",
            "FIRST_RESUME_STEP", "JOB_SUCCEEDED", "JOB_FAILED"]

@@ -59,3 +59,8 @@ def pytest_configure(config):
         "markers",
         "hfta: horizontally fused trainer tests (train/hfta.py); select "
         "with -m hfta to gate the job-packing data plane alone")
+    config.addinivalue_line(
+        "markers",
+        "chaos: fault-injection / crash-consistency soak tests "
+        "(controller/chaos.py harness); select with -m chaos, or run the "
+        "longer out-of-process soak via scripts/tier1.sh --chaos")
