@@ -294,6 +294,13 @@ COND_DEGRADED = "Degraded"
 # progress; flipped False with reason ProgressResumed once the federated
 # step frontier moves again
 COND_STUCK = "StuckGang"
+# beyond the reference: True while SOME worker ranks are unreachable to
+# the collector but the reachable remainder's progress frontier still
+# advances — a partial partition / scrape flakiness, observed but NOT
+# acted on (no restart; the StuckGang lease handles genuine stalls).
+# Flipped False with reason PartitionHealed once every rank scrapes
+# again. Distinct from COND_DEGRADED, which is the elastic-shrink state.
+COND_DEGRADED_GANG = "DegradedGang"
 
 # v1alpha1 launcher status surface kept for parity (ref types.go:102-116)
 LAUNCHER_ACTIVE = "Active"
@@ -423,7 +430,7 @@ __all__ = [
     "ServingSpec", "TPUJobSpec", "JobCondition", "ReplicaStatus",
     "TPUJobStatus", "TPUJob",
     "COND_CREATED", "COND_RUNNING", "COND_RESTARTING", "COND_SUCCEEDED",
-    "COND_FAILED", "COND_DEGRADED", "COND_STUCK",
+    "COND_FAILED", "COND_DEGRADED", "COND_STUCK", "COND_DEGRADED_GANG",
     "LAUNCHER_ACTIVE", "LAUNCHER_SUCCEEDED", "LAUNCHER_FAILED",
     "new_tpu_job", "deepcopy_obj",
 ]

@@ -23,6 +23,21 @@ readiness and launcher completion directly to the INNER server (the
 cluster's own state changes are not subject to faults aimed at the
 controller's client).
 
+On top of the control-plane soak, the DATA-plane soak (same module,
+same CLI) injects faults into the collector's per-pod scrapes
+(telemetry/chaos.py ScrapeFaultInjector) and drives the verdicts that
+depend on observed progress rather than API state:
+
+  - partial partition: one rank hard-dark while the rest keep
+    reporting — a DegradedGang condition, NEVER a restart (zero false
+    positives under pure scrape flakiness);
+  - wedged serving gang: a Running serving job whose retired-token
+    frontier freezes is caught by the SAME progress lease that catches
+    training stalls, within progressDeadlineSeconds;
+  - request timeouts: an in-process paged engine retires every
+    past-deadline request with zero leaked slots and zero leaked KV
+    pages (PageAllocator.check() clean).
+
 Run the standalone soak (scripts/tier1.sh --chaos uses this)::
 
     python -m mpi_operator_tpu.controller.chaos --seed 42 --lifecycles 25
@@ -33,6 +48,7 @@ replays the identical fault sequence.
 from __future__ import annotations
 
 import json
+import tempfile
 from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 from ..api import types as api
@@ -43,6 +59,8 @@ from ..api.types import (
 from ..cluster.apiserver import ApiError, InMemoryAPIServer
 from ..cluster.chaos import ControllerCrash, FaultingAPIServer
 from ..cluster.workqueue import RateLimitingQueue
+from ..telemetry.chaos import ScrapeFaultInjector, ScrapeFaultRule
+from ..telemetry.collector import JobObservatory
 from .controller import (
     LAUNCHER_SUFFIX, ControllerConfig, TPUJobController,
 )
@@ -68,6 +86,16 @@ DEFAULT_RULES = (
 #: pack, disagg split, teardown — teardown ends every lifecycle)
 LIFECYCLES = ("train", "restart", "resize", "pack", "serving")
 
+#: the data-plane fault mix: rank 0 HARD-dark (the partial partition the
+#: degraded leg asserts on) while the surviving rank is merely flaky —
+#: stale replays and slow links that must neither advance nor freeze the
+#: frontier for long enough to matter
+DEFAULT_SCRAPE_RULES = (
+    "0/fail=1",
+    "1/stale-replay=0.2",
+    "1/delay=0.1",
+)
+
 
 class ConvergenceError(AssertionError):
     """A lifecycle failed to converge (or converged to the wrong state)
@@ -90,7 +118,8 @@ class ChaosHarness:
 
     def __init__(self, rules: Sequence = (), seed: int = 0,
                  crash_every_write: bool = False,
-                 config: Optional[ControllerConfig] = None):
+                 config: Optional[ControllerConfig] = None,
+                 scrape_faults: Sequence = ()):
         self.inner = InMemoryAPIServer()
         self.api = FaultingAPIServer(self.inner, rules=rules, seed=seed)
         self.seed = seed
@@ -98,8 +127,26 @@ class ChaosHarness:
         self.config = config or ControllerConfig()
         self.ns = self.config.namespace or "default"
         self.controller_restarts = 0
+        # data-plane fault rules (telemetry/chaos.py syntax); the
+        # injector itself is built when an observatory is attached
+        self.scrape_rules: Tuple[ScrapeFaultRule, ...] = tuple(
+            r if isinstance(r, ScrapeFaultRule) else ScrapeFaultRule.parse(r)
+            for r in scrape_faults)
+        self.scrape_injector: Optional[ScrapeFaultInjector] = None
         self.controller: Optional[TPUJobController] = None
         self._build_controller()
+
+    def attach_observatory(self, obs: JobObservatory) -> None:
+        """Wire an observatory into the CURRENT controller incarnation,
+        threading the harness's scrape-fault injector into its fetches.
+        The injector is harness-lifetime (like the FaultingAPIServer):
+        a controller restart gets a fresh process image but the network
+        it scrapes through keeps its faults."""
+        if self.scrape_rules and self.scrape_injector is None:
+            self.scrape_injector = ScrapeFaultInjector(self.scrape_rules,
+                                                       seed=self.seed)
+        obs.scrape_injector = self.scrape_injector
+        self.controller.observatory = obs
 
     # -- controller lifecycle ------------------------------------------------
 
@@ -456,6 +503,240 @@ def soak(seed: int = 0, lifecycles: int = 25,
     }
 
 
+# ---------------------------------------------------------------------------
+# data-plane soak: scrape faults, the serving progress lease, request
+# timeouts. These legs are NOT oracle-diffed — their whole point is
+# conditions (DegradedGang) the healthy universe never grows — so each
+# asserts its contract explicitly and raises ConvergenceError (with the
+# reproducer seed) on violation.
+# ---------------------------------------------------------------------------
+
+def _observed_harness(seed: int, fetch: Callable[[str], str],
+                      scrape_faults: Sequence = ()):
+    """A harness + fake-clock observatory wired for data-plane legs:
+    scrapes go through `fetch` (and the harness's injector, when rules
+    are given), time is the returned clock dict — no wall-clock
+    dependence, so a (seed, rules) pair replays exactly."""
+    h = ChaosHarness(config=ControllerConfig(worker_metrics_port=9100),
+                     seed=seed, scrape_faults=scrape_faults)
+    clock = {"now": 1000.0}
+    obs = JobObservatory(events_dir=tempfile.mkdtemp(prefix="dp-chaos-"),
+                         clock=lambda: clock["now"], fetch=fetch,
+                         scrape_interval=0.0)
+    h.attach_observatory(obs)
+    return h, obs, clock
+
+
+def data_plane_degraded(seed: int = 0,
+                        scrape_faults: Sequence = DEFAULT_SCRAPE_RULES,
+                        ) -> Dict:
+    """Partial partition under pure scrape flakiness: rank 0 dark for
+    two deadline-widths of wall clock while rank 1's step frontier keeps
+    advancing. The gang must be marked DegradedGang — and NEVER
+    restarted or declared stuck — then heal to PartitionHealed the
+    moment every rank scrapes again."""
+    step = {"v": 5}
+
+    def fetch(url):
+        if url.endswith("/metrics"):
+            return f"tpu_worker_step {step['v']}\n"
+        raise IOError("no events endpoint in this universe")
+
+    h, obs, clock = _observed_harness(seed, fetch,
+                                      scrape_faults=scrape_faults)
+    name = "dp-degraded"
+    h.create_job(name, restart_policy="OnFailure",
+                 progress_deadline_seconds=60)
+    sync = lambda: h.controller.sync_handler(f"{h.ns}/{name}")  # noqa: E731
+    sync()
+    h.resync()
+    h.make_workers_ready(name)
+    sync()
+    h.resync()
+    h.set_launcher_active(name)
+    h.resync()
+    sync()
+    h.resync()
+    saw_degraded = False
+    for _ in range(12):                     # 120s > 2x the 60s deadline
+        clock["now"] += 10
+        step["v"] += 1
+        sync()
+        h.resync()
+        job = h.job(name)
+        cond = job.status.get_condition(api.COND_DEGRADED_GANG)
+        saw_degraded = saw_degraded or (cond is not None
+                                        and cond.status == "True")
+        if job.status.restart_count:
+            raise ConvergenceError(
+                "degraded leg: scrape flakiness alone restarted the gang "
+                "(a false-positive stuck verdict)", seed)
+        stuck = job.status.get_condition(api.COND_STUCK)
+        if stuck is not None and stuck.status == "True":
+            raise ConvergenceError(
+                "degraded leg: partially observable gang declared stuck "
+                "while its frontier was advancing", seed)
+    if not saw_degraded:
+        raise ConvergenceError(
+            "degraded leg: rank 0 dark for 120s never produced a "
+            "DegradedGang condition", seed)
+    faults = h.scrape_injector.fault_count() if h.scrape_injector else 0
+    # heal: the partition lifts; the condition must retire, not linger
+    obs.scrape_injector = None
+    clock["now"] += 10
+    step["v"] += 1
+    sync()
+    h.resync()
+    cond = h.job(name).status.get_condition(api.COND_DEGRADED_GANG)
+    if cond is None or cond.status != "False" \
+            or cond.reason != "PartitionHealed":
+        raise ConvergenceError(
+            f"degraded leg: heal did not retire the condition (got "
+            f"{cond and (cond.status, cond.reason)})", seed)
+    degraded = [r for r in obs.merged_records(name)
+                if r["event"] == "gang_degraded"]
+    opened = [r for r in degraded if not r.get("healed")]
+    healed = [r for r in degraded if r.get("healed")]
+    if not opened or len(healed) != 1:
+        raise ConvergenceError(
+            f"degraded leg: expected one closed degraded window in the "
+            f"timeline, got {len(opened)} open / {len(healed)} healed",
+            seed)
+    return {
+        "degraded_windows": len(healed),
+        "scrape_faults_injected": faults,
+        "false_positive_restarts": h.job(name).status.restart_count,
+    }
+
+
+def data_plane_serving_lease(seed: int = 0) -> Dict:
+    """The serving progress lease end to end: a Running serving gang
+    whose retired-request/token frontier advances is left alone for two
+    deadline-widths; the moment the frontier freezes it is declared
+    stuck — via the token counters, within progressDeadlineSeconds —
+    and restarted through the ordinary restart-policy path."""
+    frontier = {"requests": 0, "tokens": 0}
+
+    def fetch(url):
+        if url.endswith("/metrics"):
+            return (f"tpu_worker_requests_total {frontier['requests']}\n"
+                    f"tpu_worker_tokens_total {frontier['tokens']}\n")
+        raise IOError("no events endpoint in this universe")
+
+    h, obs, clock = _observed_harness(seed, fetch)
+    name = "dp-serving"
+    deadline = 60
+    h.create_job(name, tpus=8, restart_policy="OnFailure",
+                 progress_deadline_seconds=deadline,
+                 serving=ServingSpec(prefill_replicas=1, decode_replicas=1))
+    h.drive_until(lambda: len(h.worker_sets(name)) == 2,
+                  f"{name}: prefill+decode pools")
+    h.make_workers_ready(name)
+    h.drive_until(lambda: h.launcher(name) is not None, f"{name}: launcher")
+    h.set_launcher_active(name)
+    h.drive_until(lambda: h.cond(name, COND_RUNNING) == "True",
+                  f"{name}: Running")
+    sync = lambda: h.controller.sync_handler(f"{h.ns}/{name}")  # noqa: E731
+    for _ in range(8):                      # 120s of live traffic
+        clock["now"] += 15
+        frontier["requests"] += 2
+        frontier["tokens"] += 40
+        sync()
+        h.resync()
+    job = h.job(name)
+    if job.status.restart_count or \
+            job.status.get_condition(api.COND_STUCK) is not None:
+        raise ConvergenceError(
+            "serving leg: an advancing token frontier tripped the "
+            "progress lease", seed)
+    # the engine wedges: requests stop retiring, the frontier freezes
+    clock["now"] += deadline + 10
+    sync()
+    h.resync()
+    job = h.job(name)
+    stuck = job.status.get_condition(api.COND_STUCK)
+    if stuck is None or stuck.status != "True":
+        raise ConvergenceError(
+            "serving leg: frozen token frontier not declared stuck "
+            "within progressDeadlineSeconds", seed)
+    if job.status.restart_count != 1:
+        raise ConvergenceError(
+            f"serving leg: expected exactly one restart of the wedged "
+            f"gang, got {job.status.restart_count}", seed)
+    stuck_recs = [r for r in obs.merged_records(name)
+                  if r["event"] == "gang_stuck"]
+    if not stuck_recs:
+        raise ConvergenceError(
+            "serving leg: stuck verdict left no gang_stuck timeline "
+            "record", seed)
+    return {"serving_stalls_detected": len(stuck_recs),
+            "serving_false_positives": 0}
+
+
+def data_plane_request_timeouts(seed: int = 0) -> Dict:
+    """Engine-side lease enforcement: every request admitted with an
+    already-expired deadline (request_timeout=0, the degenerate worst
+    case) must retire with finish_reason "timeout" leaking NO slots and
+    NO KV pages — and the engine must still serve afterwards. Imports
+    jax lazily so the control-plane soak stays light."""
+    import jax
+    import jax.numpy as jnp
+    from flax.core import meta as flax_meta
+
+    from ..models import CausalLM, gpt2_config
+    from ..serve import EngineConfig, Request, ServingEngine
+
+    cfg = gpt2_config("test", attention="dense", dtype=jnp.float32,
+                      vocab_size=64, max_len=64)
+    model = CausalLM(cfg)
+    probe = jnp.zeros((1, 4), jnp.int32)
+    params = flax_meta.unbox(
+        model.init(jax.random.PRNGKey(seed), probe))["params"]
+    engine = ServingEngine(model, params, EngineConfig(
+        slots=2, chunk_buckets=(4, 8), paged=True, page_size=8,
+        rng_seed=seed, request_timeout=0.0))
+    reqs = [Request(i, [1 + (i % 5)] * 6, 16) for i in range(5)]
+    results = engine.run(reqs)
+    timeouts = sum(1 for r in results.values()
+                   if r.finish_reason == "timeout")
+    if timeouts != len(reqs):
+        raise ConvergenceError(
+            f"timeout leg: {len(reqs)} expired requests, only {timeouts} "
+            f"retired as timeouts", seed)
+    engine.page_allocator.check()           # raises on refcount damage
+    leaked_pages = engine.page_allocator.in_use
+    leaked_slots = engine.config.slots - len(engine.slots.free)
+    if leaked_pages or leaked_slots:
+        raise ConvergenceError(
+            f"timeout leg: leaked {leaked_pages} pages / {leaked_slots} "
+            f"slots after request timeouts", seed)
+    # lift the timeout: the same engine (same slots, same pool) must
+    # complete a fresh request normally — the reclaim was real
+    engine.config.request_timeout = None
+    after = engine.run([Request(99, [2, 3, 4, 5], 4)])
+    if after[99].finish_reason not in ("eos", "length"):
+        raise ConvergenceError(
+            f"timeout leg: post-timeout request finished "
+            f"{after[99].finish_reason!r}, engine did not recover", seed)
+    return {"request_timeouts": timeouts,
+            "leaked_pages": leaked_pages,
+            "leaked_slots": leaked_slots}
+
+
+def data_plane_soak(seed: int = 0,
+                    scrape_faults: Sequence = DEFAULT_SCRAPE_RULES,
+                    engine_leg: bool = True) -> Dict:
+    """All three data-plane legs; one merged report. `engine_leg=False`
+    skips the jax-importing request-timeout leg (unit tests cover it
+    in-process; the out-of-process soak runs everything)."""
+    report: Dict = {}
+    report.update(data_plane_degraded(seed, scrape_faults))
+    report.update(data_plane_serving_lease(seed))
+    if engine_leg:
+        report.update(data_plane_request_timeouts(seed))
+    return report
+
+
 def main(argv: Optional[Sequence[str]] = None) -> int:
     import argparse
     import logging
@@ -476,11 +757,23 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                              + " ".join(DEFAULT_RULES))
     parser.add_argument("--no-crash", action="store_true",
                         help="faults only, no kill at write boundaries")
+    parser.add_argument("--scrape-faults", action="append", default=None,
+                        metavar="RANK/KIND=RATE",
+                        help="data-plane scrape fault rule (repeatable); "
+                             "default: " + " ".join(DEFAULT_SCRAPE_RULES))
+    parser.add_argument("--no-data-plane", action="store_true",
+                        help="control-plane soak only (skip scrape-fault, "
+                             "serving-lease, and request-timeout legs)")
     opts = parser.parse_args(argv)
     rules = opts.rule if opts.rule is not None else DEFAULT_RULES
+    scrape_rules = (opts.scrape_faults if opts.scrape_faults is not None
+                    else DEFAULT_SCRAPE_RULES)
     try:
         report = soak(seed=opts.seed, lifecycles=opts.lifecycles,
                       rules=rules, crash_every_write=not opts.no_crash)
+        if not opts.no_data_plane:
+            report["data_plane"] = data_plane_soak(
+                seed=opts.seed, scrape_faults=scrape_rules)
     except ConvergenceError as exc:
         print(f"CHAOS SOAK FAILED: {exc}", file=sys.stderr)
         print(f"reproduce: python -m mpi_operator_tpu.controller.chaos "

@@ -736,6 +736,11 @@ class TPUJobController:
             self.observatory.note_pods_ready(
                 job.metadata.name, replicas=alloc.worker_replicas)
             self._observe_job(job, alloc)
+            # partial-partition verdict off the scrape just taken: some
+            # ranks dark + frontier advancing = DegradedGang (observed,
+            # never restarted); genuine stalls stay with the progress
+            # lease below
+            job = self._check_degraded_gang(job)
 
         # progress lease (spec.progressDeadlineSeconds): consumes the
         # scrape the observatory just took; a restart here deletes the
@@ -821,13 +826,56 @@ class TPUJobController:
         """One federation pass: scrape every worker pod's /metrics and
         /events through the observatory (rate-limited there). Targets
         come from the same slice-major hostname order as the discovery
-        data, so replica_rank labels match TPU_PROCESS_ID."""
+        data, so replica_rank labels match TPU_PROCESS_ID. Serving jobs
+        flip the progress frontier to the retired-request/token counters
+        (a serving gang has no training step to watch)."""
         if self.observatory is None or not self.config.worker_metrics_port:
             return
         targets = {
             rank: f"http://{host}:{self.config.worker_metrics_port}"
             for rank, host in enumerate(self.worker_hostnames(job, alloc))}
-        self.observatory.observe(job.metadata.name, targets)
+        self.observatory.observe(job.metadata.name, targets,
+                                 serving=job.spec.serving is not None)
+
+    def _check_degraded_gang(self, job: TPUJob) -> TPUJob:
+        """Partial-partition verdict off the latest scrape pass: SOME
+        worker ranks unreachable while the rest still report. Observed,
+        never acted on — a DegradedGang condition + gang_degraded
+        timeline record, NO restart: scrape flakiness alone must never
+        kill a healthy gang. Genuine stalls (including every rank dark,
+        which freezes the frontier) stay with the StuckGang progress
+        lease — an unobservable gang cannot prove liveness, a partially
+        observable one can."""
+        if self.observatory is None:
+            return job
+        name = job.metadata.name
+        dark, total = self.observatory.partition_state(name)
+        cond = job.status.get_condition(api.COND_DEGRADED_GANG)
+        if dark and len(dark) < total:
+            msg = (f"ranks {','.join(str(r) for r in dark)} unreachable "
+                   f"({len(dark)}/{total}); progress still observed via "
+                   f"the reachable remainder")
+            self.observatory.note_degraded(name, dark, total)
+            if not (cond is not None and cond.status == "True"
+                    and cond.message == msg):
+                job.status.set_condition(api.JobCondition(
+                    api.COND_DEGRADED_GANG, "True", "PartialPartition",
+                    msg))
+                job = self._update_status_apply(job)
+                self.recorder.event(job, "Warning", "DegradedGang", msg)
+        elif not dark:
+            self.observatory.note_degraded_healed(name)
+            if cond is not None and cond.status == "True":
+                healed = "every worker rank scraping again"
+                job.status.set_condition(api.JobCondition(
+                    api.COND_DEGRADED_GANG, "False", "PartitionHealed",
+                    healed))
+                job = self._update_status_apply(job)
+                self.recorder.event(job, "Normal", "PartitionHealed",
+                                    healed)
+        # every rank dark is NOT "degraded": that is the all-stale freeze
+        # the progress lease owns — leave the condition untouched
+        return job
 
     def _fail_invalid_spec(self, job: TPUJob, message: str,
                            launcher: Optional[Job] = None) -> None:

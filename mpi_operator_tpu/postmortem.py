@@ -52,14 +52,16 @@ INCIDENTS = (
     ev.PREEMPTION_DRAIN, ev.EMERGENCY_CHECKPOINT, ev.CHECKPOINT_RESTORE,
     ev.CHECKPOINT_SAVED, ev.FIRST_RESUME_STEP, ev.DIVERGENCE_ROLLBACK,
     ev.FAULT_INJECTED, ev.REPLICA_FROZEN, ev.INIT_RETRY, ev.CLOCK_ANCHOR,
-    ev.GANG_STUCK,
+    ev.GANG_STUCK, ev.GANG_DEGRADED, ev.REQUEST_TIMEOUT,
 )
 
 _DETAIL_FIELDS = ("step", "from_step", "to_step", "last_observed_step",
                   "exit_code", "restart", "replicas", "num_slices", "tpus",
                   "workers", "k", "fault", "signal", "seconds", "leaves",
                   "resharded", "stop_check_every", "path", "boot_id",
-                  "stall_seconds", "progress_deadline_seconds")
+                  "stall_seconds", "progress_deadline_seconds",
+                  "ranks", "partitioned_ranks", "total_ranks", "healed",
+                  "request", "new_tokens", "deadline_seconds")
 
 
 def read_timeline(path: str) -> List[Dict]:
@@ -128,6 +130,10 @@ def summarize(records: Sequence[Dict]) -> Dict:
     # gang_restart (or terminal job_failed) names how it was resolved —
     # the incident a postmortem reader needs as ONE line, not two greps
     stalls: List[Dict] = []
+    # degraded-window pairing, same shape: a gang_degraded record opens a
+    # window (further opens update the rank set in place), the healed=True
+    # record — or a terminal event — closes it
+    degraded: List[Dict] = []
     for rec in records:
         kind = rec.get("event")
         entry = {
@@ -147,6 +153,24 @@ def summarize(records: Sequence[Dict]) -> Dict:
                 and stalls[-1]["resolution"] is None:
             stalls[-1]["resolution"] = kind
             stalls[-1]["resolution_t"] = entry["t"]
+        if kind == ev.GANG_DEGRADED:
+            open_win = degraded and degraded[-1]["resolution"] is None
+            if rec.get("healed"):
+                if open_win:
+                    degraded[-1]["resolution"] = "healed"
+                    degraded[-1]["resolution_t"] = entry["t"]
+            elif open_win:
+                degraded[-1]["ranks"] = rec.get("ranks")   # set changed
+            else:
+                degraded.append({
+                    "t": entry["t"],
+                    "ranks": rec.get("ranks"),
+                    "total_ranks": rec.get("total_ranks"),
+                    "resolution": None})
+        elif kind in (ev.JOB_FAILED, ev.JOB_SUCCEEDED) and degraded \
+                and degraded[-1]["resolution"] is None:
+            degraded[-1]["resolution"] = kind
+            degraded[-1]["resolution_t"] = entry["t"]
         if kind == ev.PREEMPTION_DRAIN:
             drain_open[entry["host"]] = {
                 "ts": rec.get("ts", t0),
@@ -196,6 +220,7 @@ def summarize(records: Sequence[Dict]) -> Dict:
         "drain_latencies": drain_latencies,
         "suggested_stop_check_every": suggested,
         "stalls": stalls,
+        "degraded": degraded,
         "resizes": resizes,
         "other_events": other,
         "ledger": goodput_ledger(records),
@@ -252,6 +277,27 @@ def render(summary: Dict, out: TextIO) -> None:
                 fate = "  (unresolved)"
             out.write(f"  stalled at t={s['t']:.3f}s: {window}{deadline}"
                       f"{step}{fate}\n")
+
+    degraded = summary.get("degraded") or []
+    if degraded:
+        out.write("\ndegraded gangs:\n")
+        for d in degraded:
+            ranks = d.get("ranks")
+            who = (f"rank(s) {', '.join(str(r) for r in ranks)}"
+                   if ranks else "some ranks")
+            total = (f" of {d['total_ranks']}"
+                     if d.get("total_ranks") else "")
+            if d.get("resolution") == "healed":
+                width = d["resolution_t"] - d["t"]
+                fate = (f" -> healed at t={d['resolution_t']:.3f}s "
+                        f"(window {_fmt_duration(width)})")
+            elif d.get("resolution") is not None:
+                fate = (f" -> {d['resolution']} at "
+                        f"t={d['resolution_t']:.3f}s")
+            else:
+                fate = "  (unresolved)"
+            out.write(f"  {who}{total} unreachable from t={d['t']:.3f}s, "
+                      f"progress still observed — no restart{fate}\n")
 
     resizes = summary.get("resizes") or []
     if resizes:

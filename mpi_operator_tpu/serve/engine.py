@@ -103,7 +103,18 @@ class EngineConfig:
     capacity wins then come from requests that DON'T use their worst
     case). `prefix_cache` publishes fully-prefilled prompt pages for
     cross-request sharing; False keeps pure paging. `admit_lookahead`
-    bounds the packing scan past a head-of-queue that doesn't fit."""
+    bounds the packing scan past a head-of-queue that doesn't fit.
+
+    `request_timeout` (seconds, None = off) stamps a deadline on every
+    request at ADMISSION (RequestState.deadline); the run loop's sweep
+    retires a past-deadline request with finish_reason "timeout" through
+    the normal retire path — slot and KV pages reclaimed like any EOS,
+    plus a request_timeout event. This is the engine-side half of the
+    serving progress lease: one wedged request cannot pin a slot (and
+    its pages) forever, so the retired-request/token frontier the
+    controller watches keeps moving unless the whole engine is stuck.
+    In the disaggregated facade each pool stamps its own window (prefill
+    admission and decode install each start a fresh deadline)."""
     slots: int = 8
     chunk_buckets: Tuple[int, ...] = (32, 128, 512)
     decode_kernel: Optional[bool] = None
@@ -114,6 +125,7 @@ class EngineConfig:
     num_pages: Optional[int] = None
     prefix_cache: bool = True
     admit_lookahead: int = 8
+    request_timeout: Optional[float] = None
 
 
 @dataclasses.dataclass
@@ -121,8 +133,10 @@ class RequestResult:
     id: int
     tokens: List[int]                 # new tokens only (no prompt)
     logprobs: List[float]
-    finish_reason: str                # "eos" | "length"
+    finish_reason: str                # "eos" | "length" | "timeout"
     ttft: float                       # arrival → first new token, seconds
+    #                                   (-1.0 when the request timed out
+    #                                   before its first token)
     token_times: List[float]          # absolute (run-relative) per token
     cached_tokens: int = 0            # prompt span served from the prefix
     #                                   cache (paged mode; 0 = cold)
@@ -636,7 +650,10 @@ class ServingEngine:
         by run() and the disaggregated facade's prefill side."""
         alloc = self.page_allocator
         tel = self.telemetry
+        timeout = self.config.request_timeout
         for st in admitted:
+            if timeout is not None:
+                st.deadline = st.admitted_at + timeout
             self.slots.bind(st)
             if self.events is not None:
                 self.events.emit(ev.SLOT_ADMIT, request=st.req.id,
@@ -679,10 +696,37 @@ class ServingEngine:
             id=st.req.id, tokens=list(st.generated),
             logprobs=list(st.logprobs),
             finish_reason=st.finish_reason,
-            ttft=st.token_times[0] - st.req.arrival,
+            # a request timed out before its first token has no TTFT
+            ttft=(st.token_times[0] - st.req.arrival
+                  if st.token_times else -1.0),
             token_times=list(st.token_times),
             cached_tokens=st.cached_tokens,
             admitted_at=st.admitted_at)
+
+    def _sweep_timeouts(self, now: float,
+                        results: Dict[int, "RequestResult"]) -> None:
+        """Retire every resident state past its deadline with
+        finish_reason "timeout" — through _retire_state, so the slot and
+        pages come back exactly like an EOS retirement. Marking the state
+        done here also makes any in-flight decode step's sync skip it
+        (same discipline as a length retirement): the junk token the
+        dispatched step produces for its old slot is discarded, and the
+        row's next occupant overwrites its K/V."""
+        if self.config.request_timeout is None:
+            return
+        for st in list(self.scheduler.active):
+            if st.done or st.deadline is None or now < st.deadline:
+                continue
+            st.finish_reason = "timeout"
+            st.chunks = []        # a mid-prefill request stops consuming
+            #                       windows; nothing re-plans a done state
+            if self.events is not None:
+                self.events.emit(ev.REQUEST_TIMEOUT, request=st.req.id,
+                                 slot=st.slot,
+                                 new_tokens=len(st.generated),
+                                 deadline_seconds=self.config
+                                 .request_timeout)
+            self._retire_state(st, results)
 
     def run(self, requests: Sequence[Request] = (),
             on_token: Optional[Callable[[Request, int], None]] = None,
@@ -721,6 +765,9 @@ class ServingEngine:
         pending = None
         while not (self.scheduler.idle and pending is None):
             now = now_fn()
+            # deadline sweep FIRST: a wedged head-of-queue request frees
+            # its slot before this iteration's admission fills the rows
+            self._sweep_timeouts(now, results)
             with span("serve.schedule"):
                 self._note_admissions(
                     self.scheduler.admit(self.slots.free, now,
@@ -849,6 +896,10 @@ class DecodeEngine(ServingEngine):
         slot = self.slots.free.pop(0)
         st = RequestState(req=req, slot=slot, pos=p1, chunks=[],
                           next_input=int(req.prompt[-1]), admitted_at=now)
+        if self.config.request_timeout is not None:
+            # the decode pool stamps its OWN window — the prefill-side
+            # deadline was consumed getting the request this far
+            st.deadline = now + self.config.request_timeout
         st.page_table = table
         st.owned_pages = chain + private
         st.cached_tokens = cached_tokens
@@ -1029,6 +1080,38 @@ class DisaggEngine:
                              pages=moved, cached_pages=chain_hits,
                              seconds=dt)
 
+    def _sweep_handoff_timeouts(self, now: float,
+                                results: Dict[int, RequestResult]) -> None:
+        """Expire past-deadline requests parked in the handoff queue.
+        These left the prefill scheduler already (take_prefilled) but
+        still hold prefill-pool page references for the pending copy —
+        the one resident claim _sweep_timeouts can't see — so the drop
+        happens here, against the prefill allocator, before the decode
+        pool ever reserves for them."""
+        pre = self.prefill
+        still: List[RequestState] = []
+        for st in self._handoff_q:
+            if st.deadline is None or now < st.deadline:
+                still.append(st)
+                continue
+            st.finish_reason = "timeout"
+            for p in st.owned_pages:
+                pre.page_allocator.release(p)
+            st.owned_pages = []
+            if self.events is not None:
+                self.events.emit(ev.REQUEST_TIMEOUT, request=st.req.id,
+                                 slot=st.slot, new_tokens=0,
+                                 deadline_seconds=pre.config
+                                 .request_timeout)
+            if pre.telemetry is not None:
+                pre.telemetry.requests_total.inc()
+            results[st.req.id] = RequestResult(
+                id=st.req.id, tokens=[], logprobs=[],
+                finish_reason="timeout", ttft=-1.0, token_times=[],
+                cached_tokens=st.cached_tokens,
+                admitted_at=st.admitted_at)
+        self._handoff_q = still
+
     def _drain_handoffs(self, now_fn) -> None:
         """Install every queued handoff the decode pool can take right
         now (a free slot + a full-span page reservation); the rest stay
@@ -1076,6 +1159,12 @@ class DisaggEngine:
         while not (pre.scheduler.idle and not self._handoff_q
                    and dec.scheduler.idle and pending is None):
             now = now_fn()
+            # per-pool deadline sweeps plus the handoff queue (a request
+            # parked between pools holds prefill-side pages — it must
+            # not outlive its deadline there either)
+            pre._sweep_timeouts(now, results)
+            dec._sweep_timeouts(now, results)
+            self._sweep_handoff_timeouts(now, results)
             with span("serve.schedule"):
                 pre._note_admissions(
                     pre.scheduler.admit(pre.slots.free, now,

@@ -64,7 +64,14 @@ class RequestState:
     logprobs: List[float] = dataclasses.field(default_factory=list)
     token_times: List[float] = dataclasses.field(default_factory=list)
     admitted_at: float = 0.0
-    finish_reason: Optional[str] = None   # "eos" | "length" once done
+    finish_reason: Optional[str] = None   # "eos" | "length" | "timeout"
+    # wall-clock (run-relative) deadline stamped at admission when
+    # EngineConfig.request_timeout is set; None = no deadline. The
+    # engine's timeout sweep retires a past-deadline request with
+    # finish_reason "timeout" through the NORMAL retire path — slot and
+    # KV pages reclaimed like any EOS, so one wedged request can neither
+    # freeze the serving progress frontier nor leak pages.
+    deadline: Optional[float] = None
     # paged-KV mode only (all None/zero otherwise): `page_table` maps the
     # slot's logical KV blocks to physical pages (length max_len //
     # page_size, unallocated entries = trash page 0); `owned_pages` are

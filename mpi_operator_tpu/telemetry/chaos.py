@@ -1,0 +1,196 @@
+"""Deterministic data-plane fault injection for collector scrapes.
+
+cluster/chaos.py injects faults into the CONTROL plane (API-server
+verbs); this module injects them into the DATA plane: the per-pod
+/metrics + /events fetches the JobObservatory makes each scrape pass.
+The failure taxonomy follows what pod-scale operation actually sees
+(PAPERS.md, "Exploring the limits of Concurrency in ML Training on
+Google TPUs"): partial-host degradation — stragglers, flaky links,
+asymmetric partitions — dominates over clean whole-job deaths.
+
+Rule syntax mirrors cluster/chaos.py (`<verb>/<kind>=<rate>:<error>`
+there): here a rule is ``<rank>/<kind>=<rate>`` where `<rank>` is a
+worker rank or ``*`` and `<kind>` is one of
+
+  fail              the fetch raises (one flaky scrape; the collector's
+                    existing scrape_failed path absorbs it)
+  delay             the fetch returns the PREVIOUS fetch's payload and
+                    stashes the fresh one for next time (a slow link:
+                    data arrives, one cycle late; the first delayed
+                    fetch has nothing lagged yet and times out instead)
+  stale-replay      the fetch replays the last payload this url ever
+                    returned (a stuck proxy/cache: the frontier reads
+                    the same step twice — must NOT look like progress)
+  partition-window  the fetch raises AND opens a window: the next
+                    `partition_fetches` fetches of this rank all raise
+                    too (an asymmetric network partition — one rank
+                    dark for a stretch while its peers keep reporting)
+
+Determinism: one seeded random.Random, rolled once per fetch in the
+collector's sorted-rank fetch order — a given (seed, rules, lifecycle
+sequence) replays the identical fault sequence, which is what lets the
+chaos soak print a reproducer seed that actually reproduces.
+
+Like FaultingAPIServer, the first matching rule wins and every injected
+error message carries ``(seed=N)`` so a failure in a larger harness is
+attributable to its soak at a glance.
+"""
+from __future__ import annotations
+
+import dataclasses
+import random
+from typing import Callable, Dict, List, Optional, Sequence, Tuple, Union
+
+#: the data-plane fault taxonomy (see module docstring)
+SCRAPE_FAULT_KINDS = ("fail", "delay", "stale-replay", "partition-window")
+
+#: fetches a partition-window fault keeps a rank dark for, by default —
+#: long enough to span several scrape passes, short enough that a soak
+#: sees the heal
+DEFAULT_PARTITION_FETCHES = 3
+
+
+@dataclasses.dataclass(frozen=True)
+class ScrapeFaultRule:
+    """``<rank>/<kind>=<rate>`` — rank ``*`` matches every rank."""
+    rank: str
+    kind: str
+    rate: float
+
+    def __post_init__(self):
+        if self.kind not in SCRAPE_FAULT_KINDS:
+            raise ValueError(
+                f"unknown scrape fault kind {self.kind!r}; known: "
+                f"{', '.join(SCRAPE_FAULT_KINDS)}")
+        if not (self.rank == "*" or self.rank.isdigit()):
+            raise ValueError(
+                f"rank must be '*' or a non-negative integer, "
+                f"got {self.rank!r}")
+        if not (0.0 < self.rate <= 1.0):
+            raise ValueError(
+                f"rate must be in (0, 1], got {self.rate}")
+
+    @classmethod
+    def parse(cls, text: str) -> "ScrapeFaultRule":
+        head, sep, rate = text.partition("=")
+        rank, sep2, kind = head.partition("/")
+        if not sep or not sep2 or not rank or not kind or not rate:
+            raise ValueError(
+                f"bad scrape fault rule {text!r}; want "
+                f"'<rank>/<kind>=<rate>' (e.g. '*/fail=0.2', "
+                f"'3/partition-window=0.05')")
+        try:
+            rate_f = float(rate)
+        except ValueError:
+            raise ValueError(f"bad rate in scrape fault rule {text!r}")
+        return cls(rank=rank.strip(), kind=kind.strip(), rate=rate_f)
+
+    def matches(self, rank: int) -> bool:
+        return self.rank == "*" or int(self.rank) == rank
+
+
+class ScrapeFaultInjector:
+    """Seeded fault layer between the JobObservatory and its fetcher.
+
+    The observatory calls ``fetch(rank, url, real_fetch)`` for every
+    per-pod fetch; this either passes through to ``real_fetch(url)``,
+    raises an injected IOError, or returns a delayed/replayed payload,
+    per the rules. State (last payloads, open partition windows) is per
+    injector — one injector per soak, like one FaultingAPIServer per
+    harness.
+    """
+
+    def __init__(self, rules: Sequence[Union[str, ScrapeFaultRule]] = (),
+                 seed: int = 0,
+                 partition_fetches: int = DEFAULT_PARTITION_FETCHES):
+        self.rules: Tuple[ScrapeFaultRule, ...] = tuple(
+            r if isinstance(r, ScrapeFaultRule) else ScrapeFaultRule.parse(r)
+            for r in rules)
+        self.seed = seed
+        self.rng = random.Random(seed)
+        self.partition_fetches = int(partition_fetches)
+        #: url -> last payload actually handed to the collector
+        self._last: Dict[str, str] = {}
+        #: url -> fresh payload held back by a delay fault
+        self._lag: Dict[str, str] = {}
+        #: rank -> failing fetches remaining in its partition window
+        self._partition: Dict[int, int] = {}
+        #: (rank, kind) -> injections, the soak-report evidence that the
+        #: configured mix actually fired (mirrors FaultingAPIServer)
+        self.faults_injected: Dict[Tuple[int, str], int] = {}
+
+    # -- bookkeeping ------------------------------------------------------
+
+    def _count(self, rank: int, kind: str) -> None:
+        key = (rank, kind)
+        self.faults_injected[key] = self.faults_injected.get(key, 0) + 1
+
+    def fault_count(self, kind: Optional[str] = None) -> int:
+        """Total injections, optionally restricted to one kind."""
+        return sum(n for (_, k), n in self.faults_injected.items()
+                   if kind is None or k == kind)
+
+    def partitioned_ranks(self) -> List[int]:
+        """Ranks whose partition window is currently open."""
+        return sorted(r for r, n in self._partition.items() if n > 0)
+
+    def _roll(self, rank: int) -> Optional[str]:
+        for rule in self.rules:
+            if rule.matches(rank) and self.rng.random() < rule.rate:
+                return rule.kind
+        return None
+
+    # -- the fetch wrapper ------------------------------------------------
+
+    def fetch(self, rank: int, url: str,
+              real_fetch: Callable[[str], str]) -> str:
+        """One per-pod fetch, faults applied. An OPEN partition window
+        dominates any roll (the rank is dark, full stop); otherwise the
+        first matching rule that fires decides the fault."""
+        left = self._partition.get(rank, 0)
+        if left > 0:
+            self._partition[rank] = left - 1
+            self._count(rank, "partition-window")
+            raise IOError(
+                f"injected: rank {rank} partitioned, {url} unreachable "
+                f"(seed={self.seed})")
+        kind = self._roll(rank)
+        if kind == "fail":
+            self._count(rank, "fail")
+            raise IOError(
+                f"injected: scrape of rank {rank} failed ({url}) "
+                f"(seed={self.seed})")
+        if kind == "partition-window":
+            self._partition[rank] = self.partition_fetches
+            self._count(rank, "partition-window")
+            raise IOError(
+                f"injected: rank {rank} partition window opened "
+                f"({self.partition_fetches} fetches dark) "
+                f"(seed={self.seed})")
+        if kind == "stale-replay" and url in self._last:
+            # replay WITHOUT refreshing _last: consecutive stale-replays
+            # keep serving the same snapshot, like a genuinely stuck
+            # cache would
+            self._count(rank, "stale-replay")
+            return self._last[url]
+        if kind == "delay":
+            # the slow link still delivers: hold the fresh payload back
+            # one cycle and serve the previously held one. First delay
+            # on a url has nothing held yet — that one times out.
+            fresh = real_fetch(url)
+            lagged = self._lag.pop(url, None)
+            self._lag[url] = fresh
+            self._count(rank, "delay")
+            if lagged is None:
+                raise IOError(
+                    f"injected: scrape of rank {rank} timed out ({url}) "
+                    f"(seed={self.seed})")
+            self._last[url] = lagged
+            return lagged
+        text = real_fetch(url)
+        self._last[url] = text
+        return text
+
+
+__all__ = ["DEFAULT_PARTITION_FETCHES", "SCRAPE_FAULT_KINDS",
+           "ScrapeFaultInjector", "ScrapeFaultRule"]

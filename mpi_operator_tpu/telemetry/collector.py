@@ -196,6 +196,27 @@ class MetricsFederation:
                     best = max(best, int(value))
         return best
 
+    def observed_tokens(self) -> int:
+        """Serving progress frontier: retired requests + emitted tokens
+        (ServeTelemetry counters) summed across pods and label sets
+        (per-pool labels included). Counters only grow per pod and a
+        partitioned pod's last-known counts are RETAINED, so a partial
+        partition can never move this frontier backward — and pure
+        scrape flakiness can never advance it."""
+        total = 0.0
+        for pod in self.pods.values():
+            for name, _labels, value in pod["samples"]:
+                if name in (WORKER_PREFIX + "requests_total",
+                            WORKER_PREFIX + "tokens_total"):
+                    total += value
+        return int(total)
+
+    def unreachable_ranks(self) -> List[int]:
+        """Ranks whose LATEST scrape attempt failed — the partial-
+        partition evidence. A rank that has never been scraped at all
+        is absent (no attempt, no verdict)."""
+        return sorted(r for r, p in self.pods.items() if not p["ok"])
+
     def _aggregate(self):
         counters: Dict[Tuple, float] = {}
         gauges: Dict[Tuple, float] = {}
@@ -305,6 +326,17 @@ class MetricsFederation:
                     f"{name}"
                     f"{self._out_labels((), {'replica_rank': str(rank)})}"
                     f" {format_value(fn(self.pods[rank]))}")
+        if self.pods:
+            # job-level partition gauge: how many ranks the collector
+            # currently cannot reach (0 = fully connected). The per-rank
+            # tpu_job_up series above names WHICH; this is the one number
+            # an alert rule wants.
+            down = len(self.unreachable_ranks())
+            lines.append("# HELP tpu_job_partitioned_ranks worker ranks "
+                         "currently unreachable to the collector")
+            lines.append("# TYPE tpu_job_partitioned_ranks gauge")
+            lines.append(f"tpu_job_partitioned_ranks{self._out_labels(())}"
+                         f" {down}")
         return lines
 
 
@@ -588,7 +620,8 @@ class JobObservatory:
                  events: Optional[EventLog] = None,
                  clock: Callable[[], float] = time.time,
                  fetch: Callable[[str], str] = _http_get,
-                 scrape_interval: float = 10.0):
+                 scrape_interval: float = 10.0,
+                 scrape_injector=None):
         self.events_dir = events_dir
         if events is None and events_dir:
             events = EventLog(os.path.join(events_dir,
@@ -598,6 +631,12 @@ class JobObservatory:
         self.clock = clock
         self.fetch = fetch
         self.scrape_interval = scrape_interval
+        #: telemetry.chaos.ScrapeFaultInjector — when set, every per-pod
+        #: fetch routes through it (data-plane chaos). Rank-aware by
+        #: construction: URL→rank parsing is ambiguous for serving pools
+        #: (prefill-0 and decode-0 both exist), so the injector is fed
+        #: the rank the observe loop already knows.
+        self.scrape_injector = scrape_injector
         self.jobs: Dict[str, Dict] = {}
 
     def view(self, job: str) -> Dict:
@@ -612,7 +651,13 @@ class JobObservatory:
             # frontier ever observed for this gang incarnation and WHEN it
             # last moved. progress_ts None = lease disarmed (not observed
             # yet, or reset by a gang restart).
-            "progress_step": -1, "progress_ts": None})
+            "progress_step": -1, "progress_ts": None,
+            # serving gangs watch the retired-request/token frontier
+            # instead of the step frontier (observe(serving=True))
+            "serving": False,
+            # open partial-partition window: the unreachable rank set the
+            # last gang_degraded record named, None when fully connected
+            "degraded_ranks": None})
 
     # -- controller lifecycle events ------------------------------------
     def record(self, job: str, event: str, **fields) -> Dict:
@@ -676,6 +721,40 @@ class JobObservatory:
                     progress_deadline_seconds=deadline,
                     last_observed_step=self._observed_step(view))
 
+    def partition_state(self, job: str) -> Tuple[List[int], int]:
+        """(unreachable ranks, total ranks attempted) — the controller's
+        partial-partition evidence after a scrape pass."""
+        view = self.jobs.get(job)
+        if view is None:
+            return [], 0
+        fed = view["federation"]
+        return fed.unreachable_ranks(), len(fed.pods)
+
+    def note_degraded(self, job: str, ranks: List[int],
+                      total: int) -> None:
+        """Open (or update) a partial-partition window: some ranks dark,
+        the rest still reporting. Idempotent per rank set — re-observing
+        the same dark set does not re-emit; a CHANGED set does (the
+        window's shape is part of the incident)."""
+        view = self.view(job)
+        key = tuple(ranks)
+        if view.get("degraded_ranks") == key:
+            return
+        view["degraded_ranks"] = key
+        self.record(job, ev.GANG_DEGRADED, ranks=list(ranks),
+                    partitioned_ranks=len(ranks), total_ranks=total,
+                    last_observed_step=self._observed_step(view))
+
+    def note_degraded_healed(self, job: str) -> None:
+        """Close an open partial-partition window (every rank scraped
+        again). No-op when no window is open."""
+        view = self.view(job)
+        if view.get("degraded_ranks"):
+            view["degraded_ranks"] = None
+            self.record(job, ev.GANG_DEGRADED, healed=True, ranks=[],
+                        partitioned_ranks=0,
+                        last_observed_step=self._observed_step(view))
+
     def note_packed(self, job: str, group: str, members: List[str],
                     k: int,
                     labels: Optional[Dict[str, str]] = None) -> None:
@@ -708,12 +787,22 @@ class JobObservatory:
                            exc_info=True)
 
     # -- scraping -------------------------------------------------------
+    def _scrape(self, rank: int, url: str) -> str:
+        """One per-pod fetch, routed through the scrape-fault injector
+        when one is installed (telemetry/chaos.py)."""
+        if self.scrape_injector is not None:
+            return self.scrape_injector.fetch(rank, url, self.fetch)
+        return self.fetch(url)
+
     def observe(self, job: str, targets: Dict[int, str],
-                force: bool = False) -> None:
+                force: bool = False, serving: bool = False) -> None:
         """Scrape each pod's /metrics and /events. ``targets`` maps
         replica_rank -> base URL (http://host:port). Rate-limited by
-        scrape_interval unless forced."""
+        scrape_interval unless forced. ``serving=True`` switches the
+        job's progress frontier from the step counter to the
+        retired-request/token counters (the serving progress lease)."""
         view = self.view(job)
+        view["serving"] = bool(serving)
         now = self.clock()
         if not force and now - view["last_scrape"] < self.scrape_interval:
             return
@@ -724,12 +813,13 @@ class JobObservatory:
             # differ only by port, and each listener is its own clock
             host = urllib.parse.urlparse(base).netloc or str(rank)
             try:
-                fed.ingest(rank, self.fetch(base + "/metrics"))
+                fed.ingest(rank, self._scrape(rank, base + "/metrics"))
             except Exception:
                 fed.scrape_failed(rank)
                 continue
             try:
-                payload = json.loads(self.fetch(base + "/events"))
+                payload = json.loads(
+                    self._scrape(rank, base + "/events"))
             except Exception:
                 # metrics landed; treat the events pull as best-effort
                 continue
@@ -751,6 +841,12 @@ class JobObservatory:
             view["progress_ts"] = now
 
     def _observed_step(self, view: Dict) -> int:
+        if view.get("serving"):
+            # serving gangs have no training step: the progress frontier
+            # is the retired-request/token counter sum — a wedged engine
+            # stops retiring and the frontier freezes exactly like a
+            # stalled step counter would
+            return view["federation"].observed_tokens()
         best = view["federation"].observed_step()
         for records in view["worker_records"].values():
             for rec in records:

@@ -54,6 +54,11 @@ RUN_COMPLETE = "run_complete"
 # disaggregated serving: one record per prefill→decode page handoff
 # (serve/engine.py DisaggEngine), with pages moved/cached and seconds
 KV_HANDOFF = "kv_handoff"
+# a serving request blew its per-request deadline
+# (EngineConfig.request_timeout): retired with finish_reason "timeout",
+# slot + KV pages reclaimed through the normal retire path — carries
+# request id, tokens generated, and the deadline that expired
+REQUEST_TIMEOUT = "request_timeout"
 # Controller-side kinds (the operator's own EventLog; stamped with a
 # "job" field and merged with worker records into <job>/timeline.jsonl):
 JOB_CREATED = "job_created"
@@ -63,6 +68,12 @@ GANG_RESTART = "gang_restart"
 # carries stall_seconds + last_observed_step; a GANG_RESTART (or
 # job_failed with reason StuckGang) ordinarily follows
 GANG_STUCK = "gang_stuck"
+# partial partition: SOME worker scrapes unreachable while the reachable
+# remainder's frontier still advances — a DegradedGang condition, never
+# a restart (scrape flakiness alone must not kill a healthy gang).
+# Carries the unreachable rank set + partitioned_ranks/total_ranks;
+# a follow-up record with healed=True closes the window.
+GANG_DEGRADED = "gang_degraded"
 PODS_READY = "pods_ready"
 FIRST_STEP_OBSERVED = "first_step_observed"
 JOB_PACKED = "packed"
@@ -284,7 +295,8 @@ __all__ = ["EventLog", "BoundEventLog", "read_events", "event_files",
            "EMERGENCY_CHECKPOINT", "DIVERGENCE_ROLLBACK", "INIT_RETRY",
            "SLOT_ADMIT", "SLOT_RETIRE", "CHECKPOINT_RESTORE",
            "CHECKPOINT_SAVED", "CLOCK_ANCHOR", "FAULT_INJECTED",
-           "REPLICA_FROZEN", "RUN_COMPLETE", "JOB_CREATED",
-           "GANG_RESTART", "GANG_STUCK", "PODS_READY", "FIRST_STEP_OBSERVED",
+           "REPLICA_FROZEN", "RUN_COMPLETE", "REQUEST_TIMEOUT",
+           "JOB_CREATED", "GANG_RESTART", "GANG_STUCK", "GANG_DEGRADED",
+           "PODS_READY", "FIRST_STEP_OBSERVED",
            "JOB_PACKED", "JOB_RESIZED", "GANG_RESIZE",
            "FIRST_RESUME_STEP", "JOB_SUCCEEDED", "JOB_FAILED"]

@@ -17,6 +17,7 @@ loss/grad psums span both axis groups.
 """
 from __future__ import annotations
 
+import math
 import time
 from typing import Any, Callable, Dict, Optional, Tuple
 
@@ -438,12 +439,18 @@ class PipelineLMTrainer:
         step_hook(state, step) fires after every timed step (periodic
         async checkpointing, train/checkpoint.periodic_saver).
 
-        resilience: preemption stop-bit only here — the emergency
-        checkpoint is written in CANONICAL layer order (canonical_state,
-        same as every pp checkpoint) so the restarted gang may pick a
-        different schedule/interleave. The in-step divergence guard is a
-        flat-trainer feature (1F1B computes grads in-schedule; there is
-        no single post-step select point).
+        resilience: preemption stop-bit + a COARSE divergence backstop.
+        The emergency checkpoint is written in CANONICAL layer order
+        (canonical_state, same as every pp checkpoint) so the restarted
+        gang may pick a different schedule/interleave. The in-step
+        divergence guard is a flat-trainer feature (1F1B computes grads
+        in-schedule; there is no single post-step select point) — here
+        the loss is instead read back on the host every divergence_k
+        steps, so a non-finite loss runs at most divergence_k steps
+        before routing into the SAME rollback path (restore the newest
+        intact checkpoint, bounded by max_rollbacks, DivergenceError
+        when the budget is spent). One host read per window keeps the
+        schedule device-bound between checks.
 
         telemetry: a telemetry.TrainTelemetry to feed. The pp loop is a
         single timed block (no window fetches), so the whole run folds in
@@ -463,12 +470,22 @@ class PipelineLMTrainer:
         float(metrics["loss"])
         base_step = int(state.step)      # one host read, OUTSIDE the loop
         tokens_per_step = cfg.global_batch_size * cfg.seq_len
+        # divergence backstop cadence: the same k that bounds the flat
+        # trainers' on-device streak bounds how many pp steps a
+        # non-finite loss can run unnoticed (0 = no resilience, no check)
+        loss_check_every = (resilience.config.divergence_k
+                            if resilience is not None else 0)
         t0 = time.perf_counter()
         for i in range(1, num_steps + 1):
             with span("train.pp_step"):
                 state, metrics = step(state, *prepare(next(it)))
             if step_hook is not None:
                 step_hook(state, base_step + i)
+            if loss_check_every and i % loss_check_every == 0 \
+                    and not math.isfinite(float(metrics["loss"])):
+                log(f"non-finite loss at step {base_step + i}: "
+                    f"rolling back")
+                state = resilience.rollback(state)
             if resilience is not None \
                     and resilience.on_step(base_step + i):
                 from .resilience import Preempted

@@ -717,8 +717,13 @@ class ResilienceContext:
                              path=path)
         if self.telemetry is not None:
             self.telemetry.record_rollback(max(0, from_step - to_step))
-        return restored.replace(
-            nonfinite_streak=jnp.zeros_like(jnp.asarray(restored.step)))
+        if hasattr(restored, "nonfinite_streak"):
+            # flat trainers carry the divergence streak on device and
+            # need it rezeroed; the pp trainer's host-side loss backstop
+            # has no such field — its streak IS the host reading
+            restored = restored.replace(
+                nonfinite_streak=jnp.zeros_like(jnp.asarray(restored.step)))
+        return restored
 
 
 __all__ = [

@@ -230,16 +230,21 @@ fi
 #   against seeded API fault injection (transient writes, status
 #   conflicts, stale reads, dropped watch events) with the controller
 #   killed at EVERY write boundary, gated on oracle convergence, zero
-#   leaked resources, and zero wedged workqueue keys. Deterministic per
-#   seed; the reproducer seed is printed on failure.
+#   leaked resources, and zero wedged workqueue keys — PLUS the
+#   data-plane legs: scrape faults (one rank hard-dark, the rest flaky)
+#   must produce a DegradedGang window and ZERO restarts; a wedged
+#   serving gang must be caught via the frozen token frontier within
+#   progressDeadlineSeconds; request timeouts must leak zero slots and
+#   zero KV pages. Deterministic per seed; the reproducer seed is
+#   printed on failure (and a deliberately-failing run below proves it).
 
 if [ "${1:-}" = "--chaos" ]; then
   set -u
   dir=$(mktemp -d)
   trap 'rm -rf "$dir"' EXIT
   seed="${2:-42}"
-  echo "== chaos soak: 25 fault-injected, crash-interrupted lifecycles (seed $seed) =="
-  timeout -k 10 900 env JAX_PLATFORMS=cpu \
+  echo "== chaos soak: 25 fault-injected, crash-interrupted lifecycles + data plane (seed $seed) =="
+  timeout -k 10 1200 env JAX_PLATFORMS=cpu \
     python -m mpi_operator_tpu.controller.chaos \
     --seed "$seed" --lifecycles 25 \
     > "$dir/chaos.json" 2> "$dir/chaos.log"
@@ -262,8 +267,58 @@ if [ "${1:-}" = "--chaos" ]; then
     echo "FAIL: zero injected faults — the fault rules never fired"
     cat "$dir/chaos.json"; exit 1
   fi
+  # data-plane gates: the degraded window opened and healed with no
+  # false-positive restart, the wedged serving gang was caught via the
+  # token frontier, and request timeouts reclaimed every slot and page
+  if ! grep -q '"false_positive_restarts": 0' "$dir/chaos.json"; then
+    echo "FAIL: scrape flakiness restarted a gang (or the degraded leg never ran)"
+    cat "$dir/chaos.json"; exit 1
+  fi
+  if grep -q '"degraded_windows": 0' "$dir/chaos.json" \
+      || ! grep -q '"degraded_windows":' "$dir/chaos.json"; then
+    echo "FAIL: no DegradedGang window under the partial partition"
+    cat "$dir/chaos.json"; exit 1
+  fi
+  if grep -q '"scrape_faults_injected": 0' "$dir/chaos.json"; then
+    echo "FAIL: zero injected scrape faults — the data-plane rules never fired"
+    cat "$dir/chaos.json"; exit 1
+  fi
+  if ! grep -q '"serving_stalls_detected": 1' "$dir/chaos.json"; then
+    echo "FAIL: wedged serving gang not detected via the token frontier"
+    cat "$dir/chaos.json"; exit 1
+  fi
+  if ! grep -q '"leaked_pages": 0' "$dir/chaos.json" \
+      || ! grep -q '"leaked_slots": 0' "$dir/chaos.json"; then
+    echo "FAIL: request timeouts leaked slots or KV pages"
+    cat "$dir/chaos.json"; exit 1
+  fi
+  if grep -q '"request_timeouts": 0' "$dir/chaos.json" \
+      || ! grep -q '"request_timeouts":' "$dir/chaos.json"; then
+    echo "FAIL: the request-timeout leg retired nothing"
+    cat "$dir/chaos.json"; exit 1
+  fi
+  # failure discipline: a soak that DOES fail must print the reproducer
+  # seed. Every rank dark turns the degraded leg's partition total,
+  # which must trip its zero-false-positive assertion — expected exit 1
+  # with the seed named on stderr.
+  echo "== chaos soak: reproducer-seed discipline (deliberate failure) =="
+  if timeout -k 10 300 env JAX_PLATFORMS=cpu \
+      python -m mpi_operator_tpu.controller.chaos \
+      --seed "$seed" --lifecycles 0 --scrape-faults '*/fail=1' \
+      > "$dir/fail.json" 2> "$dir/fail.log"; then
+    echo "FAIL: all-ranks-dark soak was expected to fail and did not"
+    cat "$dir/fail.json"; exit 1
+  fi
+  if ! grep -q "CHAOS SOAK FAILED" "$dir/fail.log" \
+      || ! grep -q "seed=$seed" "$dir/fail.log" \
+      || ! grep -q "^reproduce: python -m mpi_operator_tpu.controller.chaos" "$dir/fail.log"; then
+    echo "FAIL: failing soak did not print the reproducer seed line"
+    cat "$dir/fail.log"; exit 1
+  fi
   echo "chaos soak: OK ($(grep -o '"crashes": [0-9]*' "$dir/chaos.json" | grep -o '[0-9]*') crashes," \
-       "$(grep -o '"total_faults": [0-9]*' "$dir/chaos.json" | grep -o '[0-9]*') faults, 25 lifecycles converged)"
+       "$(grep -o '"total_faults": [0-9]*' "$dir/chaos.json" | grep -o '[0-9]*') API faults," \
+       "$(grep -o '"scrape_faults_injected": [0-9]*' "$dir/chaos.json" | grep -o '[0-9]*$') scrape faults;" \
+       "25 lifecycles converged, degraded window healed, serving stall caught, zero leaks)"
   exit 0
 fi
 

@@ -394,3 +394,76 @@ def test_e2e_sigterm_resume_token_identical(tmp_path, monkeypatch):
     for a, b in zip(jax.tree.leaves(state_a.params),
                     jax.tree.leaves(state_b.params)):
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+# ---------------------------------------------------------------------------
+# The pp divergence backstop: no in-step streak counter (1F1B computes
+# grads in-schedule), so the loop reads the loss back every divergence_k
+# steps and routes a non-finite value into the SAME rollback path.
+# ---------------------------------------------------------------------------
+
+class _BareState(struct.PyTreeNode):
+    """PPTrainState-shaped: NO nonfinite_streak field — rollback must
+    not assume the flat trainers' streak counter exists."""
+    step: Any
+    params: Any
+    opt_state: Any
+
+
+def test_rollback_handles_states_without_streak_field(tmp_path):
+    good = _BareState(step=jnp.asarray(2, jnp.int32),
+                      params={"w": jnp.full((4,), 2.0, jnp.float32)},
+                      opt_state={"m": jnp.zeros((4,), jnp.float32)})
+    save_checkpoint(tmp_path, good, step=2)
+    ctx = ResilienceContext(ResilienceConfig(train_dir=str(tmp_path)),
+                            log=lambda s: None)
+    diverged = good.replace(
+        step=jnp.asarray(9, jnp.int32),
+        params={"w": jnp.full((4,), jnp.nan, jnp.float32)})
+    rolled = ctx.rollback(diverged)
+    assert int(rolled.step) == 2
+    np.testing.assert_array_equal(np.asarray(rolled.params["w"]), 2.0)
+
+
+def test_pp_benchmark_nonfinite_loss_rolls_back(tmp_path):
+    import optax
+
+    from mpi_operator_tpu.models.transformer import gpt2_config
+    from mpi_operator_tpu.parallel import MeshConfig, make_mesh
+    from mpi_operator_tpu.train import LMTrainerConfig, PipelineLMTrainer
+
+    cfg = gpt2_config("test", attention="dense", dtype=jnp.float32,
+                      vocab_size=128, max_len=16)
+    mesh = make_mesh(MeshConfig(pp=2, dp=4))
+    t = PipelineLMTrainer(cfg, mesh,
+                          LMTrainerConfig(global_batch_size=16, seq_len=16,
+                                          warmup_steps=1),
+                          num_microbatches=4, tx=optax.sgd(0.1))
+    state = t.init_state(jax.random.PRNGKey(0))
+    toks = jax.random.randint(jax.random.PRNGKey(1), (16, 17), 0, 128)
+    batch = t.microbatch(toks[:, :-1], toks[:, 1:])
+    state, _ = t.train_step(state, *batch)
+    save_checkpoint(str(tmp_path), state)       # the intact restore point
+    # poison the live params: every loss is non-finite until rollback
+    poisoned = state.replace(params=jax.tree.map(
+        lambda x: jnp.full_like(x, jnp.nan), state.params))
+
+    class Rep:
+        def __iter__(self):
+            return iter([batch] * 16)
+
+    logs = []
+    ctx = ResilienceContext(
+        ResilienceConfig(train_dir=str(tmp_path), divergence_k=1,
+                         max_rollbacks=2),
+        log=logs.append)
+    final, metrics = t.benchmark(poisoned, Rep(), num_steps=3,
+                                 warmup_steps=1, log=logs.append,
+                                 resilience=ctx)
+    assert any("non-finite loss at step" in l for l in logs)
+    assert any("divergence rollback #1" in l for l in logs)
+    # rolled back once, then trained clean from the restored params
+    assert not any("divergence rollback #2" in l for l in logs)
+    assert np.isfinite(metrics["final_loss"])
+    assert all(np.all(np.isfinite(np.asarray(x)))
+               for x in jax.tree.leaves(final.params))
