@@ -555,6 +555,39 @@ def main() -> None:
             page_size=16 if args.smoke else 64,
             log=lambda s: print(s, file=sys.stderr)))
 
+    def serving_spec_metrics():
+        # speculative decoding A/B over the shared-system-prompt paged
+        # trace: ngram self-drafting copies from history, and the
+        # seeded shared prefix gives it real structure to copy, so the
+        # smoke trace exercises acceptance > 0 (not just the machinery).
+        # compare_spec replays the IDENTICAL trace with speculation off
+        # through the same engine, so acceptance_rate,
+        # effective_tokens_per_step, the no-spec baseline throughput
+        # and the greedy token-identity gate all land in ONE record.
+        from mpi_operator_tpu.examples.serve_benchmark import (
+            run_serving_benchmark)
+        m = retry_infra_once(lambda: run_serving_benchmark(
+            size="test" if args.smoke else None,
+            slots=4 if args.smoke else 8,
+            num_requests=8 if args.smoke else 32,
+            prompt_grid=(8, 16, 24) if args.smoke else (32, 64, 128),
+            new_grid=(16, 32) if args.smoke else (32, 64),
+            chunk_buckets=(8, 16) if args.smoke else (32, 128),
+            dtype_name=args.dtype,
+            paged=True,
+            page_size=16 if args.smoke else 64,
+            shared_prefix_len=16 if args.smoke else 128,
+            speculative="ngram",
+            compare_spec=True,
+            baseline=False,
+            log=lambda s: print(s, file=sys.stderr)))
+        # spec/nospec keys already carry their own prefixes; everything
+        # else (ttft/tpot/compile pins) gets the leg prefix
+        keep = ("serving_spec_", "serving_nospec_")
+        return {(k if k.startswith(keep)
+                 else k.replace("serving_", "serving_spec_", 1)): v
+                for k, v in m.items()}
+
     if args.workload == "serving":
         line = {
             "metric": "serving_tokens_per_sec",
@@ -573,6 +606,9 @@ def main() -> None:
         dm = serving_disagg_metrics()
         line.update(dm)
         emit_leg("serving_disagg", dm)
+        ssm = serving_spec_metrics()
+        line.update(ssm)
+        emit_leg("serving_spec", ssm)
         finish(line)
         return
     if args.workload == "generate":
@@ -918,6 +954,24 @@ def main() -> None:
                 line["serving_paged_error"] = type(exc).__name__
                 emit_leg("serving_paged",
                          {"serving_paged_error": type(exc).__name__})
+        # speculative decoding over the same shared-prefix trace shape
+        # (acceptance rate + effective tokens/row-step, no-spec A/B
+        # throughput in the same record)
+        if not over_budget("serving_spec"):
+            try:
+                clear_residue()
+                ssm = serving_spec_metrics()
+                line.update(ssm)
+                emit_leg("serving_spec", ssm)
+            except Exception as exc:  # noqa: BLE001
+                from mpi_operator_tpu.train.resilience import Preempted
+                if isinstance(exc, Preempted):
+                    raise
+                print(f"# serving_spec bench leg failed: {exc!r}",
+                      file=sys.stderr)
+                line["serving_spec_error"] = type(exc).__name__
+                emit_leg("serving_spec",
+                         {"serving_spec_error": type(exc).__name__})
         # ViT-B/16 (BASELINE configs[5] single-chip point; the multi-slice
         # variant is the dryrun's dcn leg)
         if not over_budget("vit"):

@@ -512,7 +512,8 @@ def soak(seed: int = 0, lifecycles: int = 25,
 # ---------------------------------------------------------------------------
 
 def _observed_harness(seed: int, fetch: Callable[[str], str],
-                      scrape_faults: Sequence = ()):
+                      scrape_faults: Sequence = (),
+                      serving_rate_floor: Optional[float] = None):
     """A harness + fake-clock observatory wired for data-plane legs:
     scrapes go through `fetch` (and the harness's injector, when rules
     are given), time is the returned clock dict — no wall-clock
@@ -522,7 +523,8 @@ def _observed_harness(seed: int, fetch: Callable[[str], str],
     clock = {"now": 1000.0}
     obs = JobObservatory(events_dir=tempfile.mkdtemp(prefix="dp-chaos-"),
                          clock=lambda: clock["now"], fetch=fetch,
-                         scrape_interval=0.0)
+                         scrape_interval=0.0,
+                         serving_rate_floor=serving_rate_floor)
     h.attach_observatory(obs)
     return h, obs, clock
 
@@ -673,6 +675,81 @@ def data_plane_serving_lease(seed: int = 0) -> Dict:
             "serving_false_positives": 0}
 
 
+def data_plane_tpot_slope(seed: int = 0) -> Dict:
+    """The TPOT-slope upgrade of the serving lease: an engine whose
+    token frontier still CREEPS (a couple of tokens per scrape — the
+    wall-clock lease alone would renew forever, one token at a time)
+    but whose rate collapsed below the floor must go stuck within the
+    ordinary progressDeadlineSeconds and restart exactly once; healthy-
+    rate traffic first must not trip anything."""
+    frontier = {"requests": 0, "tokens": 0}
+
+    def fetch(url):
+        if url.endswith("/metrics"):
+            return (f"tpu_worker_requests_total {frontier['requests']}\n"
+                    f"tpu_worker_tokens_total {frontier['tokens']}\n")
+        raise IOError("no events endpoint in this universe")
+
+    # floor: 1 observed token/sec. Healthy traffic below runs ~2.8/s;
+    # the degraded phase creeps at ~0.13/s — above and below with a
+    # decade of margin, so scrape-cadence jitter cannot flip the verdict
+    h, obs, clock = _observed_harness(seed, fetch, serving_rate_floor=1.0)
+    name = "dp-tpot-slope"
+    deadline = 60
+    h.create_job(name, tpus=8, restart_policy="OnFailure",
+                 progress_deadline_seconds=deadline,
+                 serving=ServingSpec(prefill_replicas=1, decode_replicas=1))
+    h.drive_until(lambda: len(h.worker_sets(name)) == 2,
+                  f"{name}: prefill+decode pools")
+    h.make_workers_ready(name)
+    h.drive_until(lambda: h.launcher(name) is not None, f"{name}: launcher")
+    h.set_launcher_active(name)
+    h.drive_until(lambda: h.cond(name, COND_RUNNING) == "True",
+                  f"{name}: Running")
+    sync = lambda: h.controller.sync_handler(f"{h.ns}/{name}")  # noqa: E731
+    for _ in range(8):                      # 120s of healthy-rate traffic
+        clock["now"] += 15
+        frontier["requests"] += 2
+        frontier["tokens"] += 40
+        sync()
+        h.resync()
+    job = h.job(name)
+    if job.status.restart_count or \
+            job.status.get_condition(api.COND_STUCK) is not None:
+        raise ConvergenceError(
+            "tpot-slope leg: healthy-rate traffic tripped the slope "
+            "check (false positive)", seed)
+    # the engine degrades: the frontier keeps creeping — every scrape
+    # still advances it, so the WALL-CLOCK lease alone would renew
+    # forever — but far below the rate floor
+    for _ in range(10):                     # 150s >> the 60s deadline
+        clock["now"] += 15
+        frontier["tokens"] += 2
+        sync()
+        h.resync()
+        if h.job(name).status.restart_count:
+            break
+    job = h.job(name)
+    stuck = job.status.get_condition(api.COND_STUCK)
+    if stuck is None or stuck.status != "True":
+        raise ConvergenceError(
+            "tpot-slope leg: creeping-but-collapsed token frontier "
+            "never declared stuck (the wall-clock lease renewed on a "
+            "trickle)", seed)
+    if job.status.restart_count != 1:
+        raise ConvergenceError(
+            f"tpot-slope leg: expected exactly one restart of the "
+            f"degraded gang, got {job.status.restart_count}", seed)
+    stuck_recs = [r for r in obs.merged_records(name)
+                  if r["event"] == "gang_stuck"]
+    if not stuck_recs:
+        raise ConvergenceError(
+            "tpot-slope leg: stuck verdict left no gang_stuck timeline "
+            "record", seed)
+    return {"tpot_slope_stalls_detected": len(stuck_recs),
+            "tpot_slope_false_positives": 0}
+
+
 def data_plane_request_timeouts(seed: int = 0) -> Dict:
     """Engine-side lease enforcement: every request admitted with an
     already-expired deadline (request_timeout=0, the degenerate worst
@@ -726,12 +803,13 @@ def data_plane_request_timeouts(seed: int = 0) -> Dict:
 def data_plane_soak(seed: int = 0,
                     scrape_faults: Sequence = DEFAULT_SCRAPE_RULES,
                     engine_leg: bool = True) -> Dict:
-    """All three data-plane legs; one merged report. `engine_leg=False`
+    """All four data-plane legs; one merged report. `engine_leg=False`
     skips the jax-importing request-timeout leg (unit tests cover it
     in-process; the out-of-process soak runs everything)."""
     report: Dict = {}
     report.update(data_plane_degraded(seed, scrape_faults))
     report.update(data_plane_serving_lease(seed))
+    report.update(data_plane_tpot_slope(seed))
     if engine_leg:
         report.update(data_plane_request_timeouts(seed))
     return report

@@ -312,6 +312,11 @@ class ControllerConfig:
     worker_metrics_port: Optional[int] = None
     events_dir: Optional[str] = None
     scrape_interval: float = 10.0
+    # serving progress lease TPOT-slope floor (observed tokens+requests
+    # per second between frontier advances): a serving gang whose
+    # frontier creeps below this rate arms the lease like a frozen one.
+    # None keeps the lease purely wall-clock.
+    serving_rate_floor: Optional[float] = None
 
 
 @dataclass
@@ -368,7 +373,8 @@ class TPUJobController:
             from ..telemetry.collector import JobObservatory
             observatory = JobObservatory(
                 events_dir=self.config.events_dir,
-                scrape_interval=self.config.scrape_interval)
+                scrape_interval=self.config.scrape_interval,
+                serving_rate_floor=self.config.serving_rate_floor)
         self.observatory = observatory
         # default recorder posts real core-v1 Events through the same API
         # server the reconciler writes to (ref StartRecordingToSink,

@@ -31,6 +31,23 @@ def _percentiles(xs, ps=(50, 99)):
     return {p: float(np.percentile(np.asarray(xs), p)) for p in ps}
 
 
+def _latency_fields(results, prefix="serving"):
+    """TTFT/TPOT p50/p99 fields in ms over an iterable of Results.
+    ttft == -1.0 is the "no token ever produced" sentinel (the request
+    expired before its first sample) — excluded here, never folded into
+    the percentiles as a negative latency. An all-timeout trace yields
+    all-None fields instead of crashing."""
+    import numpy as np
+    ttft = _percentiles([r.ttft for r in results if r.ttft >= 0.0])
+    tpot = _percentiles([dt for r in results
+                         for dt in np.diff(r.token_times)])
+    ms = lambda v, nd: round(v * 1e3, nd) if v is not None else None  # noqa: E731
+    return {f"{prefix}_ttft_p50_ms": ms(ttft[50], 2),
+            f"{prefix}_ttft_p99_ms": ms(ttft[99], 2),
+            f"{prefix}_tpot_p50_ms": ms(tpot[50], 3),
+            f"{prefix}_tpot_p99_ms": ms(tpot[99], 3)}
+
+
 def run_serving_benchmark(
     size: Optional[str] = None,
     family: str = "gpt2",
@@ -47,8 +64,11 @@ def run_serving_benchmark(
     page_size: int = 64,
     num_pages: Optional[int] = None,
     shared_prefix_len: int = 0,
+    speculative: Optional[str] = None,
+    draft_k: int = 4,
     baseline: bool = True,
     compare_sync: bool = False,
+    compare_spec: bool = False,
     seed: int = 0,
     profile_dir: Optional[str] = None,
     metrics_port: Optional[int] = None,
@@ -77,6 +97,16 @@ def run_serving_benchmark(
     later waves pin the shared pages and skip that prefill. The paged
     report adds prefix_hit_rate, cold-vs-hit TTFT (admission-relative —
     a hit skips prefill, not the queue), and page-occupancy peaks.
+
+    `speculative` ("ngram") turns on speculative decoding with
+    `draft_k` drafted tokens per greedy row; the report adds the
+    engine's acceptance rate and effective tokens per row-step.
+    `compare_spec` re-runs the identical trace through the SAME engine
+    with speculation disabled (reset between — zero extra compiles) and
+    reports the non-spec throughput/TPOT, the spec speedup, and a
+    token-identity check over the greedy requests (speculation changes
+    WHEN tokens compute, never WHICH — sampled requests legitimately
+    differ because the per-step rng stream shifts with step count).
 
     `profile_dir` captures an XProf trace of the MEASURED trace only
     (warmup excluded, trace serialization after the closing timestamp —
@@ -139,7 +169,8 @@ def run_serving_benchmark(
     engine = ServingEngine(model, params, EngineConfig(
         slots=slots, chunk_buckets=tuple(chunk_buckets),
         decode_kernel=decode_kernel, rng_seed=seed,
-        paged=paged, page_size=page_size, num_pages=num_pages),
+        paged=paged, page_size=page_size, num_pages=num_pages,
+        speculative=speculative, draft_k=draft_k),
         telemetry=wtel.serving)
     if metrics_port is not None:
         log(f"worker /metrics listening on port "
@@ -166,9 +197,7 @@ def run_serving_benchmark(
         wtel.close()
     total_new = sum(len(r.tokens) for r in results.values())
     tps = total_new / wall
-    ttft = _percentiles([r.ttft for r in results.values()])
-    tpot = _percentiles([dt for r in results.values()
-                         for dt in np.diff(r.token_times)])
+    lat = _latency_fields(results.values())
     counts = engine.compile_counts()
     # step has at most 3 variants (the sample_slots modes), prefill one
     # program per bucket; anything beyond that is a recompile leak
@@ -188,12 +217,7 @@ def run_serving_benchmark(
         "serving_slots": slots,
         "serving_total_new_tokens": total_new,
         "serving_wall_seconds": round(wall, 3),
-        "serving_ttft_p50_ms": round(ttft[50] * 1e3, 2),
-        "serving_ttft_p99_ms": round(ttft[99] * 1e3, 2),
-        "serving_tpot_p50_ms": (round(tpot[50] * 1e3, 3)
-                                if tpot[50] is not None else None),
-        "serving_tpot_p99_ms": (round(tpot[99] * 1e3, 3)
-                                if tpot[99] is not None else None),
+        **lat,
         "serving_host_gap_p50_ms": gap50_ms,
         "serving_host_gap_p99_ms": gap99_ms,
         "serving_step_compiles": counts["step"],
@@ -203,6 +227,30 @@ def run_serving_benchmark(
         "serving_async_decode": bool(engine.config.async_decode),
         "serving_paged": bool(paged),
     }
+    if speculative is not None:
+        # snapshot spec counters BEFORE any compare_* rerun resets them
+        spec = engine.spec_stats()
+        # verify pins like step does: <= 2 bucketed widths per
+        # sample_slots mode, and a trace touches at most 3 modes
+        out["serving_no_recompile"] = bool(
+            no_recompile and counts["verify"] <= 2 * 3)
+        out.update({
+            "serving_speculative": speculative,
+            "serving_spec_draft_k": draft_k,
+            "serving_spec_proposed": int(spec["proposed"]),
+            "serving_spec_accepted": int(spec["accepted"]),
+            "serving_spec_acceptance_rate":
+                round(spec["acceptance_rate"], 4),
+            "serving_spec_effective_tokens_per_step":
+                round(spec["effective_tokens_per_step"], 3),
+            "serving_verify_compiles": counts["verify"],
+        })
+        log(f"speculative ({speculative}, k={draft_k}): acceptance "
+            f"{out['serving_spec_acceptance_rate']} "
+            f"({spec['accepted']}/{spec['proposed']} drafts), "
+            f"{out['serving_spec_effective_tokens_per_step']} effective "
+            f"tokens/row-step over {spec['verify_steps']} verify steps, "
+            f"{counts['verify']} verify compiles")
     if paged:
         # snapshot the allocator BEFORE any compare_sync rerun resets it
         alloc = engine.page_allocator
@@ -212,9 +260,9 @@ def run_serving_benchmark(
         # queueing delay, so the cold/hit split excludes the queue
         adm = lambda r: r.token_times[0] - r.admitted_at  # noqa: E731
         cold = _percentiles([adm(r) for r in results.values()
-                             if r.cached_tokens == 0])
+                             if r.cached_tokens == 0 and r.token_times])
         hit = _percentiles([adm(r) for r in results.values()
-                            if r.cached_tokens > 0])
+                            if r.cached_tokens > 0 and r.token_times])
         hit_reqs = sum(1 for r in results.values() if r.cached_tokens > 0)
         out.update({
             "serving_page_size": page_size,
@@ -244,6 +292,44 @@ def run_serving_benchmark(
         f"TPOT p50/p99 {out['serving_tpot_p50_ms']}/"
         f"{out['serving_tpot_p99_ms']} ms, recompile-free="
         f"{no_recompile}")
+
+    if compare_spec:
+        # spec vs no-spec on the IDENTICAL seeded trace through the
+        # same engine (reset between — same compiled step/prefill
+        # programs, the verify program simply sits unused). Greedy
+        # token identity is the exactness gate; sampled requests may
+        # differ (per-step rng stream shifts with the step count).
+        if speculative is None:
+            raise ValueError("compare_spec requires speculative")
+        engine.config.speculative = None
+        engine.reset()
+        t0 = time.perf_counter()
+        base_results = engine.run(trace)
+        base_wall = time.perf_counter() - t0
+        engine.config.speculative = speculative
+        base_total = sum(len(r.tokens) for r in base_results.values())
+        base_tps = base_total / base_wall
+        base_tpot = _percentiles([dt for r in base_results.values()
+                                  for dt in np.diff(r.token_times)])
+        spec_identical = all(
+            results[r.id].tokens == base_results[r.id].tokens
+            for r in trace if r.temperature == 0.0)
+        out.update({
+            "serving_nospec_tokens_per_sec": round(base_tps, 1),
+            "serving_nospec_wall_seconds": round(base_wall, 3),
+            "serving_nospec_tpot_p50_ms": (round(base_tpot[50] * 1e3, 3)
+                                           if base_tpot[50] is not None
+                                           else None),
+            "serving_nospec_tpot_p99_ms": (round(base_tpot[99] * 1e3, 3)
+                                           if base_tpot[99] is not None
+                                           else None),
+            "serving_spec_speedup": (round(tps / base_tps, 3)
+                                     if base_tps else None),
+            "serving_spec_greedy_identical": bool(spec_identical),
+        })
+        log(f"spec A/B: {tps:.0f} spec vs {base_tps:.0f} no-spec new "
+            f"tokens/sec -> {out['serving_spec_speedup']}x, greedy "
+            f"token-identical={spec_identical}")
 
     if compare_sync:
         # the A/B the double-buffered loop has to win: same engine, same
@@ -411,7 +497,9 @@ def run_disagg_benchmark(
     disagg_results, disagg_wall = timed(disagg)
 
     def latency(results):
-        ttft = _percentiles([r.ttft for r in results.values()])
+        # drop the ttft == -1.0 "no token produced" sentinel
+        ttft = _percentiles([r.ttft for r in results.values()
+                             if r.ttft >= 0.0])
         tpot = _percentiles([dt for r in results.values()
                              for dt in np.diff(r.token_times)])
         return ttft, tpot
@@ -504,6 +592,18 @@ def main(argv=None) -> int:
                              "through both, TTFT/TPOT p50/p99 each, "
                              "kv_handoff p50/p99, token-identity + "
                              "per-pool compile pins")
+    parser.add_argument("--speculative", default=None,
+                        choices=[None, "ngram"],
+                        help="speculative decoding mode (prompt-lookup "
+                             "self-drafting); greedy rows draft, verify "
+                             "scores k drafts + bonus token per pass")
+    parser.add_argument("--draft-k", type=int, default=4,
+                        help="drafted tokens per speculative step")
+    parser.add_argument("--compare-spec", action="store_true",
+                        help="re-run the trace with speculation "
+                             "disabled through the same engine and "
+                             "report the no-spec throughput + spec "
+                             "speedup + greedy token-identity check")
     parser.add_argument("--no-baseline", action="store_true")
     parser.add_argument("--compare-sync", action="store_true",
                         help="re-run the trace with async_decode=False "
@@ -536,8 +636,9 @@ def main(argv=None) -> int:
         paged=args.paged, page_size=args.page_size,
         num_pages=args.num_pages,
         shared_prefix_len=args.shared_prefix_len,
+        speculative=args.speculative, draft_k=args.draft_k,
         baseline=not args.no_baseline, compare_sync=args.compare_sync,
-        seed=args.seed,
+        compare_spec=args.compare_spec, seed=args.seed,
         profile_dir=args.profile_dir, metrics_port=args.metrics_port)
     print(json.dumps({"metric": "serving_tokens_per_sec",
                       "value": metrics["serving_tokens_per_sec"],

@@ -519,7 +519,7 @@ class Attention(nn.Module):
 
                 def upd3(c, u):   # pool [NP, KV, ps] ← [B, KV, S]
                     return c.at[phys, :, off].set(u.transpose(0, 2, 1))
-            else:
+            elif S == 1:
                 def upd4(c, u):   # [B, KV, L, D] ← [B, KV, S, D] at cursors
                     return jax.vmap(
                         lambda cb, ub, s: jax.lax.dynamic_update_slice(
@@ -529,6 +529,25 @@ class Attention(nn.Module):
                     return jax.vmap(
                         lambda cb, ub, s: jax.lax.dynamic_update_slice(
                             cb, ub, (0, s)))(c, u, cur)
+            else:
+                # multi-token decode (speculative verify, S = width > 1):
+                # dynamic_update_slice CLAMPS its start index, so a row
+                # whose window would cross L (cur + S > L) would silently
+                # shift its writes left over live history. Scatter with
+                # per-position indices instead: padded tail positions are
+                # set to L host-side and out-of-bounds scatter updates
+                # DROP, mirroring the paged path's trash-page semantics.
+                bidx = jnp.arange(B)[:, None]
+
+                def upd4(c, u):   # [B, KV, L, D] ← [B, KV, S, D] scatter
+                    # advanced indices [B, S] + slice dims put the index
+                    # dims in front: target block is [B, S, KV, D]
+                    return c.at[bidx, :, pos, :].set(
+                        u.transpose(0, 2, 1, 3), mode="drop")
+
+                def upd3(c, u):   # [B, KV, L] ← [B, KV, S] (int8 scales)
+                    return c.at[bidx, :, pos].set(
+                        u.transpose(0, 2, 1), mode="drop")
 
             def bump():
                 pass          # the engine owns the cursors host-side

@@ -9,7 +9,7 @@ fixed-batch oracle the engine is parity-tested against.
 """
 from .engine import (  # noqa: F401
     DecodeEngine, DisaggEngine, EngineConfig, PrefillEngine,
-    RequestResult, ServingEngine, sample_slots,
+    RequestResult, ServingEngine, propose_ngram, sample_slots,
 )
 from .scheduler import (  # noqa: F401
     Request, RequestState, Scheduler, plan_chunks,
@@ -21,5 +21,5 @@ __all__ = [
     "DecodeEngine", "DisaggEngine", "EngineConfig", "PageAllocator",
     "PageTransfer", "PrefillEngine", "Request", "RequestResult",
     "RequestState", "Scheduler", "ServingEngine", "SlotManager",
-    "plan_chunks", "sample_slots",
+    "plan_chunks", "propose_ngram", "sample_slots",
 ]

@@ -58,6 +58,26 @@ model the way a frontend needs it served:
   byte-for-byte what it was — it is the token-exactness oracle the
   paged engine is pinned against in tests/test_paged_kv.py.
 
+- **Speculative decoding** (`EngineConfig.speculative`). Decode is one
+  memory-bound HBM sweep per token; speculation turns k sequential
+  sweeps into ONE batched verify step. A host-side drafter proposes up
+  to `draft_k` continuation tokens per row — "ngram" self-drafting
+  matches the request's own prompt+output history (no second model),
+  "draft" plugs in any callable (a small draft model) — and the verify
+  program scores all proposals plus the bonus token in a single pass:
+  the same right-aligned ragged-row shape as a chunked-prefill window,
+  bucketed to ≤2 compiled widths. Greedy acceptance keeps the longest
+  prefix where draft == previous position's argmax, then emits the
+  model's own next token — so speculation changes WHEN tokens are
+  computed, never WHICH (token-exact vs the plain engine at temperature
+  0, pinned in tests/test_spec_decode.py). Rejection is a cursor
+  rewind (slots.SlotManager.rewind): written-but-rejected K/V is dead
+  weight the next write overwrites — never a copy — and prefix-cache
+  publishing only ever covers prompt pages, so published boundaries
+  advance on accepted tokens by construction. The decode pool of a
+  DisaggEngine verifies the same way; drafting is host state, so the
+  split gets speculation for free.
+
 Parity: at temperature 0 a single request produces token-for-token the
 same output as `generate()` — tests/test_serve.py pins this across the
 dense and Pallas decode-kernel paths, async and sync.
@@ -114,7 +134,18 @@ class EngineConfig:
     its pages) forever, so the retired-request/token frontier the
     controller watches keeps moving unless the whole engine is stuck.
     In the disaggregated facade each pool stamps its own window (prefill
-    admission and decode install each start a fresh deadline)."""
+    admission and decode install each start a fresh deadline).
+
+    `speculative` (None = off) enables multi-token verify: "ngram"
+    self-drafts via prompt lookup against each request's own history
+    (`spec_ngram` caps the match length), "draft" uses the `drafter`
+    callable handed to the engine (a small draft model, or anything
+    else — correctness never depends on draft quality). `draft_k` caps
+    proposed tokens per row per verify step; the verify program runs at
+    ≤2 bucketed widths from {2, draft_k+1}. Greedy rows are token-exact
+    vs the plain engine; sampling rows never speculate (their next
+    token is a draw, not an argmax, so lookahead has nothing to verify
+    against) and run plain decode in the same batch."""
     slots: int = 8
     chunk_buckets: Tuple[int, ...] = (32, 128, 512)
     decode_kernel: Optional[bool] = None
@@ -126,6 +157,9 @@ class EngineConfig:
     prefix_cache: bool = True
     admit_lookahead: int = 8
     request_timeout: Optional[float] = None
+    speculative: Optional[str] = None     # None | "ngram" | "draft"
+    draft_k: int = 4
+    spec_ngram: int = 3
 
 
 @dataclasses.dataclass
@@ -218,6 +252,33 @@ def sample_slots(logits, rng, temperature, top_k, top_p,
     return tok, jnp.take_along_axis(logp, tok[:, None], axis=-1)[:, 0]
 
 
+def propose_ngram(history: Sequence[int], k: int,
+                  max_n: int = 3) -> List[int]:
+    """Prompt-lookup self-drafting: propose up to `k` tokens by matching
+    the longest suffix n-gram (n = max_n down to 1) of `history` against
+    its most recent EARLIER occurrence and copying what followed it.
+    Pure host work, no second model — repetitive continuations (code,
+    lists, quoted spans, the cyclic output of a greedy decode) hit
+    constantly; novel text just returns [] and the engine falls back to
+    plain decode. Wrong proposals cost a verify column, never a token
+    (greedy acceptance discards them)."""
+    L = len(history)
+    out: List[int] = []
+    if k < 1 or L < 2:
+        return out
+    for n in range(min(max_n, L - 1), 0, -1):
+        pat = list(history[L - n:])
+        # scan right-to-left: recency wins (the latest occurrence is the
+        # best predictor of what the model is currently repeating)
+        for s in range(L - n - 1, -1, -1):
+            if list(history[s:s + n]) == pat:
+                out = [int(t) for t in history[s + n:s + n + k]]
+                break
+        if out:
+            break
+    return out
+
+
 class ServingEngine:
     """Continuous-batching inference over a trained CausalLM.
 
@@ -237,12 +298,15 @@ class ServingEngine:
     RESERVE = "full"
 
     def __init__(self, model, params, config: Optional[EngineConfig] = None,
-                 telemetry=None, events=None):
+                 telemetry=None, events=None, drafter=None):
         """telemetry: a telemetry.ServeTelemetry — live TTFT/TPOT/step
         histograms and queue/occupancy gauges (today these exist only as
         a post-hoc trace reduction in serve_benchmark); events: a
         telemetry.EventLog receiving slot_admit/slot_retire records.
-        Both optional and None-cost when absent."""
+        Both optional and None-cost when absent. drafter: the
+        speculative="draft" proposal hook — callable(history, k) -> up
+        to k candidate tokens (history = prompt + generated so far);
+        correctness never depends on what it returns."""
         cfg = config or EngineConfig()
         mcfg = model.config
         if not mcfg.causal:
@@ -251,6 +315,20 @@ class ServingEngine:
             if b > mcfg.max_len:
                 raise ValueError(f"chunk bucket {b} exceeds "
                                  f"max_len={mcfg.max_len}")
+        if cfg.speculative not in (None, "ngram", "draft"):
+            raise ValueError(f"speculative={cfg.speculative!r}: expected "
+                             f"None, 'ngram' or 'draft'")
+        if cfg.speculative is not None and cfg.draft_k < 1:
+            raise ValueError(f"draft_k={cfg.draft_k}: speculation needs "
+                             f"at least one proposed token")
+        if cfg.speculative == "draft" and drafter is None:
+            raise ValueError("speculative='draft' needs a drafter "
+                             "callable (history, k) -> tokens")
+        self._drafter = drafter
+        # ≤2 compiled verify widths: a narrow one for single-token
+        # proposals plus the full draft_k+1 (compile_counts pins this)
+        self._verify_buckets = tuple(sorted({min(2, cfg.draft_k + 1),
+                                             cfg.draft_k + 1}))
         self.config = cfg
         self.model_config = mcfg
         ps = cfg.page_size
@@ -384,6 +462,62 @@ class ServingEngine:
                                      top_p, mode=mode)
             return vars_["cache"], tok, logp
 
+        def _verify_targets(h, params, rng, temperature, top_k, top_p,
+                            mode):
+            # shared verify tail: [S, W] hidden states → per-position
+            # target tokens + logprobs. Column 0 is the plain decode
+            # step's sample (same sample_slots, so sampling rows in a
+            # mixed batch still draw correctly); columns 1.. are the
+            # greedy targets the drafts are checked against — argmax in
+            # float32, bitwise the same reduction sample_slots runs for
+            # a temperature-0 row, which is the token-exactness hinge.
+            from ..models.transformer import _head_matmul
+            Sv, W, E = h.shape
+            logits = _head_matmul(h.reshape(Sv * W, E),
+                                  params["wte"]["embedding"])
+            logits = logits.reshape(Sv, W, -1)
+            tok0, lp0 = sample_slots(logits[:, 0], rng, temperature,
+                                     top_k, top_p, mode=mode)
+            f32 = logits.astype(jnp.float32)
+            logp = jax.nn.log_softmax(f32)
+            greedy = jnp.argmax(f32, axis=-1)
+            glp = jnp.take_along_axis(logp, greedy[..., None],
+                                      axis=-1)[..., 0]
+            targets = greedy.at[:, 0].set(tok0)
+            return targets, glp.at[:, 0].set(lp0)
+
+        def verify(params, cache, toks, positions, rng, temperature,
+                   top_k, top_p, mode):
+            # ONE batched pass over [S, W] proposed tokens at explicit
+            # per-position cursors — a chunked-prefill-shaped step with
+            # right-aligned ragged rows. Row layout (host-built): column
+            # 0 = the row's real next input, columns 1..k = drafts,
+            # padded tail positions = max_len (out-of-bounds, so their
+            # K/V writes DROP — transformer.py's multi-token scatter).
+            # K/V for every column is written BEFORE attention reads it,
+            # and each query position attends only <= itself, so a
+            # row's rejected tail never contaminates an accepted
+            # position; the cursor rewind makes it invisible to every
+            # later step too.
+            h, vars_ = dmodel.apply(
+                {"params": params, "cache": cache}, toks,
+                positions=positions, with_head=False, mutable=["cache"])
+            targets, tlp = _verify_targets(h, params, rng, temperature,
+                                           top_k, top_p, mode)
+            return vars_["cache"], targets, tlp
+
+        def verify_paged(params, cache, toks, positions, rng, temperature,
+                         top_k, top_p, pages, mode):
+            # padded tail positions hit the trash-page guard instead of
+            # the scatter bound — same dropped-write semantics
+            h, vars_ = dmodel.apply(
+                {"params": params, "cache": cache}, toks,
+                positions=positions, with_head=False, mutable=["cache"],
+                pages=pages)
+            targets, tlp = _verify_targets(h, params, rng, temperature,
+                                           top_k, top_p, mode)
+            return vars_["cache"], targets, tlp
+
         # cache buffers are donated — the engine holds the only live
         # reference, and the cache ([SLOTS, KV, L, D] per layer, or the
         # page pool) is the biggest allocation here; donation keeps it
@@ -396,10 +530,14 @@ class ServingEngine:
             self._prefill = jax.jit(prefill_paged, donate_argnums=donate)
             self._step = jax.jit(step_paged, donate_argnums=donate,
                                  static_argnums=(11,))
+            self._verify = jax.jit(verify_paged, donate_argnums=donate,
+                                   static_argnums=(9,))
         else:
             self._prefill = jax.jit(prefill, donate_argnums=donate)
             self._step = jax.jit(step, donate_argnums=donate,
                                  static_argnums=(10,))
+            self._verify = jax.jit(verify, donate_argnums=donate,
+                                   static_argnums=(8,))
 
         self.scheduler = Scheduler(cfg.chunk_buckets, mcfg.max_len,
                                    admit_lookahead=cfg.admit_lookahead,
@@ -413,6 +551,13 @@ class ServingEngine:
         # the pool while occupancy_peak exceeds the contiguous slot cap)
         self.occupancy_peak = 0
         self.pages_in_use_peak = 0
+        # speculation run counters (host truth the bench reads;
+        # spec_stats() derives acceptance_rate / effective tokens/step)
+        self.spec_proposed = 0       # draft tokens sent to verify
+        self.spec_accepted = 0       # draft tokens that matched argmax
+        self.spec_steps = 0          # verify steps run
+        self.spec_rows = 0           # consumer rows across verify steps
+        self.spec_tokens = 0         # tokens emitted by verify steps
 
     # -- bookkeeping ------------------------------------------------------
 
@@ -453,17 +598,42 @@ class ServingEngine:
         self._steps_dispatched = 0
         self.occupancy_peak = 0
         self.pages_in_use_peak = 0
+        self.spec_proposed = 0
+        self.spec_accepted = 0
+        self.spec_steps = 0
+        self.spec_rows = 0
+        self.spec_tokens = 0
 
     def compile_counts(self) -> Dict[str, int]:
         """Executable-cache sizes of the engine's jitted programs —
         the no-recompile contract is `step <= 3` (at most one program
-        per sample_slots mode; a pure-greedy trace compiles 1) and
-        `prefill <= len(chunk_buckets)` no matter what trace ran."""
+        per sample_slots mode; a pure-greedy trace compiles 1),
+        `prefill <= len(chunk_buckets)`, and `verify <=
+        len(_verify_buckets)` per mode (a greedy speculative trace
+        compiles at most 2) no matter what trace ran."""
         return {
             "step": self._step._cache_size(),
             "prefill": self._prefill._cache_size(),
+            "verify": self._verify._cache_size(),
             "init_cache": self._init_cache._cache_size(),
             "cast": self._cast._cache_size(),
+        }
+
+    def spec_stats(self) -> Dict[str, float]:
+        """Speculation accounting since construction/reset().
+        effective_tokens_per_step is tokens emitted PER ROW per verify
+        step (so batch width cancels out): 1.0 means drafts never
+        helped (each row's bonus token only — exactly plain decode in
+        step count), > 1.0 is sequential HBM sweeps actually saved."""
+        return {
+            "proposed": self.spec_proposed,
+            "accepted": self.spec_accepted,
+            "verify_steps": self.spec_steps,
+            "spec_tokens": self.spec_tokens,
+            "acceptance_rate": (self.spec_accepted / self.spec_proposed
+                                if self.spec_proposed else 0.0),
+            "effective_tokens_per_step": (self.spec_tokens / self.spec_rows
+                                          if self.spec_rows else 0.0),
         }
 
     # -- the loop ---------------------------------------------------------
@@ -587,6 +757,7 @@ class ServingEngine:
         for st in consumers:
             st.pos += 1                          # the step wrote at pos
             st.dispatched += 1
+            st.host_next = False                 # chain re-established
             if st.dispatched >= st.req.max_new_tokens:
                 # length exhaustion is known NOW, not at sync: free the
                 # row so the next iteration admits into it — the final
@@ -597,6 +768,171 @@ class ServingEngine:
                 self.slots.release(st)
                 st.slot_released = True
         return out_tok, out_logp, consumers, step_t0
+
+    def _plan_drafts(self) -> Dict[int, List[int]]:
+        """Host-side proposal pass: {slot: draft tokens} for every row
+        that can speculate THIS step. Eligibility: decoding (not
+        prefilling/drained/done), temperature 0 (greedy acceptance
+        verifies argmax agreement — a sampling row's next token is a
+        draw, so there is nothing to verify), and ≥2 tokens of budget
+        left (a 1-token budget is exactly a plain step). The caller
+        must have synced any in-flight step first: drafting reads the
+        request's full host-known history. Draft length is clamped so
+        the verify step's worst-case writes stay inside the budget the
+        scheduler reserved pages for (pos never passes P-2+max_new)."""
+        cfg = self.config
+        planned: Dict[int, List[int]] = {}
+        vocab = self.model_config.vocab_size
+        for st in self.slots.states:
+            if st is None or st.prefilling or st.done:
+                continue
+            if st.req.temperature > 0.0:
+                continue
+            budget = st.req.max_new_tokens - st.dispatched - 1
+            if budget < 1:
+                continue
+            k = min(cfg.draft_k, budget)
+            hist = list(st.req.prompt) + st.generated
+            if cfg.speculative == "ngram":
+                raw = propose_ngram(hist, k, cfg.spec_ngram)
+            else:
+                raw = self._drafter(hist, k)
+            draft: List[int] = []
+            for t in raw[:k]:
+                t = int(t)
+                if not 0 <= t < vocab:
+                    break          # garbage id: stop, keep the prefix
+                draft.append(t)
+            if draft:
+                planned[st.slot] = draft
+        return planned
+
+    def _spec_step(self, planned: Dict[int, List[int]], now_fn,
+                   on_token=None) -> List[RequestState]:
+        """Dispatch ONE verify step over every decoding row and sync it:
+        drafting rows carry [next_input, draft...] at consecutive
+        cursors, plain rows ride along in column 0 (mixed batches cost
+        nothing — the program is fixed-shape), padded tail positions sit
+        at max_len so their writes drop. Greedy acceptance per row: keep
+        the longest draft prefix matching the previous column's argmax,
+        then the model's own next token rides free — every verify step
+        emits ≥1 token, so speculation is never behind plain decode in
+        steps. The cursor advanced over ALL written columns; the
+        rejected tail is rolled back via slots.rewind (pure host
+        bookkeeping — the dead K/V is masked now and overwritten next
+        write). Synchronous by design: acceptance decides the NEXT
+        step's inputs, so there is nothing to overlap (host_next keeps
+        the device-side chain honest for the next plain step)."""
+        cfg = self.config
+        Sn = cfg.slots
+        L = self.model_config.max_len
+        max_k = max((len(d) for d in planned.values()), default=0)
+        W = next(b for b in self._verify_buckets if b >= max_k + 1)
+        toks = np.zeros((Sn, W), np.int32)
+        posn = np.full((Sn, W), L, np.int32)   # max_len = dropped write
+        temps = np.zeros((Sn,), np.float32)
+        top_ks = np.zeros((Sn,), np.int32)
+        top_ps = np.ones((Sn,), np.float32)
+        consumers: List[RequestState] = []
+        for st in self.slots.states:
+            if st is None or st.prefilling or st.done:
+                continue
+            if st.dispatched >= st.req.max_new_tokens:
+                continue                       # drained: final sync only
+            toks[st.slot, 0] = st.next_input
+            posn[st.slot, 0] = st.pos
+            temps[st.slot] = st.req.temperature
+            top_ks[st.slot] = st.req.top_k
+            top_ps[st.slot] = st.req.top_p
+            d = planned.get(st.slot, ())
+            if d:
+                toks[st.slot, 1:1 + len(d)] = d
+                posn[st.slot, 1:1 + len(d)] = \
+                    st.pos + 1 + np.arange(len(d))
+            consumers.append(st)
+        if not consumers:
+            return []
+        sampling = [st.req for st in consumers if st.req.temperature > 0.0]
+        if not sampling:
+            mode = "greedy"
+        elif all(1 <= r.top_k <= SAMPLE_POOL for r in sampling):
+            mode = "bounded"
+        else:
+            mode = "full"
+        rng = jax.random.fold_in(self._base_rng, self._steps_dispatched)
+        self._steps_dispatched += 1
+        step_t0 = time.perf_counter()
+        extra = ((jnp.asarray(self._page_table_array()),)
+                 if cfg.paged else ())
+        with span("serve.verify_step"):
+            self.cache, dev_tg, dev_lp = self._verify(
+                self.params, self.cache, jnp.asarray(toks),
+                jnp.asarray(posn), rng, jnp.asarray(temps),
+                jnp.asarray(top_ks), jnp.asarray(top_ps), *extra, mode)
+        tel = self.telemetry
+        gap_t0 = time.perf_counter()
+        tg = np.asarray(dev_tg)
+        lp = np.asarray(dev_lp)
+        t_sync = time.perf_counter()
+        if tel is not None:
+            tel.host_gap_seconds.observe(t_sync - gap_t0)
+            tel.decode_step_seconds.observe(t_sync - step_t0)
+        now = now_fn()
+        ps = cfg.page_size if cfg.paged else None
+        finished: List[RequestState] = []
+        self.spec_steps += 1
+        for st in consumers:
+            d = planned.get(st.slot, [])
+            row_t, row_l = tg[st.slot], lp[st.slot]
+            accepted = 0
+            while accepted < len(d) and d[accepted] == int(row_t[accepted]):
+                accepted += 1
+            emit = accepted + 1          # the model's own token is free
+            eos = st.req.eos_id
+            if eos is not None:
+                for j in range(emit):    # nothing streams past an EOS
+                    if int(row_t[j]) == eos:
+                        emit = j + 1
+                        break
+            written = len(d) + 1         # columns this row really wrote
+            st.pos += written
+            if written > emit:
+                self.slots.rewind(st.slot, written - emit, page_size=ps)
+            st.dispatched += emit
+            if d:
+                self.spec_proposed += len(d)
+                self.spec_accepted += accepted
+                if tel is not None:
+                    tel.spec_proposed_total.inc(len(d))
+                    tel.spec_accepted_total.inc(accepted)
+                    tel.spec_acceptance_ratio.observe(accepted / len(d))
+            self.spec_rows += 1
+            self.spec_tokens += emit
+            if tel is not None:
+                tel.spec_tokens_per_step.observe(emit)
+            for j in range(emit):
+                t = int(row_t[j])
+                if tel is not None:
+                    if st.token_times:
+                        tel.tpot_seconds.observe(now - st.token_times[-1])
+                    else:
+                        tel.ttft_seconds.observe(now - st.req.arrival)
+                    tel.tokens_total.inc()
+                st.generated.append(t)
+                st.logprobs.append(float(row_l[j]))
+                st.token_times.append(now)
+                if on_token is not None:
+                    on_token(st.req, t)
+            st.next_input = int(row_t[emit - 1])
+            st.host_next = True          # device chain token is stale
+            if (eos is not None and st.generated
+                    and st.generated[-1] == eos):
+                st.finish_reason = "eos"
+            elif len(st.generated) >= st.req.max_new_tokens:
+                st.finish_reason = "length"
+            if st.done:
+                finished.append(st)
+        return finished
 
     def _sync_decode_step(self, pending, now_fn, on_token=None) \
             -> List[RequestState]:
@@ -796,8 +1132,26 @@ class ServingEngine:
                     self._run_prefill_batched(st)
                 else:
                     self._run_prefill_chunk(st)
-            new_pending = (self._dispatch_decode_step()
-                           if self.scheduler.decoding() else None)
+            planned = {}
+            if (self.config.speculative is not None
+                    and self.scheduler.decoding()):
+                # drafting reads host-known history, and acceptance
+                # decides the next step's inputs — drain the in-flight
+                # step first (speculative steps are synchronous; the
+                # multi-token payoff replaces the dispatch overlap)
+                if pending is not None:
+                    retire(self._sync_decode_step(pending, now_fn,
+                                                  on_token))
+                    pending = None
+                planned = self._plan_drafts()
+            if planned:
+                retire(self._spec_step(planned, now_fn, on_token))
+                new_pending = None
+            else:
+                # no row drafted this step (novel text, sampling rows,
+                # exhausted budgets): plain decode, async overlap intact
+                new_pending = (self._dispatch_decode_step()
+                               if self.scheduler.decoding() else None)
             if pending is not None:
                 retire(self._sync_decode_step(pending, now_fn, on_token))
                 pending = None
@@ -835,6 +1189,11 @@ class PrefillEngine(ServingEngine):
         if not cfg.paged:
             raise ValueError("disaggregated serving requires paged=True "
                              "(the handoff unit is a page list)")
+        # the prefill pool never decodes, so it never drafts either —
+        # strip the speculation knob rather than make it validate a
+        # drafter it will not call
+        if cfg.speculative is not None:
+            cfg = dataclasses.replace(cfg, speculative=None)
         super().__init__(model, params, cfg, telemetry=telemetry,
                          events=events)
 
@@ -864,13 +1223,13 @@ class DecodeEngine(ServingEngine):
     the misses)."""
 
     def __init__(self, model, params, config: Optional[EngineConfig] = None,
-                 telemetry=None, events=None):
+                 telemetry=None, events=None, drafter=None):
         cfg = config or EngineConfig()
         if not cfg.paged:
             raise ValueError("disaggregated serving requires paged=True "
                              "(the handoff unit is a page list)")
         super().__init__(model, params, cfg, telemetry=telemetry,
-                         events=events)
+                         events=events, drafter=drafter)
 
     def install_handoff(self, req: Request, reserved, now: float,
                         cached_tokens: int = 0,
@@ -963,7 +1322,7 @@ class DisaggEngine:
 
     def __init__(self, model, params, config: Optional[EngineConfig] = None,
                  *, prefill_config: Optional[EngineConfig] = None,
-                 registry=None, events=None, devices=None):
+                 registry=None, events=None, devices=None, drafter=None):
         cfg = config or EngineConfig(paged=True)
         pcfg = prefill_config or cfg
         if not cfg.paged or not pcfg.paged:
@@ -995,7 +1354,7 @@ class DisaggEngine:
             telemetry=pre_tel, events=pre_ev)
         self.decode = DecodeEngine(
             model, jax.device_put(params, self.devices[1]), cfg,
-            telemetry=dec_tel, events=dec_ev)
+            telemetry=dec_tel, events=dec_ev, drafter=drafter)
         self.transfer = PageTransfer(self.prefill.page_allocator.num_pages,
                                      self.decode.page_allocator.num_pages)
         self.config = cfg
@@ -1195,8 +1554,25 @@ class DisaggEngine:
                 pre._run_prefill_batched(lead)
             self._handoff_q.extend(pre.take_prefilled())
             self._drain_handoffs(now_fn)
-            new_pending = (dec._dispatch_decode_step()
-                           if dec.scheduler.decoding() else None)
+            planned = {}
+            if (dec.config.speculative is not None
+                    and dec.scheduler.decoding()):
+                # the decode pool verifies; drafting is host state, so
+                # the disaggregated split composes with speculation with
+                # no extra machinery (see ServingEngine.run)
+                if pending is not None:
+                    for fin in dec._sync_decode_step(pending, now_fn,
+                                                     on_token):
+                        dec._retire_state(fin, results)
+                    pending = None
+                planned = dec._plan_drafts()
+            if planned:
+                for fin in dec._spec_step(planned, now_fn, on_token):
+                    dec._retire_state(fin, results)
+                new_pending = None
+            else:
+                new_pending = (dec._dispatch_decode_step()
+                               if dec.scheduler.decoding() else None)
             if pending is not None:
                 for fin in dec._sync_decode_step(pending, now_fn,
                                                  on_token):
@@ -1220,4 +1596,4 @@ class DisaggEngine:
 
 __all__ = ["SAMPLE_POOL", "DecodeEngine", "DisaggEngine", "EngineConfig",
            "PrefillEngine", "RequestResult", "ServingEngine",
-           "sample_slots"]
+           "propose_ngram", "sample_slots"]

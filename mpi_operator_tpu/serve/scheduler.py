@@ -60,6 +60,12 @@ class RequestState:
     # final sync delivers the last token — the guard keeps the sync-side
     # retirement from releasing a row that may already be re-bound)
     slot_released: bool = False
+    # the last emitted token lives on the HOST (next_input), not in the
+    # device-side _prev_tok chain — set after a speculative verify step
+    # (its targets return to the host for acceptance), cleared when a
+    # plain decode step re-establishes the device chain. step_arrays
+    # keeps use_prev False while set.
+    host_next: bool = False
     generated: List[int] = dataclasses.field(default_factory=list)
     logprobs: List[float] = dataclasses.field(default_factory=list)
     token_times: List[float] = dataclasses.field(default_factory=list)

@@ -263,6 +263,40 @@ class SlotManager:
         self.free.append(st.slot)
         self.free.sort()
 
+    def rewind(self, slot: int, n: int,
+               page_size: Optional[int] = None) -> None:
+        """Roll slot's cursor back `n` positions after a speculative
+        verify step rejected the tail of its writes. The rejected K/V
+        stays in place as dead weight — every reader masks positions
+        >= the cursor and the next write lands exactly there, so rewind
+        is pure host bookkeeping (no cache mutation, no page traffic; a
+        rejected span that crossed into a fresh page leaves that page
+        allocated — it is still inside the request's reserved span).
+
+        In paged mode (`page_size` given) the cursor must not drop below
+        the published-page frontier: published pages are immutable prefix
+        -cache entries other requests may already share, so un-publishing
+        is refused loudly rather than corrupting shared state. The engine
+        never trips this (decode tokens are never published), but the
+        guard keeps a buggy caller from silently poisoning the cache."""
+        st = self.states[slot]
+        if st is None:
+            raise ValueError(f"rewind on free slot {slot}")
+        if n < 0:
+            raise ValueError(f"rewind by negative n={n}")
+        new = st.pos - n
+        if new < 0:
+            raise ValueError(
+                f"rewind({slot}, {n}) would move the cursor to {new} < 0")
+        if page_size is not None:
+            floor = st.published_pages * page_size
+            if new < floor:
+                raise ValueError(
+                    f"rewind({slot}, {n}) would un-publish: cursor {new} "
+                    f"< published frontier {floor} "
+                    f"({st.published_pages} pages x {page_size})")
+        st.pos = new
+
     @property
     def occupied(self) -> int:
         return self.n - len(self.free)
@@ -282,7 +316,11 @@ class SlotManager:
         is guaranteed to be its token) — the device-side chain that lets
         the engine dispatch step N+1 before step N's tokens reach the
         host. Rows with use_prev False read the host token (the bonus
-        token after prefill). States that have dispatched all
+        token after prefill), as do rows whose last tokens came from a
+        speculative verify step (host_next: the verify program returned
+        its targets to the host, so the device-side chain token of the
+        last PLAIN step is stale for this row). States that have
+        dispatched all
         max_new_tokens steps stop consuming: the engine already returned
         their row to the free pool at dispatch time (slot_released), so
         a drained state still tracked here is skipped — only the final
@@ -303,7 +341,7 @@ class SlotManager:
             if st.prefilling:
                 continue
             toks[st.slot] = st.next_input
-            use_prev[st.slot] = st.dispatched >= 1
+            use_prev[st.slot] = st.dispatched >= 1 and not st.host_next
             temps[st.slot] = st.req.temperature
             top_ks[st.slot] = st.req.top_k
             top_ps[st.slot] = st.req.top_p

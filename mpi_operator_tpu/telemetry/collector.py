@@ -621,7 +621,8 @@ class JobObservatory:
                  clock: Callable[[], float] = time.time,
                  fetch: Callable[[str], str] = _http_get,
                  scrape_interval: float = 10.0,
-                 scrape_injector=None):
+                 scrape_injector=None,
+                 serving_rate_floor: Optional[float] = None):
         self.events_dir = events_dir
         if events is None and events_dir:
             events = EventLog(os.path.join(events_dir,
@@ -637,6 +638,14 @@ class JobObservatory:
         #: (prefill-0 and decode-0 both exist), so the injector is fed
         #: the rank the observe loop already knows.
         self.scrape_injector = scrape_injector
+        #: TPOT-slope floor for SERVING jobs (observed tokens+requests
+        #: per second, measured between frontier advances). None keeps
+        #: the lease purely wall-clock. With a floor set, a frontier
+        #: that advances but below the floor does NOT slide progress_ts:
+        #: an engine degraded to a trickle (per-token rate collapsed)
+        #: arms the lease exactly like a frozen one, instead of buying
+        #: itself an indefinite lease one token at a time.
+        self.serving_rate_floor = serving_rate_floor
         self.jobs: Dict[str, Dict] = {}
 
     def view(self, job: str) -> Dict:
@@ -652,6 +661,11 @@ class JobObservatory:
             # last moved. progress_ts None = lease disarmed (not observed
             # yet, or reset by a gang restart).
             "progress_step": -1, "progress_ts": None,
+            # TPOT-slope tracking (serving_rate_floor): the frontier and
+            # wall time of the last frontier ADVANCE, regardless of
+            # whether that advance was fast enough to renew the lease —
+            # consecutive advances measure the between-advance rate
+            "rate_step": -1, "rate_ts": None,
             # serving gangs watch the retired-request/token frontier
             # instead of the step frontier (observe(serving=True))
             "serving": False,
@@ -701,6 +715,8 @@ class JobObservatory:
         view = self.view(job)
         view["progress_step"] = -1
         view["progress_ts"] = None
+        view["rate_step"] = -1
+        view["rate_ts"] = None
 
     def stall_seconds(self, job: str) -> Optional[float]:
         """Seconds since this job's observed step frontier last advanced
@@ -837,8 +853,26 @@ class JobObservatory:
         # advance (or every scrape failing) leaves progress_ts frozen and
         # stall_seconds() growing
         if step > view["progress_step"]:
-            view["progress_step"] = step
-            view["progress_ts"] = now
+            # TPOT-slope check (serving + serving_rate_floor): an
+            # advance only renews the lease when the frontier moved at
+            # >= floor tokens/sec since its LAST advance. A degraded
+            # engine emitting a trickle keeps advancing rate_step (so
+            # the measurement window stays honest) while progress_ts
+            # stays frozen — it goes stuck by the same wall-clock
+            # deadline as a fully wedged one. The first advance of an
+            # incarnation (rate_ts None) always arms: there is no
+            # window to measure yet.
+            slope_ok = True
+            if (view.get("serving") and self.serving_rate_floor is not None
+                    and view["rate_ts"] is not None
+                    and now > view["rate_ts"]):
+                rate = (step - view["rate_step"]) / (now - view["rate_ts"])
+                slope_ok = rate >= self.serving_rate_floor
+            view["rate_step"] = step
+            view["rate_ts"] = now
+            if slope_ok:
+                view["progress_step"] = step
+                view["progress_ts"] = now
 
     def _observed_step(self, view: Dict) -> int:
         if view.get("serving"):

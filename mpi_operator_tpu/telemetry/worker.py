@@ -67,6 +67,15 @@ Serve series (ServingEngine):
   kv_handoff_pages_total  counter   — KV pages moved between pools
                                       (decode-side prefix hits move
                                       nothing and are NOT counted)
+  spec_proposed_total     counter   — draft tokens sent to a verify
+                                      step (speculative decoding)
+  spec_accepted_total     counter   — draft tokens that matched the
+                                      model's argmax and were emitted
+  spec_acceptance_ratio   histogram — accepted/proposed per row per
+                                      verify step (0-1)
+  spec_tokens_per_step    histogram — tokens emitted per row per verify
+                                      step (accepted + the model's own
+                                      bonus token; >1 is the speedup)
 
 Disaggregated serving creates one ServeTelemetry per pool with
 ``labels={"pool": "prefill"|"decode"}`` on a shared registry — the same
@@ -308,6 +317,25 @@ class ServeTelemetry:
         self.prefix_miss_pages = reg.counter(
             "tpu_worker_prefix_miss_pages_total",
             "prompt pages prefilled cold", labels=labels)
+        self.spec_proposed_total = reg.counter(
+            "tpu_worker_spec_proposed_total",
+            "draft tokens sent to speculative verify steps",
+            labels=labels)
+        self.spec_accepted_total = reg.counter(
+            "tpu_worker_spec_accepted_total",
+            "draft tokens accepted (matched the model's argmax)",
+            labels=labels)
+        # ratio/count histograms, not latencies: buckets spanning
+        # [0.01, 1] and [1, draft_k+1] at the default resolution — the
+        # latency bundle's 1e-5 floor would waste 3 decades of edges
+        self.spec_acceptance_ratio = reg.histogram(
+            "tpu_worker_spec_acceptance_ratio",
+            "accepted/proposed drafts per row per verify step",
+            lo=1e-2, hi=1.0, labels=labels)
+        self.spec_tokens_per_step = reg.histogram(
+            "tpu_worker_spec_tokens_per_step",
+            "tokens emitted per row per verify step (bonus included)",
+            lo=1.0, hi=64.0, labels=labels)
 
 
 class WorkerTelemetry:

@@ -61,6 +61,11 @@ def pytest_configure(config):
         "with -m hfta to gate the job-packing data plane alone")
     config.addinivalue_line(
         "markers",
+        "spec: speculative-decoding tests (multi-token verify, drafting, "
+        "rewind); select with -m spec to gate the speculation surface "
+        "alone")
+    config.addinivalue_line(
+        "markers",
         "chaos: fault-injection / crash-consistency soak tests "
         "(controller/chaos.py harness); select with -m chaos, or run the "
         "longer out-of-process soak via scripts/tier1.sh --chaos")

@@ -28,6 +28,7 @@ from mpi_operator_tpu.controller.chaos import (
     ConvergenceError,
     data_plane_degraded,
     data_plane_serving_lease,
+    data_plane_tpot_slope,
 )
 from mpi_operator_tpu.telemetry import events as ev
 from mpi_operator_tpu.telemetry.chaos import (
@@ -295,6 +296,98 @@ def test_serving_lease_catches_a_wedged_gang():
     report = data_plane_serving_lease(seed=0)
     assert report == {"serving_stalls_detected": 1,
                       "serving_false_positives": 0}
+
+
+def test_observatory_tpot_slope_freezes_the_lease_below_floor():
+    # the frontier ADVANCES every scrape, but below serving_rate_floor:
+    # the lease must NOT renew — a creeping engine goes stuck by the
+    # same wall-clock deadline as a frozen one
+    clock = {"now": 1000.0}
+    frontier = {"tokens": 0}
+
+    def fetch(url):
+        if url.endswith("/metrics"):
+            return (f"tpu_worker_requests_total 2\n"
+                    f"tpu_worker_tokens_total {frontier['tokens']}\n")
+        raise IOError("no events endpoint")
+
+    obs = JobObservatory(clock=lambda: clock["now"], fetch=fetch,
+                         scrape_interval=0.0, serving_rate_floor=1.0)
+    tgt = {0: "http://w0:9100"}
+    # first advance of the incarnation always arms (no window yet)
+    obs.observe("s", tgt, force=True, serving=True)
+    # healthy: 40 tokens / 20 s = 2 tok/s >= floor -> lease renews
+    clock["now"] += 20
+    frontier["tokens"] = 40
+    obs.observe("s", tgt, force=True, serving=True)
+    assert obs.stall_seconds("s") == 0.0
+    # creep: 2 tokens / 20 s = 0.1 tok/s < floor — progress_ts frozen
+    # even though the frontier moves every scrape
+    for _ in range(3):
+        clock["now"] += 20
+        frontier["tokens"] += 2
+        obs.observe("s", tgt, force=True, serving=True)
+    assert obs.stall_seconds("s") == 60.0
+    # recovery: one healthy advance re-arms the lease
+    clock["now"] += 20
+    frontier["tokens"] += 100
+    obs.observe("s", tgt, force=True, serving=True)
+    assert obs.stall_seconds("s") == 0.0
+
+
+def test_observatory_tpot_slope_off_by_default():
+    # no floor configured: the same creeping trace renews the lease on
+    # every advance (pre-existing behavior unchanged)
+    clock = {"now": 1000.0}
+    frontier = {"tokens": 0}
+
+    def fetch(url):
+        if url.endswith("/metrics"):
+            return (f"tpu_worker_requests_total 2\n"
+                    f"tpu_worker_tokens_total {frontier['tokens']}\n")
+        raise IOError("no events endpoint")
+
+    obs = JobObservatory(clock=lambda: clock["now"], fetch=fetch,
+                         scrape_interval=0.0)
+    tgt = {0: "http://w0:9100"}
+    obs.observe("s", tgt, force=True, serving=True)
+    for _ in range(3):
+        clock["now"] += 20
+        frontier["tokens"] += 2
+        obs.observe("s", tgt, force=True, serving=True)
+        assert obs.stall_seconds("s") == 0.0
+
+
+def test_reset_progress_lease_clears_the_rate_window():
+    # a gang restart must not measure its first post-restart advance
+    # against the pre-restart frontier (that window spans the outage)
+    clock = {"now": 1000.0}
+    frontier = {"tokens": 0}
+
+    def fetch(url):
+        if url.endswith("/metrics"):
+            return (f"tpu_worker_requests_total 2\n"
+                    f"tpu_worker_tokens_total {frontier['tokens']}\n")
+        raise IOError("no events endpoint")
+
+    obs = JobObservatory(clock=lambda: clock["now"], fetch=fetch,
+                         scrape_interval=0.0, serving_rate_floor=1.0)
+    tgt = {0: "http://w0:9100"}
+    obs.observe("s", tgt, force=True, serving=True)
+    clock["now"] += 500                      # long outage, then restart
+    obs.reset_progress_lease("s")
+    assert obs.view("s")["rate_ts"] is None
+    frontier["tokens"] = 10
+    obs.observe("s", tgt, force=True, serving=True)
+    # first advance after reset arms unconditionally — 10 tokens / 500 s
+    # would read as creep if the stale window survived the reset
+    assert obs.stall_seconds("s") == 0.0
+
+
+def test_tpot_slope_lease_catches_a_creeping_gang():
+    report = data_plane_tpot_slope(seed=0)
+    assert report == {"tpot_slope_stalls_detected": 1,
+                      "tpot_slope_false_positives": 0}
 
 
 def test_degraded_condition_constants_exist():
