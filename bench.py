@@ -588,6 +588,28 @@ def main() -> None:
                  else k.replace("serving_", "serving_spec_", 1)): v
                 for k, v in m.items()}
 
+    def serving_router_metrics():
+        # front-door A/B over an engine fleet: the same seeded multi-
+        # tenant shared-prefix trace with prefix-affinity routing ON vs
+        # OFF, plus an overload-burst shed/recovery leg. ONE record
+        # carries per-replica dispatch/shed counts, both hit rates,
+        # admission-relative TTFT for both modes, p99 TTFT at the
+        # offered load, and the token-identity + compile-pin gates.
+        from mpi_operator_tpu.examples.serve_benchmark import (
+            run_router_benchmark)
+        return retry_infra_once(lambda: run_router_benchmark(
+            size="test" if args.smoke else None,
+            replicas=2,
+            slots=4 if args.smoke else 8,
+            num_requests=12 if args.smoke else 32,
+            prompt_grid=(16, 32) if args.smoke else (32, 64),
+            new_grid=(8, 16) if args.smoke else (32, 64),
+            chunk_buckets=(16, 64) if args.smoke else (32, 128),
+            dtype_name=args.dtype,
+            page_size=16 if args.smoke else 64,
+            shared_prefix_len=32 if args.smoke else 128,
+            log=lambda s: print(s, file=sys.stderr)))
+
     if args.workload == "serving":
         line = {
             "metric": "serving_tokens_per_sec",
@@ -609,6 +631,9 @@ def main() -> None:
         ssm = serving_spec_metrics()
         line.update(ssm)
         emit_leg("serving_spec", ssm)
+        srm = serving_router_metrics()
+        line.update(srm)
+        emit_leg("serving_router", srm)
         finish(line)
         return
     if args.workload == "generate":
@@ -972,6 +997,24 @@ def main() -> None:
                 line["serving_spec_error"] = type(exc).__name__
                 emit_leg("serving_spec",
                          {"serving_spec_error": type(exc).__name__})
+        # prefix-affinity router over an engine fleet (affinity A/B +
+        # overload shed/recovery; builds on the paged prefix cache the
+        # serving_paged leg just measured)
+        if not over_budget("serving_router"):
+            try:
+                clear_residue()
+                srm = serving_router_metrics()
+                line.update(srm)
+                emit_leg("serving_router", srm)
+            except Exception as exc:  # noqa: BLE001
+                from mpi_operator_tpu.train.resilience import Preempted
+                if isinstance(exc, Preempted):
+                    raise
+                print(f"# serving_router bench leg failed: {exc!r}",
+                      file=sys.stderr)
+                line["serving_router_error"] = type(exc).__name__
+                emit_leg("serving_router",
+                         {"serving_router_error": type(exc).__name__})
         # ViT-B/16 (BASELINE configs[5] single-chip point; the multi-slice
         # variant is the dryrun's dcn leg)
         if not over_budget("vit"):

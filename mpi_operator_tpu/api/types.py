@@ -144,6 +144,34 @@ class PodTemplateSpec:
 # ---------------------------------------------------------------------------
 
 @dataclass
+class ServingSLO:
+    """Latency/backlog targets for SLO-driven decode autoscaling
+    (controller/autoscale.py). All targets are federated job-level
+    observations (telemetry/collector.py): ``ttft_p99_seconds`` and
+    ``tpot_p99_seconds`` against the ``tpu_job_ttft_seconds`` /
+    ``tpu_job_tpot_seconds`` histogram p99s, ``queue_depth`` against
+    the summed ``tpu_job_queue_depth`` gauge. A target left None is
+    not evaluated; at least one must be set.
+
+    Breaches must PERSIST for ``breach_seconds`` before a scale-up, and
+    the fleet must run clear for ``clear_seconds`` before a scale-down
+    — and every decision additionally waits out a cooldown of
+    ``cooldown_multiplier`` x the last observed gang-resize cost (the
+    resize ledger's total_seconds; ``cooldown_floor_seconds`` before
+    any resize has been measured), so scaling can never thrash faster
+    than resizes actually complete."""
+    ttft_p99_seconds: Optional[float] = None
+    tpot_p99_seconds: Optional[float] = None
+    queue_depth: Optional[float] = None
+    min_decode_replicas: int = 1
+    max_decode_replicas: int = 8
+    breach_seconds: float = 60.0
+    clear_seconds: float = 300.0
+    cooldown_multiplier: float = 4.0
+    cooldown_floor_seconds: float = 120.0
+
+
+@dataclass
 class ServingSpec:
     """Disaggregated-serving role pools (serve/engine.py DisaggEngine).
 
@@ -154,9 +182,16 @@ class ServingSpec:
     addresses in env (covered by the template hash, so role/count changes
     are an ordinary level-triggered gang restart). The pool sizes must sum
     to the worker replica count the sizing mode derives — serving
-    re-partitions the gang, it does not resize it."""
+    re-partitions the gang, it does not resize it (the autoscaler's
+    decode override rides STATUS, never this spec).
+
+    ``slo``: optional autoscaling targets; when set, the controller's
+    autoscale pass adjusts the EFFECTIVE decode pool between
+    min/max_decode_replicas via status.serving_decode_replicas —
+    ``decode_replicas`` here stays the user's baseline."""
     prefill_replicas: int = 1
     decode_replicas: int = 1
+    slo: Optional[ServingSLO] = None
 
 
 @dataclass
@@ -347,6 +382,15 @@ class TPUJobStatus:
     # (TPUJobController._elastic_ready_since). None = full size.
     elastic_tpus: Optional[int] = None
     elastic_since: Optional[float] = None
+    # SLO-driven decode autoscaling (spec.serving.slo): the EFFECTIVE
+    # decode-pool size when it differs from spec.serving.decodeReplicas,
+    # plus when the last scaling decision landed (the controller's
+    # cooldown reference). Same status-override discipline as
+    # elastic_tpus: the controller NEVER edits the user's spec — the
+    # allocation path reads this override and resizes the gang through
+    # the ordinary template-hash restart. None = run at the spec size.
+    serving_decode_replicas: Optional[int] = None
+    serving_scaled_at: Optional[float] = None
 
     # -- condition helpers (ref: v1alpha2 intent; pkg has no impl) ----------
     def get_condition(self, cond_type: str) -> Optional[JobCondition]:
@@ -427,7 +471,8 @@ __all__ = [
     "V5E_VALID_SLICE_CHIPS",
     "OwnerReference", "ObjectMeta", "is_controlled_by",
     "Container", "PodTemplateSpec",
-    "ServingSpec", "TPUJobSpec", "JobCondition", "ReplicaStatus",
+    "ServingSLO", "ServingSpec", "TPUJobSpec", "JobCondition",
+    "ReplicaStatus",
     "TPUJobStatus", "TPUJob",
     "COND_CREATED", "COND_RUNNING", "COND_RESTARTING", "COND_SUCCEEDED",
     "COND_FAILED", "COND_DEGRADED", "COND_STUCK", "COND_DEGRADED_GANG",

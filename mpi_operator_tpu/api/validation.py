@@ -309,6 +309,50 @@ def validate_spec(spec: TPUJobSpec,
             errs.append(
                 "spec.serving is incompatible with spec.packGroup (both "
                 "rewrite the worker topology)")
+        if sv.slo is not None:
+            # SLO-driven decode autoscaling targets: at least one
+            # observable target, a sane replica band containing the
+            # spec baseline, and non-negative timing knobs — the
+            # autoscale pass assumes all of this and must never have
+            # to re-validate mid-decision
+            slo = sv.slo
+            targets = [("ttftP99Seconds", slo.ttft_p99_seconds),
+                       ("tpotP99Seconds", slo.tpot_p99_seconds),
+                       ("queueDepth", slo.queue_depth)]
+            live = [(n, v) for n, v in targets if v is not None]
+            if not live:
+                errs.append(
+                    "spec.serving.slo must set at least one target "
+                    "(ttftP99Seconds, tpotP99Seconds or queueDepth)")
+            for n, v in live:
+                if v <= 0:
+                    errs.append(
+                        f"spec.serving.slo.{n} must be > 0, got {v}")
+            if slo.min_decode_replicas < 1:
+                errs.append(
+                    f"spec.serving.slo.minDecodeReplicas must be >= 1, "
+                    f"got {slo.min_decode_replicas}")
+            if slo.max_decode_replicas < slo.min_decode_replicas:
+                errs.append(
+                    f"spec.serving.slo.maxDecodeReplicas "
+                    f"({slo.max_decode_replicas}) must be >= "
+                    f"minDecodeReplicas ({slo.min_decode_replicas})")
+            if not (slo.min_decode_replicas <= sv.decode_replicas
+                    <= slo.max_decode_replicas):
+                errs.append(
+                    f"spec.serving.decodeReplicas "
+                    f"({sv.decode_replicas}) must sit inside the slo "
+                    f"band [{slo.min_decode_replicas}, "
+                    f"{slo.max_decode_replicas}] (it is the autoscaler's "
+                    f"baseline)")
+            for n, v in (("breachSeconds", slo.breach_seconds),
+                         ("clearSeconds", slo.clear_seconds),
+                         ("cooldownMultiplier", slo.cooldown_multiplier),
+                         ("cooldownFloorSeconds",
+                          slo.cooldown_floor_seconds)):
+                if v < 0:
+                    errs.append(
+                        f"spec.serving.slo.{n} must be >= 0, got {v}")
         workers = _derived_workers(spec)
         want = sv.prefill_replicas + sv.decode_replicas
         if (workers is not None and spec.num_slices == 1

@@ -48,6 +48,7 @@ replays the identical fault sequence.
 from __future__ import annotations
 
 import json
+import random
 import tempfile
 from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
@@ -800,18 +801,171 @@ def data_plane_request_timeouts(seed: int = 0) -> Dict:
             "leaked_slots": leaked_slots}
 
 
+def data_plane_scrape_bursts(seed: int = 0) -> Dict:
+    """Time-varying scrape faults vs the serving progress lease: a
+    Running serving gang with a healthy token frontier rides an
+    oscillating fault schedule (`*/fail=1.0:burst:6/0.3` — total scrape
+    blackout for 2 fetches out of every 6, per rank). Every storm is
+    shorter than progressDeadlineSeconds, so across many bursts the
+    lease must neither trip (zero false-positive restarts, no stuck
+    verdict) nor disarm: after the storms, a genuinely frozen frontier
+    must still be declared stuck within one deadline — the re-arm path
+    worked every calm window."""
+    frontier = {"requests": 0, "tokens": 0}
+
+    def fetch(url):
+        if url.endswith("/metrics"):
+            return (f"tpu_worker_requests_total {frontier['requests']}\n"
+                    f"tpu_worker_tokens_total {frontier['tokens']}\n")
+        raise IOError("no events endpoint in this universe")
+
+    # rate 1.0 inside the burst window makes the storm schedule exact:
+    # 2 dark fetches (30s of clock) then 4 clean, per rank, repeating
+    h, obs, clock = _observed_harness(
+        seed, fetch, scrape_faults=("*/fail=1.0:burst:6/0.3",))
+    name = "dp-bursts"
+    deadline = 60
+    h.create_job(name, tpus=8, restart_policy="OnFailure",
+                 progress_deadline_seconds=deadline,
+                 serving=ServingSpec(prefill_replicas=1, decode_replicas=1))
+    h.drive_until(lambda: len(h.worker_sets(name)) == 2,
+                  f"{name}: prefill+decode pools")
+    h.make_workers_ready(name)
+    h.drive_until(lambda: h.launcher(name) is not None, f"{name}: launcher")
+    h.set_launcher_active(name)
+    h.drive_until(lambda: h.cond(name, COND_RUNNING) == "True",
+                  f"{name}: Running")
+    sync = lambda: h.controller.sync_handler(f"{h.ns}/{name}")  # noqa: E731
+    for _ in range(24):                     # 360s: ~4 full burst periods
+        clock["now"] += 15
+        frontier["requests"] += 2
+        frontier["tokens"] += 40
+        sync()
+        h.resync()
+        job = h.job(name)
+        if job.status.restart_count:
+            raise ConvergenceError(
+                "burst leg: oscillating scrape faults over a live "
+                "frontier restarted the gang (false positive)", seed)
+        stuck = job.status.get_condition(api.COND_STUCK)
+        if stuck is not None and stuck.status == "True":
+            raise ConvergenceError(
+                "burst leg: live frontier declared stuck during a "
+                "scrape-fault burst", seed)
+    inj = h.scrape_injector
+    windows = inj.burst_windows_hit() if inj else 0
+    faults = inj.fault_count("fail") if inj else 0
+    if windows < 2 or not faults:
+        raise ConvergenceError(
+            f"burst leg: fault schedule never oscillated "
+            f"({faults} faults across {windows} burst windows)", seed)
+    # the storms are over; now the engine genuinely wedges — the lease
+    # must have re-armed through every calm window and still fire
+    obs.scrape_injector = None
+    clock["now"] += deadline + 10
+    sync()
+    h.resync()
+    job = h.job(name)
+    stuck = job.status.get_condition(api.COND_STUCK)
+    if stuck is None or stuck.status != "True" \
+            or job.status.restart_count != 1:
+        raise ConvergenceError(
+            "burst leg: post-burst frozen frontier not declared stuck — "
+            "the bursts disarmed the lease", seed)
+    return {"burst_windows_hit": windows,
+            "burst_faults_injected": faults,
+            "burst_false_positive_restarts": 0,
+            "burst_real_stall_detected": 1}
+
+
+def data_plane_router_failover(seed: int = 0) -> Dict:
+    """Front-door failover: two in-process engine replicas behind the
+    Router, one killed mid-trace (its tick starts raising). The router
+    must mark it dead, resubmit its in-flight requests to the survivor,
+    and converge with ZERO lost requests — every request's tokens
+    bitwise-identical to a single-engine greedy oracle (greedy decode is
+    replica-independent, so a replayed request is indistinguishable).
+    Imports jax lazily like the request-timeout leg."""
+    import jax
+    import jax.numpy as jnp
+    from flax.core import meta as flax_meta
+
+    from ..models import CausalLM, gpt2_config
+    from ..serve import (EngineConfig, Request, Router, RouterConfig,
+                         ServingEngine)
+
+    cfg = gpt2_config("test", attention="dense", dtype=jnp.float32,
+                      vocab_size=64, max_len=64)
+    model = CausalLM(cfg)
+    probe = jnp.zeros((1, 4), jnp.int32)
+    params = flax_meta.unbox(
+        model.init(jax.random.PRNGKey(seed), probe))["params"]
+
+    def mk():
+        return ServingEngine(model, params, EngineConfig(
+            slots=2, chunk_buckets=(4, 8), paged=True, page_size=8,
+            rng_seed=seed))
+
+    rng = random.Random(seed)
+    reqs = [Request(i, [1 + rng.randrange(60) for _ in range(4 + i % 5)],
+                    max_new_tokens=5, arrival=0.0) for i in range(6)]
+    oracle = {}
+    for r in reqs:
+        oracle[r.id] = mk().run(
+            [Request(r.id, r.prompt, r.max_new_tokens)])[r.id].tokens
+
+    router = Router([mk(), mk()], RouterConfig(max_inflight=8))
+    ticks = {"n": 0}
+    victim = router.replicas[0].engine
+    real_tick = victim.tick
+
+    def dying_tick():
+        ticks["n"] += 1
+        if ticks["n"] > 3:
+            raise IOError(f"injected: replica 0 died (seed={seed})")
+        return real_tick()
+
+    victim.tick = dying_tick
+    results = router.run([Request(r.id, r.prompt, r.max_new_tokens,
+                                  arrival=r.arrival) for r in reqs])
+    lost = [r.id for r in reqs if r.id not in results
+            or results[r.id].finish_reason == "shed"]
+    if lost:
+        raise ConvergenceError(
+            f"router leg: requests {lost} lost in failover", seed)
+    wrong = [r.id for r in reqs if results[r.id].tokens != oracle[r.id]]
+    if wrong:
+        raise ConvergenceError(
+            f"router leg: failover replay diverged from the greedy "
+            f"oracle for requests {wrong}", seed)
+    if router.dead_replicas() != [0]:
+        raise ConvergenceError(
+            f"router leg: expected replica 0 dead, got "
+            f"{router.dead_replicas()}", seed)
+    if not router.resubmitted_total:
+        raise ConvergenceError(
+            "router leg: replica died mid-trace but nothing was "
+            "resubmitted — the kill landed after the work", seed)
+    return {"router_failover_lost": 0,
+            "router_resubmitted": router.resubmitted_total,
+            "router_dead_replicas": 1}
+
+
 def data_plane_soak(seed: int = 0,
                     scrape_faults: Sequence = DEFAULT_SCRAPE_RULES,
                     engine_leg: bool = True) -> Dict:
-    """All four data-plane legs; one merged report. `engine_leg=False`
-    skips the jax-importing request-timeout leg (unit tests cover it
-    in-process; the out-of-process soak runs everything)."""
+    """All data-plane legs; one merged report. `engine_leg=False` skips
+    the jax-importing request-timeout and router-failover legs (unit
+    tests cover them in-process; the out-of-process soak runs
+    everything)."""
     report: Dict = {}
     report.update(data_plane_degraded(seed, scrape_faults))
     report.update(data_plane_serving_lease(seed))
     report.update(data_plane_tpot_slope(seed))
+    report.update(data_plane_scrape_bursts(seed))
     if engine_leg:
         report.update(data_plane_request_timeouts(seed))
+        report.update(data_plane_router_failover(seed))
     return report
 
 

@@ -397,6 +397,12 @@ class TPUJobController:
         # clock for tests.
         self._not_ready_since: Dict[tuple, float] = {}
         self._elastic_ready_since: Dict[tuple, float] = {}
+        # SLO-driven decode autoscaling (spec.serving.slo): one pure
+        # hysteresis state machine per job. In-memory like the elastic
+        # timers — an operator restart conservatively restarts the
+        # persistence windows (the status-side cooldown timestamp
+        # survives, so restarts never un-brake the thrash guard).
+        self._autoscalers: Dict[tuple, "DecodeAutoscaler"] = {}
         self.now = time.time
 
         # Admission: reject invalid TPUJob specs at create/update, the CRD
@@ -579,6 +585,7 @@ class TPUJobController:
             self._worker_restart_marks.pop((namespace, name), None)
             self._not_ready_since.pop((namespace, name), None)
             self._elastic_ready_since.pop((namespace, name), None)
+            self._autoscalers.pop((namespace, name), None)
             logger.debug("tpujob '%s' no longer exists", key)
             return
 
@@ -747,6 +754,13 @@ class TPUJobController:
             # never restarted); genuine stalls stay with the progress
             # lease below
             job = self._check_degraded_gang(job)
+            # SLO-driven decode autoscaling consumes the same scrape:
+            # decisions land in STATUS (serving_decode_replicas); the
+            # next sync materializes the new pool split through the
+            # ordinary template-hash resize
+            if (job.spec.serving is not None
+                    and job.spec.serving.slo is not None):
+                job = self._autoscale_reconcile(job, key)
 
         # progress lease (spec.progressDeadlineSeconds): consumes the
         # scrape the observatory just took; a restart here deletes the
@@ -881,6 +895,66 @@ class TPUJobController:
                                     healed)
         # every rank dark is NOT "degraded": that is the all-stale freeze
         # the progress lease owns — leave the condition untouched
+        return job
+
+    def _autoscale_reconcile(self, job: TPUJob, key: str) -> TPUJob:
+        """One tick of SLO-driven decode autoscaling (spec.serving.slo).
+
+        Policy lives in controller/autoscale.py (pure hysteresis);
+        this glue feeds it the federated p99/queue observations from
+        the scrape the observatory just took, the resize-cost cooldown
+        from the ledger, and lands accepted targets in
+        status.serving_decode_replicas — the elastic_tpus discipline:
+        the user's spec is NEVER edited, and the next sync materializes
+        the new pool through the ordinary template-hash gang-restart
+        resize. Pending persistence/cooldown windows schedule their own
+        queue wake-ups so a quiet cluster still re-evaluates."""
+        from ..telemetry.collector import resize_ledger
+        from .autoscale import DecodeAutoscaler, SLOObservation
+
+        if self.observatory is None:
+            return job
+        if job.status.get_condition(COND_RUNNING) is None:
+            # never yet Ready: an empty fleet's silent histograms are
+            # not SLO evidence in either direction (the elastic arming
+            # gate, applied to serving)
+            return job
+        slo = job.spec.serving.slo
+        name = job.metadata.name
+        jkey = (job.metadata.namespace, name)
+        scaler = self._autoscalers.setdefault(jkey, DecodeAutoscaler(slo))
+        scaler.slo = slo          # a spec edit retargets the machine
+        fed = self.observatory.view(name)["federation"]
+        obs = SLOObservation(
+            ttft_p99=fed.histogram_quantile(
+                "tpu_worker_ttft_seconds", 0.99),
+            tpot_p99=fed.histogram_quantile(
+                "tpu_worker_tpot_seconds", 0.99),
+            queue_depth=fed.gauge_value("tpu_worker_queue_depth"))
+        resizes = resize_ledger(self.observatory.merged_records(name))
+        # newest resize with a MEASURED total (a serving gang that never
+        # stepped after a resize leaves the phase fields partial)
+        last_cost = next((r["total_seconds"] for r in reversed(resizes)
+                          if "total_seconds" in r), None)
+        current = (job.status.serving_decode_replicas
+                   if job.status.serving_decode_replicas is not None
+                   else job.spec.serving.decode_replicas)
+        decision = scaler.decide(
+            now=self.now(), obs=obs, current=current,
+            last_scaled_at=job.status.serving_scaled_at,
+            last_resize_seconds=last_cost)
+        if decision.wake_after is not None and decision.wake_after > 0:
+            self.queue.add_after(key, decision.wake_after)
+        if decision.target is None or decision.target == current:
+            return job
+        up = decision.target > current
+        job.status.serving_decode_replicas = decision.target
+        job.status.serving_scaled_at = self.now()
+        job = self._update_status_apply(job)
+        self.recorder.event(
+            job, "Warning" if up else "Normal",
+            "ServingScaleUp" if up else "ServingScaleDown",
+            decision.reason)
         return job
 
     def _fail_invalid_spec(self, job: TPUJob, message: str,
@@ -1282,8 +1356,18 @@ class TPUJobController:
                     f"serving pools need prefillReplicas + "
                     f"decodeReplicas == worker replicas: {want} != "
                     f"{workers}")
-            serving_pools = (spec.serving.prefill_replicas,
-                             spec.serving.decode_replicas)
+            decode = spec.serving.decode_replicas
+            if job.status.serving_decode_replicas is not None:
+                # SLO autoscaler override — status-driven like
+                # elastic_tpus, but here the POOL SPLIT is the primary
+                # and the worker count follows it (the spec-consistency
+                # check above already ran against the user's numbers,
+                # so an invalid spec fails identically with or without
+                # an override in status)
+                decode = job.status.serving_decode_replicas
+                if workers > 0:
+                    workers = spec.serving.prefill_replicas + decode
+            serving_pools = (spec.serving.prefill_replicas, decode)
         if done:
             workers = 0              # scale-down after completion (ref :594-596)
         return AllocationResult(
@@ -1576,17 +1660,26 @@ class TPUJobController:
                     "worker topology changed; gang restarted on the new "
                     "template")
                 if self.observatory is not None:
-                    # spec.resize is the user steering the gang size —
-                    # that lands in the timeline as gang_resize (the
-                    # resize_seconds ledger keys off it); every other
-                    # template drift stays the plain elastic resize event
+                    # spec.resize is the user steering the gang size, a
+                    # serving_decode_replicas override the autoscaler —
+                    # both land in the timeline as gang_resize (the
+                    # resize_seconds ledger keys off it; the autoscale
+                    # cooldown reads its own resize cost back from
+                    # there); every other template drift stays the
+                    # plain elastic resize event
                     fields = {"replicas": alloc.worker_replicas,
                               "num_slices": alloc.num_slices}
                     if job.spec.resize is not None:
                         fields["tpus"] = job.spec.resize
+                    scaled = (job.status.serving_decode_replicas
+                              is not None)
+                    if scaled:
+                        fields["decode_replicas"] = \
+                            job.status.serving_decode_replicas
                     self.observatory.note_resize(
                         job.metadata.name,
-                        gang=job.spec.resize is not None, **fields)
+                        gang=job.spec.resize is not None or scaled,
+                        **fields)
             else:
                 # the restart did NOT happen this sync — the stale hash
                 # annotations make the next sync retry; say so instead of
@@ -2223,6 +2316,7 @@ class TPUJobController:
             if job.status.is_done():
                 self._not_ready_since.pop(jkey, None)
                 self._elastic_ready_since.pop(jkey, None)
+                self._autoscalers.pop(jkey, None)
         worker_failed = prev_failed + delta
         if delta > 0 and worker_failed >= 2:
             # repeated restarts = crash loop; one Warning per escalation

@@ -560,6 +560,230 @@ def run_disagg_benchmark(
     return out
 
 
+def run_router_benchmark(
+    size: Optional[str] = None,
+    family: str = "gpt2",
+    replicas: int = 2,
+    slots: int = 4,
+    num_requests: int = 24,
+    prompt_grid: Sequence[int] = (16, 32),
+    new_grid: Sequence[int] = (8, 16),
+    chunk_buckets: Tuple[int, ...] = (16, 64),
+    dtype_name: str = "bfloat16",
+    decode_kernel: Optional[bool] = None,
+    page_size: int = 16,
+    num_pages: Optional[int] = None,
+    shared_prefix_len: int = 32,
+    num_tenants: int = 4,
+    max_inflight: int = 8,
+    arrival_gap: float = 0.15,
+    seed: int = 0,
+    log: Callable[[str], None] = print,
+) -> Dict[str, object]:
+    """Front-door A/B: the same seeded multi-tenant shared-system-prompt
+    trace through `replicas` paged engine replicas behind the Router,
+    affinity ON vs OFF (pure load-aware), plus an overload burst.
+
+    The trace draws each request's prompt as one of `num_tenants` seeded
+    system prefixes plus a per-request tail, arrivals `arrival_gap`
+    apart — affinity ON concentrates each tenant's chain on one replica,
+    OFF scatters it, and the replica-side PageAllocator hit counters
+    (ground truth, not the router's own prediction) decide the A/B.
+
+    Gates folded into the JSON record (the tier1 --router greps):
+    per-request tokens bitwise-identical to a single-engine greedy
+    oracle in BOTH modes, replica-measured hit rate strictly higher with
+    affinity ON, zero sheds at this low offered load, >= 1 shed and a
+    clean late-arrival recovery in the overload burst, and the compile
+    pins (step <= 3, prefill <= buckets) unchanged on EVERY replica of
+    every fleet."""
+    import time
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from ..models import create_lm
+    from ..parallel import MeshConfig, make_mesh
+    from ..parallel.sharding import shard_init
+    from ..serve import EngineConfig, Request, Router, RouterConfig, \
+        ServingEngine
+
+    dtype = jnp.bfloat16 if dtype_name == "bfloat16" else jnp.float32
+    if decode_kernel is None:
+        decode_kernel = jax.default_backend() == "tpu"
+    need = shared_prefix_len + max(prompt_grid) + max(new_grid)
+    max_len = need if need <= 128 else -(-need // 128) * 128
+    if max_len % page_size:
+        max_len = -(-max_len // page_size) * page_size
+    name = f"{family}-{size}" if size else family
+    model = create_lm(name, dtype=dtype, decode_kernel=decode_kernel,
+                      max_len=max_len)
+    mesh = make_mesh(MeshConfig(dp=jax.device_count()))
+    variables, _ = shard_init(
+        model, mesh, jax.random.PRNGKey(0),
+        jnp.zeros((1, min(prompt_grid)), jnp.int32))
+    params = variables["params"]
+
+    vocab = model.config.vocab_size
+    rs = np.random.RandomState(seed)
+    tenants = [rs.randint(0, vocab, (shared_prefix_len,)).tolist()
+               for _ in range(num_tenants)]
+
+    def make_request(i, arrival):
+        # tenants cycle round-robin, so consecutive same-tenant arrivals
+        # sit num_tenants * arrival_gap apart — the first tenant request
+        # has time to prefill and PUBLISH its prefix pages before the
+        # second one's dispatch probes for them
+        p, n = int(rs.choice(prompt_grid)), int(rs.choice(new_grid))
+        prefix = tenants[i % num_tenants]
+        return Request(
+            id=i, prompt=prefix + rs.randint(0, vocab, (p,)).tolist(),
+            max_new_tokens=n, arrival=arrival)
+
+    trace = [make_request(i, i * arrival_gap) for i in range(num_requests)]
+    # greedy only: token exactness across engines/replays is the gate
+    assert all(r.temperature == 0.0 for r in trace)
+
+    # warm one request per prompt length (covers every prefill bucket)
+    # through each fresh replica, then reset — measured traffic is
+    # steady-state and the TTFT A/B never charges a compile to a mode
+    warm = [Request(10_000 + j,
+                    rs.randint(0, vocab, (shared_prefix_len + p,)).tolist(),
+                    2)
+            for j, p in enumerate(sorted(set(int(v) for v in prompt_grid)))]
+
+    def mk_engine():
+        e = ServingEngine(model, params, EngineConfig(
+            slots=slots, chunk_buckets=tuple(chunk_buckets),
+            decode_kernel=decode_kernel, rng_seed=seed,
+            paged=True, page_size=page_size, num_pages=num_pages))
+        e.run([Request(w.id, list(w.prompt), w.max_new_tokens)
+               for w in warm])
+        e.reset()
+        return e
+
+    def fresh_trace(reqs):
+        return [Request(r.id, list(r.prompt), r.max_new_tokens,
+                        arrival=r.arrival) for r in reqs]
+
+    # single-engine greedy oracle: continuous batching is token-exact
+    # regardless of batch composition, so ONE engine over the whole
+    # trace defines the authoritative tokens for every fleet shape
+    oracle_engine = mk_engine()
+    oracle = {rid: res.tokens for rid, res in oracle_engine.run(
+        [Request(r.id, list(r.prompt), r.max_new_tokens)
+         for r in trace]).items()}
+
+    def pins_held(router):
+        return all(
+            rep.engine.compile_counts()["step"] <= 3
+            and rep.engine.compile_counts()["prefill"] <= len(chunk_buckets)
+            for rep in router.replicas)
+
+    def replica_hit_rate(router):
+        hits = sum(rep.engine.page_allocator.hits for rep in router.replicas)
+        miss = sum(rep.engine.page_allocator.misses
+                   for rep in router.replicas)
+        return hits / (hits + miss) if hits + miss else 0.0, hits
+
+    def fleet_run(affinity):
+        router = Router([mk_engine() for _ in range(replicas)],
+                        RouterConfig(max_inflight=max_inflight,
+                                     affinity=affinity))
+        t0 = time.perf_counter()
+        results = router.run(fresh_trace(trace))
+        return router, results, time.perf_counter() - t0
+
+    on_router, on_results, on_wall = fleet_run(True)
+    off_router, off_results, off_wall = fleet_run(False)
+
+    ms = lambda v: round(v * 1e3, 3) if v is not None else None  # noqa: E731
+    adm = lambda r: r.token_times[0] - r.admitted_at  # noqa: E731
+
+    def adm_ttft_p50(results):
+        return _percentiles([adm(r) for r in results.values()
+                             if r.token_times])[50]
+
+    identical = all(
+        on_results[r.id].tokens == oracle[r.id]
+        and off_results[r.id].tokens == oracle[r.id] for r in trace)
+    on_rate, on_hits = replica_hit_rate(on_router)
+    off_rate, off_hits = replica_hit_rate(off_router)
+    on_p50, off_p50 = adm_ttft_p50(on_results), adm_ttft_p50(off_results)
+    # "no worse" with 20% headroom: the structural win is skipped prefill
+    # work; single-run CPU noise must not flip a smoke verdict
+    ttft_ok = (on_p50 is not None and off_p50 is not None
+               and on_p50 <= off_p50 * 1.2)
+    total_new = sum(len(r.tokens) for r in on_results.values())
+    lat = _latency_fields(on_results.values(), prefix="router")
+
+    # overload burst on a fresh fleet with a tight in-flight cap: every
+    # burst request is due at once, so dispatch fills replicas*cap slots
+    # and front-door-sheds the rest BEFORE any replica queues them; the
+    # late recovery wave must then land entirely on drained replicas
+    burst_cap = 2
+    burst_n = replicas * burst_cap + 4
+    burst = [make_request(1_000 + i, 0.0) for i in range(burst_n)]
+    recovery = [make_request(2_000 + i, 2.5) for i in range(replicas)]
+    burst_router = Router([mk_engine() for _ in range(replicas)],
+                          RouterConfig(max_inflight=burst_cap))
+    burst_results = burst_router.run(fresh_trace(burst + recovery))
+    burst_sheds = sum(1 for r in burst
+                      if burst_results[r.id].finish_reason == "shed")
+    recovered = [burst_results[r.id] for r in recovery]
+    recovery_clean = all(r.finish_reason in ("eos", "length")
+                         for r in recovered)
+
+    out: Dict[str, object] = {
+        "router_replicas": replicas,
+        "router_requests": num_requests,
+        "router_slots": slots,
+        "router_max_inflight": max_inflight,
+        "router_page_size": page_size,
+        "router_shared_prefix_len": shared_prefix_len,
+        "router_num_tenants": num_tenants,
+        "router_tokens_per_sec": round(total_new / on_wall, 1),
+        "router_wall_seconds": round(on_wall, 3),
+        "router_offered_rps": round(1.0 / arrival_gap, 2),
+        **lat,
+        "router_token_identical": bool(identical),
+        "router_dispatch_counts": on_router.dispatch_counts(),
+        "router_shed_low_load": on_router.shed_count()
+                                + off_router.shed_count(),
+        "router_affinity_hit_rate": round(on_rate, 4),
+        "router_noaffinity_hit_rate": round(off_rate, 4),
+        "router_affinity_nonzero": bool(on_rate > 0.0),
+        "router_affinity_hit_gain": bool(on_rate > off_rate),
+        "router_replica_prefix_hit_pages": on_hits,
+        "router_predicted_hit_pages": on_router.affinity_hit_pages,
+        "router_affinity_adm_ttft_p50_ms": ms(on_p50),
+        "router_noaffinity_adm_ttft_p50_ms": ms(off_p50),
+        "router_affinity_ttft_ok": bool(ttft_ok),
+        "router_noaffinity_wall_seconds": round(off_wall, 3),
+        "router_burst_requests": burst_n,
+        "router_burst_sheds": burst_sheds,
+        "router_burst_recovered": len(recovered),
+        "router_burst_recovery_clean": bool(recovery_clean),
+        "router_compile_pins_held": bool(
+            pins_held(on_router) and pins_held(off_router)
+            and pins_held(burst_router)),
+    }
+    log(f"router {name}: {num_requests} reqs over {replicas}x{slots} "
+        f"slots at {out['router_offered_rps']} req/s offered: "
+        f"{out['router_tokens_per_sec']} new tokens/sec, TTFT p99 "
+        f"{out['router_ttft_p99_ms']} ms; hit rate "
+        f"{out['router_affinity_hit_rate']} (affinity) vs "
+        f"{out['router_noaffinity_hit_rate']} (load-only), adm-TTFT p50 "
+        f"{out['router_affinity_adm_ttft_p50_ms']} vs "
+        f"{out['router_noaffinity_adm_ttft_p50_ms']} ms; dispatch "
+        f"{out['router_dispatch_counts']}, {out['router_shed_low_load']} "
+        f"low-load sheds; burst {burst_n} -> {burst_sheds} sheds, "
+        f"recovery clean={recovery_clean}; token-identical={identical}, "
+        f"pins={out['router_compile_pins_held']}")
+    return out
+
+
 def main(argv=None) -> int:
     import argparse
     import json
@@ -586,6 +810,20 @@ def main(argv=None) -> int:
                         help="prepend one seeded system prompt of this "
                              "many tokens to every request (the "
                              "prefix-cache trace)")
+    parser.add_argument("--router", action="store_true",
+                        help="front-door A/B: the same multi-tenant "
+                             "shared-prefix trace through N replicas "
+                             "behind the prefix-affinity router with "
+                             "affinity ON vs OFF, plus an overload-"
+                             "burst shed/recovery leg; gates token "
+                             "identity vs the single-engine oracle, "
+                             "hit-rate gain, and per-replica compile "
+                             "pins")
+    parser.add_argument("--replicas", type=int, default=2,
+                        help="engine replicas behind the router")
+    parser.add_argument("--max-inflight", type=int, default=8,
+                        help="per-replica in-flight cap (the router's "
+                             "admission/shed threshold)")
     parser.add_argument("--disagg", action="store_true",
                         help="disaggregated prefill/decode A/B vs the "
                              "colocated paged engine: same greedy trace "
@@ -618,6 +856,18 @@ def main(argv=None) -> int:
                         help="serve live engine telemetry at "
                              "/metrics on this port (0 = any free port)")
     args = parser.parse_args(argv)
+    if args.router:
+        metrics = run_router_benchmark(
+            size=args.size, family=args.family, replicas=args.replicas,
+            slots=args.slots, num_requests=args.num_requests,
+            dtype_name=args.dtype, page_size=args.page_size,
+            num_pages=args.num_pages,
+            shared_prefix_len=args.shared_prefix_len or 32,
+            max_inflight=args.max_inflight, seed=args.seed)
+        print(json.dumps({"metric": "router_tokens_per_sec",
+                          "value": metrics["router_tokens_per_sec"],
+                          "unit": "tokens/sec", **metrics}))
+        return 0
     if args.disagg:
         metrics = run_disagg_benchmark(
             size=args.size, family=args.family, slots=args.slots,

@@ -11,15 +11,19 @@ from .engine import (  # noqa: F401
     DecodeEngine, DisaggEngine, EngineConfig, PrefillEngine,
     RequestResult, ServingEngine, propose_ngram, sample_slots,
 )
+from .router import ReplicaHandle, Router, RouterConfig  # noqa: F401
 from .scheduler import (  # noqa: F401
     Request, RequestState, Scheduler, plan_chunks,
 )
-from .slots import PageAllocator, SlotManager  # noqa: F401
+from .slots import (  # noqa: F401
+    PageAllocator, SlotManager, prefix_chain_windows,
+)
 from .transfer import PageTransfer  # noqa: F401
 
 __all__ = [
     "DecodeEngine", "DisaggEngine", "EngineConfig", "PageAllocator",
-    "PageTransfer", "PrefillEngine", "Request", "RequestResult",
-    "RequestState", "Scheduler", "ServingEngine", "SlotManager",
-    "plan_chunks", "propose_ngram", "sample_slots",
+    "PageTransfer", "PrefillEngine", "ReplicaHandle", "Request",
+    "RequestResult", "RequestState", "Router", "RouterConfig",
+    "Scheduler", "ServingEngine", "SlotManager", "plan_chunks",
+    "prefix_chain_windows", "propose_ngram", "sample_slots",
 ]

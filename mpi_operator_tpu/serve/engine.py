@@ -168,6 +168,8 @@ class RequestResult:
     tokens: List[int]                 # new tokens only (no prompt)
     logprobs: List[float]
     finish_reason: str                # "eos" | "length" | "timeout"
+    #                                   ("shed" at the router front door:
+    #                                   rejected before any replica)
     ttft: float                       # arrival → first new token, seconds
     #                                   (-1.0 when the request timed out
     #                                   before its first token)
@@ -545,6 +547,7 @@ class ServingEngine:
         self.slots = SlotManager(S)
         self.cache = self._init_cache(self.params)
         self._prev_tok = self._zeros_tok(S)
+        self._session = None   # open steppable session (start()/finish())
         # high-water marks over a run(): the capacity story in one pair
         # of numbers (paged mode sustains more slots than contiguous at
         # equal cache bytes exactly when pages_in_use_peak stays under
@@ -596,6 +599,7 @@ class ServingEngine:
         # the per-step rng folds in this counter — rewind it so a reset
         # engine replays a trace with identical draws
         self._steps_dispatched = 0
+        self._session: Optional[Dict] = None
         self.occupancy_peak = 0
         self.pages_in_use_peak = 0
         self.spec_proposed = 0
@@ -1064,110 +1068,188 @@ class ServingEngine:
                                  .request_timeout)
             self._retire_state(st, results)
 
-    def run(self, requests: Sequence[Request] = (),
-            on_token: Optional[Callable[[Request, int], None]] = None,
-            ) -> Dict[int, RequestResult]:
-        """Drive the engine until every submitted request completes.
-        `on_token(request, token)` streams tokens as they are fetched.
-        Returns {request.id: RequestResult}."""
+    # -- steppable session (the router drives replicas through these) -----
+
+    def start(self, on_token: Optional[Callable[[Request, int], None]]
+              = None, now_fn: Optional[Callable[[], float]] = None) -> None:
+        """Open a streaming session: submit() feeds requests in, tick()
+        advances the loop one iteration, finish() closes it and returns
+        the results. `now_fn` is the session clock (seconds, arbitrary
+        epoch) — the serving router passes ONE shared clock to every
+        replica so arrivals and TTFTs are comparable fleet-wide; None
+        starts a private clock at 0."""
+        if self._session is not None:
+            raise RuntimeError("session already open (call finish())")
+        if now_fn is None:
+            t0 = time.perf_counter()
+            now_fn = lambda: time.perf_counter() - t0   # noqa: E731
+        self._session = {"results": {}, "pending": None,
+                         "on_token": on_token, "now_fn": now_fn}
+
+    def submit(self, req: Request) -> None:
+        """Queue one request into the open session (front-door entry
+        point). Raises ValueError for spans the engine can NEVER
+        satisfy — the same up-front rejection run() applies."""
+        if self._session is None:
+            raise RuntimeError("submit() outside a session (call start())")
         alloc = self.page_allocator
-        for r in requests:
-            if alloc is not None:
-                need = Scheduler.pages_needed(r, alloc.page_size)
-                if need > alloc.usable:
-                    # a request the pool can NEVER satisfy would sit at
-                    # the head of the queue forever (admission livelock);
-                    # reject it up front like an over-max_len prompt
-                    raise ValueError(
-                        f"request {r.id}: worst-case span needs {need} KV "
-                        f"pages but the pool has {alloc.usable} usable "
-                        f"(raise num_pages or lower max_new_tokens)")
-            self.scheduler.submit(r)
-        t0 = time.perf_counter()
-        now_fn = lambda: time.perf_counter() - t0   # noqa: E731
-        results: Dict[int, RequestResult] = {}
+        if alloc is not None:
+            need = Scheduler.pages_needed(req, alloc.page_size)
+            if need > alloc.usable:
+                # a request the pool can NEVER satisfy would sit at
+                # the head of the queue forever (admission livelock);
+                # reject it up front like an over-max_len prompt
+                raise ValueError(
+                    f"request {req.id}: worst-case span needs {need} KV "
+                    f"pages but the pool has {alloc.usable} usable "
+                    f"(raise num_pages or lower max_new_tokens)")
+        self.scheduler.submit(req)
+
+    @property
+    def active(self) -> bool:
+        """True while the open session still has work in flight."""
+        return (self._session is not None
+                and not (self.scheduler.idle
+                         and self._session["pending"] is None))
+
+    def tick(self) -> bool:
+        """One iteration of the admit → prefill → decode loop. Returns
+        False when the engine had nothing to do this instant (idle, or
+        every queued arrival is in the future) WITHOUT sleeping — the
+        caller owns the wait policy (run() naps; the router services
+        other replicas)."""
+        sess = self._session
+        if sess is None:
+            raise RuntimeError("tick() outside a session (call start())")
+        if not self.active:
+            return False
+        alloc = self.page_allocator
         tel = self.telemetry
+        now_fn = sess["now_fn"]
+        on_token = sess["on_token"]
+        results = sess["results"]
 
         def retire(finished: List[RequestState]) -> None:
             for st in finished:
                 self._retire_state(st, results)
 
-        # the double buffer: the step whose tokens are still on the
-        # device. Each iteration dispatches step N+1 FIRST, then syncs
-        # step N — admission/retirement/prefill planning all happen
-        # while the dispatched step runs, and a slot retired at step N
-        # stays masked until step N+1's dispatch already consumed the
-        # old occupancy (the one-step-lagged lifecycle).
-        pending = None
-        while not (self.scheduler.idle and pending is None):
-            now = now_fn()
-            # deadline sweep FIRST: a wedged head-of-queue request frees
-            # its slot before this iteration's admission fills the rows
-            self._sweep_timeouts(now, results)
-            with span("serve.schedule"):
-                self._note_admissions(
-                    self.scheduler.admit(self.slots.free, now,
-                                         allocator=alloc))
-            self.occupancy_peak = max(self.occupancy_peak,
-                                      self.slots.occupied)
+        now = now_fn()
+        # deadline sweep FIRST: a wedged head-of-queue request frees
+        # its slot before this iteration's admission fills the rows
+        self._sweep_timeouts(now, results)
+        with span("serve.schedule"):
+            self._note_admissions(
+                self.scheduler.admit(self.slots.free, now,
+                                     allocator=alloc))
+        self.occupancy_peak = max(self.occupancy_peak,
+                                  self.slots.occupied)
+        if alloc is not None:
+            self.pages_in_use_peak = max(self.pages_in_use_peak,
+                                         alloc.in_use)
+        if tel is not None:
+            tel.queue_depth.set(len(self.scheduler.queue))
+            tel.slot_occupancy.set(self.slots.occupied)
             if alloc is not None:
-                self.pages_in_use_peak = max(self.pages_in_use_peak,
-                                             alloc.in_use)
-            if tel is not None:
-                tel.queue_depth.set(len(self.scheduler.queue))
-                tel.slot_occupancy.set(self.slots.occupied)
-                if alloc is not None:
-                    tel.pages_in_use.set(alloc.in_use)
-                    tel.pages_cached.set(alloc.cached_pages)
-            # nothing resident yet and the next arrival is in the
-            # future: sleep up to it instead of spinning
-            if self.slots.occupied == 0 and pending is None:
-                nxt = self.scheduler.next_arrival()
-                if nxt is not None and nxt > now_fn():
-                    time.sleep(min(nxt - now_fn(), 0.05))
-                continue
-            st = self.scheduler.next_prefill()
-            if st is not None:
-                if self.config.paged:
-                    self._run_prefill_batched(st)
-                else:
-                    self._run_prefill_chunk(st)
-            planned = {}
-            if (self.config.speculative is not None
-                    and self.scheduler.decoding()):
-                # drafting reads host-known history, and acceptance
-                # decides the next step's inputs — drain the in-flight
-                # step first (speculative steps are synchronous; the
-                # multi-token payoff replaces the dispatch overlap)
-                if pending is not None:
-                    retire(self._sync_decode_step(pending, now_fn,
-                                                  on_token))
-                    pending = None
-                planned = self._plan_drafts()
-            if planned:
-                retire(self._spec_step(planned, now_fn, on_token))
-                new_pending = None
+                tel.pages_in_use.set(alloc.in_use)
+                tel.pages_cached.set(alloc.cached_pages)
+        # nothing resident yet and the next arrival is in the future:
+        # nothing to advance — report it instead of spinning
+        pending = sess["pending"]
+        if self.slots.occupied == 0 and pending is None:
+            nxt = self.scheduler.next_arrival()
+            if nxt is not None and nxt > now_fn():
+                return False
+        st = self.scheduler.next_prefill()
+        if st is not None:
+            if self.config.paged:
+                self._run_prefill_batched(st)
             else:
-                # no row drafted this step (novel text, sampling rows,
-                # exhausted budgets): plain decode, async overlap intact
-                new_pending = (self._dispatch_decode_step()
-                               if self.scheduler.decoding() else None)
+                self._run_prefill_chunk(st)
+        planned = {}
+        if (self.config.speculative is not None
+                and self.scheduler.decoding()):
+            # drafting reads host-known history, and acceptance
+            # decides the next step's inputs — drain the in-flight
+            # step first (speculative steps are synchronous; the
+            # multi-token payoff replaces the dispatch overlap)
             if pending is not None:
-                retire(self._sync_decode_step(pending, now_fn, on_token))
-                pending = None
-            if self.config.async_decode:
-                pending = new_pending
-            elif new_pending is not None:
-                # sync mode: same compiled step, fetched immediately
-                retire(self._sync_decode_step(new_pending, now_fn,
+                retire(self._sync_decode_step(pending, now_fn,
                                               on_token))
+                pending = None
+            planned = self._plan_drafts()
+        if planned:
+            retire(self._spec_step(planned, now_fn, on_token))
+            new_pending = None
+        else:
+            # no row drafted this step (novel text, sampling rows,
+            # exhausted budgets): plain decode, async overlap intact
+            new_pending = (self._dispatch_decode_step()
+                           if self.scheduler.decoding() else None)
+        if pending is not None:
+            retire(self._sync_decode_step(pending, now_fn, on_token))
+            pending = None
+        if self.config.async_decode:
+            pending = new_pending
+        elif new_pending is not None:
+            # sync mode: same compiled step, fetched immediately
+            retire(self._sync_decode_step(new_pending, now_fn,
+                                          on_token))
+        sess["pending"] = pending
+        return True
+
+    def session_results(self) -> Dict[int, RequestResult]:
+        """The open session's retired results so far (live view) — the
+        router fans these in after each tick()."""
+        if self._session is None:
+            raise RuntimeError("session_results() outside a session")
+        return self._session["results"]
+
+    def finish(self) -> Dict[int, RequestResult]:
+        """Close the session (final telemetry flush) and return
+        {request.id: RequestResult} for everything retired in it."""
+        sess = self._session
+        if sess is None:
+            raise RuntimeError("finish() outside a session")
+        tel = self.telemetry
         if tel is not None:
             counts = self.compile_counts()
             tel.step_compiles.set(counts["step"])
             tel.prefill_compiles.set(counts["prefill"])
-            tel.queue_depth.set(0)
+            tel.queue_depth.set(len(self.scheduler.queue))
             tel.slot_occupancy.set(self.slots.occupied)
-        return results
+        self._session = None
+        return sess["results"]
+
+    def run(self, requests: Sequence[Request] = (),
+            on_token: Optional[Callable[[Request, int], None]] = None,
+            ) -> Dict[int, RequestResult]:
+        """Drive the engine until every submitted request completes.
+        `on_token(request, token)` streams tokens as they are fetched.
+        Returns {request.id: RequestResult}.
+
+        The body is exactly start → submit* → tick-until-idle → finish;
+        the double buffer lives inside tick(): each iteration dispatches
+        step N+1 FIRST, then syncs step N — admission/retirement/prefill
+        planning all happen while the dispatched step runs, and a slot
+        retired at step N stays masked until step N+1's dispatch already
+        consumed the old occupancy (the one-step-lagged lifecycle)."""
+        self.start(on_token)
+        try:
+            for r in requests:
+                self.submit(r)
+            while self.active:
+                if not self.tick():
+                    # queue non-empty but every arrival is in the
+                    # future: sleep up to the next one instead of
+                    # spinning
+                    nxt = self.scheduler.next_arrival()
+                    now = self._session["now_fn"]()
+                    if nxt is not None and nxt > now:
+                        time.sleep(min(nxt - now, 0.05))
+        except Exception:
+            self._session = None
+            raise
+        return self.finish()
 
 
 class PrefillEngine(ServingEngine):

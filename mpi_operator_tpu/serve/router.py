@@ -1,0 +1,322 @@
+"""Serving front door: prefix-affinity routing over an engine fleet.
+
+One engine is HBM-bound; the fleet answer is N replicas behind a router.
+This module is that router: a single-threaded dispatcher that drives N
+in-process ServingEngine replicas through their steppable session API
+(engine.start/submit/tick/finish) on ONE shared clock, deciding for each
+arriving request
+
+  1. whether to admit it at all (per-replica in-flight caps — the shed
+     path rejects at the front door BEFORE a request strands pages or
+     slots on a saturated replica), and
+  2. WHICH replica serves it, by prefix-cache affinity first: the
+     replica whose PageAllocator holds the deepest warm chain for the
+     prompt's page-aligned prefix windows (the same
+     `(parent_page, token_window)` keying slots.py uses — probed via
+     PageAllocator.probe, so router and replica can never key
+     differently), load-aware dispatch (queue depth x free slots x free
+     pages) breaking ties and taking over entirely when affinity is off
+     or cold.
+
+Affinity NEVER overrides load saturation: a replica at its in-flight
+cap is ineligible no matter how warm its cache is — a hit on a full
+replica would queue behind its whole backlog and lose more TTFT than
+the prefill it saves.
+
+Failover: a replica whose submit/tick raises is marked dead, and every
+request it still held in flight is resubmitted to the survivors
+(idempotent at the front door — results key by request id and the
+replay is a fresh Request, so the caller sees exactly one result per
+request; greedy tokens are engine-independent, so the replay is
+token-identical). Streamed tokens for a request that later failed over
+restart from the replayed prefill.
+
+Every decision is observable through RouterTelemetry
+(telemetry/worker.py): per-replica dispatch counters, affinity
+hit/miss pages, shed count, queue-wait histograms — `tpu_router_*`
+series the controller's collector federates into `tpu_job_router_*`.
+"""
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Sequence
+
+from .engine import Request, RequestResult, ServingEngine
+from .scheduler import Scheduler
+
+__all__ = ["ReplicaHandle", "Router", "RouterConfig"]
+
+
+@dataclass
+class RouterConfig:
+    """Front-door policy knobs.
+
+    max_inflight: per-replica in-flight cap (dispatched, not yet
+    retired). The shed path fires when EVERY live replica is at its
+    cap — a bounded fleet-wide backlog, so a burst degrades to fast
+    rejections instead of unbounded queueing.
+    affinity: prefix-affinity scoring on/off (off = pure load-aware
+    dispatch; the bench's A/B switch).
+    """
+    max_inflight: int = 8
+    affinity: bool = True
+
+
+@dataclass
+class ReplicaHandle:
+    """One engine replica as the router sees it: the engine itself plus
+    the front door's own bookkeeping (which request ids it holds, and
+    whether it is still alive)."""
+    index: int
+    engine: ServingEngine
+    alive: bool = True
+    inflight: Dict[int, Request] = field(default_factory=dict)
+    dispatched_total: int = 0
+
+    # -- scoring inputs ---------------------------------------------------
+
+    def affinity_pages(self, prompt: Sequence[int]) -> int:
+        """Warm-chain depth (pages) this replica's prefix cache holds
+        for `prompt` — PageAllocator.probe, i.e. EXACTLY the keying its
+        own admission lookup will walk. 0 without paging."""
+        alloc = self.engine.page_allocator
+        if alloc is None:
+            return 0
+        return alloc.probe(prompt)
+
+    def load(self) -> tuple:
+        """Load-aware dispatch key, ascending = less loaded: in-flight
+        requests and queued-behind-slots depth first, then fewer free
+        slots, then fewer available pages. Mirrors the
+        `tpu_worker_queue_depth` / `tpu_worker_slot` /
+        `tpu_worker_kv_pages_*` gauges an out-of-process router would
+        scrape; in-process it reads the same state directly."""
+        eng = self.engine
+        alloc = eng.page_allocator
+        free_pages = alloc.available if alloc is not None else 0
+        return (len(self.inflight) + len(eng.scheduler.queue),
+                -len(eng.slots.free),
+                -free_pages)
+
+    def fits(self, req: Request) -> bool:
+        """Whether this replica could EVER hold the request's worst-case
+        page span — a span the pool can't cover is submit()-rejected, so
+        it is not a routing candidate."""
+        alloc = self.engine.page_allocator
+        if alloc is None:
+            return True
+        return Scheduler.pages_needed(req, alloc.page_size) <= alloc.usable
+
+
+class Router:
+    """Front-door dispatcher over N in-process engine replicas.
+
+    Usage (the serve_benchmark / tier1 --router shape):
+        router = Router([engine0, engine1], RouterConfig())
+        results = router.run(requests)          # same contract as
+                                                # ServingEngine.run()
+
+    The loop is cooperative round-robin: each iteration admits every
+    due arrival (route or shed), then ticks each live replica once.
+    Replicas that raise are failed over (see module docstring). All
+    replicas share one session clock, so `arrival` offsets and TTFTs
+    are fleet-consistent.
+    """
+
+    def __init__(self, engines: Sequence[ServingEngine],
+                 config: Optional[RouterConfig] = None,
+                 telemetry=None):
+        """telemetry: a telemetry.RouterTelemetry (optional,
+        None-cost when absent)."""
+        if not engines:
+            raise ValueError("router needs at least one engine replica")
+        cfg = config or RouterConfig()
+        if cfg.max_inflight < 1:
+            raise ValueError(f"max_inflight must be >= 1, got "
+                             f"{cfg.max_inflight}")
+        self.config = cfg
+        self.replicas = [ReplicaHandle(i, e) for i, e in enumerate(engines)]
+        self.telemetry = telemetry
+        self.results: Dict[int, RequestResult] = {}
+        self.shed: Dict[int, RequestResult] = {}
+        self.resubmitted_total = 0
+        self.affinity_hit_pages = 0
+        self.affinity_miss_pages = 0
+
+    # -- routing policy ---------------------------------------------------
+
+    def _live(self) -> List[ReplicaHandle]:
+        return [r for r in self.replicas if r.alive]
+
+    def _pick(self, req: Request) -> Optional[ReplicaHandle]:
+        """The dispatch decision. Eligible = alive, under the in-flight
+        cap, and able to ever fit the span; among those, deepest warm
+        prefix chain wins (affinity on), load key breaks ties, lowest
+        index makes it deterministic. Returns None = shed."""
+        eligible = [r for r in self._live()
+                    if len(r.inflight) < self.config.max_inflight
+                    and r.fits(req)]
+        if not eligible:
+            return None
+        if self.config.affinity:
+            scored = [(-r.affinity_pages(req.prompt), r.load(), r.index, r)
+                      for r in eligible]
+        else:
+            scored = [(0, r.load(), r.index, r) for r in eligible]
+        scored.sort(key=lambda s: s[:3])
+        return scored[0][3]
+
+    def _shed(self, req: Request, now: float) -> None:
+        """Front-door rejection: a result with finish_reason "shed" and
+        no tokens — the request never touched a replica, so no pages or
+        slots were stranded."""
+        self.shed[req.id] = RequestResult(
+            id=req.id, tokens=[], logprobs=[], finish_reason="shed",
+            ttft=-1.0, token_times=[], cached_tokens=0, admitted_at=now)
+        if self.telemetry is not None:
+            self.telemetry.shed_total.inc()
+
+    def _dispatch(self, req: Request, now: float) -> bool:
+        """Route one due request: pick a replica (or shed), record the
+        affinity prediction, submit. Returns False when shed."""
+        rep = self._pick(req)
+        if rep is None:
+            self._shed(req, now)
+            return False
+        # measured in BOTH modes (affinity off still records how warm the
+        # load-chosen replica happened to be) so the A/B hit-rate
+        # comparison is honest, not affinity-counting-itself
+        warm = rep.affinity_pages(req.prompt)
+        alloc = rep.engine.page_allocator
+        full = (max(0, (len(req.prompt) - 1) // alloc.page_size)
+                if alloc is not None else 0)
+        self.affinity_hit_pages += warm
+        self.affinity_miss_pages += full - warm
+        tel = self.telemetry
+        if tel is not None:
+            tel.dispatch_for(rep.index).inc()
+            tel.affinity_hit_pages.inc(warm)
+            tel.affinity_miss_pages.inc(full - warm)
+            if now >= req.arrival:
+                tel.queue_wait_seconds.observe(now - req.arrival)
+        rep.engine.submit(req)
+        rep.inflight[req.id] = req
+        rep.dispatched_total += 1
+        return True
+
+    def _fail_replica(self, rep: ReplicaHandle, now: float,
+                      backlog: List[Request]) -> None:
+        """Mark a replica dead and push its in-flight requests back on
+        the dispatch backlog as fresh arrivals. The dead engine's
+        partial results are DISCARDED (results key by id; the replay
+        produces the authoritative — and for greedy traffic identical —
+        tokens)."""
+        rep.alive = False
+        if self.telemetry is not None:
+            self.telemetry.replica_deaths.inc()
+        for req in rep.inflight.values():
+            replay = Request(
+                id=req.id, prompt=list(req.prompt),
+                max_new_tokens=req.max_new_tokens,
+                temperature=req.temperature, top_k=req.top_k,
+                top_p=req.top_p, eos_id=req.eos_id, arrival=now)
+            backlog.append(replay)
+            self.resubmitted_total += 1
+            if self.telemetry is not None:
+                self.telemetry.resubmits_total.inc()
+        rep.inflight.clear()
+
+    # -- the loop ---------------------------------------------------------
+
+    def run(self, requests: Sequence[Request] = (),
+            on_token: Optional[Callable[[Request, int], None]] = None,
+            ) -> Dict[int, RequestResult]:
+        """Drive the fleet until every request completes or sheds.
+        Same contract as ServingEngine.run(): returns
+        {request.id: RequestResult}; shed requests appear with
+        finish_reason "shed" and no tokens."""
+        if any(not r.alive for r in self.replicas):
+            raise RuntimeError("router already consumed (dead replicas)")
+        t0 = time.perf_counter()
+        now_fn = lambda: time.perf_counter() - t0   # noqa: E731
+        for rep in self.replicas:
+            rep.engine.start(on_token, now_fn=now_fn)
+        # FCFS dispatch backlog; failover replays append at the tail
+        backlog: List[Request] = sorted(requests, key=lambda r: r.arrival)
+        seen = set()
+        for r in backlog:
+            if r.id in seen:
+                raise ValueError(f"duplicate request id {r.id}")
+            seen.add(r.id)
+        while True:
+            now = now_fn()
+            # admit every due arrival this pass (route or shed) — sheds
+            # happen at ARRIVAL, never after queueing on a replica
+            while backlog and backlog[0].arrival <= now:
+                self._dispatch(backlog.pop(0), now)
+            progressed = False
+            for rep in self._live():
+                try:
+                    progressed |= rep.engine.tick()
+                except Exception:
+                    self._fail_replica(rep, now_fn(), backlog)
+                    backlog.sort(key=lambda r: r.arrival)
+                    continue
+                self._collect(rep)
+            live = self._live()
+            if not live:
+                raise RuntimeError(
+                    f"every replica died with {len(backlog)} request(s) "
+                    f"outstanding")
+            if not backlog and all(not r.engine.active for r in live):
+                break
+            if not progressed:
+                # everything is waiting on a future arrival
+                nxt = backlog[0].arrival if backlog else None
+                for rep in live:
+                    rn = rep.engine.scheduler.next_arrival()
+                    if rn is not None:
+                        nxt = rn if nxt is None else min(nxt, rn)
+                now = now_fn()
+                if nxt is not None and nxt > now:
+                    time.sleep(min(nxt - now, 0.05))
+        out: Dict[int, RequestResult] = {}
+        for rep in self.replicas:
+            if rep.alive:
+                self._collect(rep, final=rep.engine.finish())
+        out.update(self.results)
+        out.update(self.shed)
+        if self.telemetry is not None:
+            self.telemetry.requests_total.inc(len(self.results))
+        return out
+
+    def _collect(self, rep: ReplicaHandle,
+                 final: Optional[Dict[int, RequestResult]] = None) -> None:
+        """Fan in newly retired results from one replica. Results key by
+        request id — the idempotence point for failover replays (a dead
+        replica's partials were dropped with it, so each id lands here
+        exactly once)."""
+        done = final if final is not None \
+            else rep.engine.session_results()
+        for rid in [r for r in rep.inflight if r in done]:
+            self.results[rid] = done[rid]
+            del rep.inflight[rid]
+
+    # -- reporting --------------------------------------------------------
+
+    def affinity_hit_rate(self) -> float:
+        """Warm pages / full prompt pages over every dispatched request
+        (the prediction made AT dispatch; replica-side
+        prefix_hit_pages counters confirm it at admission)."""
+        total = self.affinity_hit_pages + self.affinity_miss_pages
+        return self.affinity_hit_pages / total if total else 0.0
+
+    def dispatch_counts(self) -> List[int]:
+        return [r.dispatched_total for r in self.replicas]
+
+    def shed_count(self) -> int:
+        return len(self.shed)
+
+    def dead_replicas(self) -> List[int]:
+        return [r.index for r in self.replicas if not r.alive]

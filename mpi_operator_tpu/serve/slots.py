@@ -41,6 +41,22 @@ from .scheduler import RequestState
 PrefixKey = Tuple[int, Tuple[int, ...]]
 
 
+def prefix_chain_windows(prompt: Sequence[int], page_size: int,
+                         full_pages: Optional[int] = None,
+                         ) -> List[Tuple[int, ...]]:
+    """The page-aligned token windows of `prompt`'s complete pages — the
+    token half of each chained PrefixKey, in chain order. This is the
+    SINGLE source of the keying both sides of the front door use: the
+    allocator's lookup/probe walk these windows against its cache, and
+    the serving router scores replica affinity over the same windows —
+    so a change to the keying here moves router and replica together
+    (no silent divergence)."""
+    if full_pages is None:
+        full_pages = max(0, (len(prompt) - 1) // page_size)
+    return [tuple(int(t) for t in prompt[k * page_size:(k + 1) * page_size])
+            for k in range(full_pages)]
+
+
 class PageAllocator:
     """Physical KV pages for the paged serving cache: a free list,
     per-page refcounts, and the prompt-prefix cache.
@@ -178,13 +194,11 @@ class PageAllocator:
         complete pages and PIN every match. Returns the matched chain
         (physical page ids, possibly empty); callers release() each page
         if they end up not admitting."""
-        ps = self.page_size
         chain: List[int] = []
         parent = -1
-        for k in range(full_pages):
-            key = (parent, tuple(int(t) for t in
-                                 prompt[k * ps:(k + 1) * ps]))
-            p = self._cache.get(key)
+        for window in prefix_chain_windows(prompt, self.page_size,
+                                           full_pages):
+            p = self._cache.get((parent, window))
             if p is None:
                 break
             self.pin(p)
@@ -193,6 +207,25 @@ class PageAllocator:
         self.hits += len(chain)
         self.misses += full_pages - len(chain)
         return chain
+
+    def probe(self, prompt: Sequence[int],
+              full_pages: Optional[int] = None) -> int:
+        """Depth of the warm prefix chain for `prompt` WITHOUT pinning
+        pages or touching the hit/miss counters — the read-only variant
+        of lookup() the serving router's affinity scoring uses. Walks
+        the same prefix_chain_windows keying, so probe depth k promises
+        a later lookup() of the same prompt at least k hit pages
+        (barring eviction in between)."""
+        depth = 0
+        parent = -1
+        for window in prefix_chain_windows(prompt, self.page_size,
+                                           full_pages):
+            p = self._cache.get((parent, window))
+            if p is None:
+                break
+            depth += 1
+            parent = p
+        return depth
 
     def publish(self, page: int, parent: int,
                 tokens: Sequence[int]) -> bool:
@@ -349,4 +382,4 @@ class SlotManager:
         return toks, pos, use_prev, temps, top_ks, top_ps, consumers
 
 
-__all__ = ["PageAllocator", "SlotManager"]
+__all__ = ["PageAllocator", "SlotManager", "prefix_chain_windows"]

@@ -17,7 +17,9 @@ from .events import (BoundEventLog, EventLog, read_events,
 from .prometheus import (CONTENT_TYPE, TelemetryServer, escape_label_value,
                          format_value, histogram_lines, render_registry)
 from .spans import span
-from .worker import ServeTelemetry, TrainTelemetry, WorkerTelemetry
+from .worker import (
+    RouterTelemetry, ServeTelemetry, TrainTelemetry, WorkerTelemetry,
+)
 
 __all__ = [
     "ClockSync", "JobObservatory", "MetricsFederation", "goodput_ledger",
@@ -34,5 +36,6 @@ __all__ = [
     "CONTENT_TYPE", "TelemetryServer", "escape_label_value", "format_value",
     "histogram_lines", "render_registry",
     "span",
-    "ServeTelemetry", "TrainTelemetry", "WorkerTelemetry",
+    "RouterTelemetry", "ServeTelemetry", "TrainTelemetry",
+    "WorkerTelemetry",
 ]

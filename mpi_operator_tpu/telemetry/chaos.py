@@ -26,14 +26,30 @@ worker rank or ``*`` and `<kind>` is one of
                     too (an asymmetric network partition — one rank
                     dark for a stretch while its peers keep reporting)
 
-Determinism: one seeded random.Random, rolled once per fetch in the
-collector's sorted-rank fetch order — a given (seed, rules, lifecycle
-sequence) replays the identical fault sequence, which is what lets the
-chaos soak print a reproducer seed that actually reproduces.
+A rule may carry a time-varying **burst** modifier —
+``<rank>/<kind>=<rate>:burst:<period>/<duty>`` — which turns the flat
+rate into a square wave over the rank's own fetch count: within every
+window of ``period`` fetches the rule is live for the first
+``duty * period`` fetches (rate applies) and silent for the rest (rate
+0). A soak under ``*/fail=0.6:burst:8/0.25`` therefore oscillates
+between fault storms and calm stretches, which is exactly the shape
+that exercises lease re-arm paths: a lease must survive the storm
+without a false-positive expiry AND re-arm promptly in the calm.
+
+Determinism: one seeded random.Random, rolled once per matching live
+rule in the collector's sorted-rank fetch order, plus per-rank fetch
+counters that advance on every fetch — a given (seed, rules, lifecycle
+sequence) replays the identical fault sequence AND burst phasing, which
+is what lets the chaos soak print a reproducer seed that actually
+reproduces.
 
 Like FaultingAPIServer, the first matching rule wins and every injected
 error message carries ``(seed=N)`` so a failure in a larger harness is
-attributable to its soak at a glance.
+attributable to its soak at a glance. Burst-windowed injections
+additionally name their window index — ``(seed=N, burst=W)`` — because
+a seed alone pins the roll sequence but not WHICH oscillation the fault
+landed in; with the index a reproducer can fast-forward straight to the
+offending burst instead of replaying the whole soak.
 """
 from __future__ import annotations
 
@@ -52,10 +68,16 @@ DEFAULT_PARTITION_FETCHES = 3
 
 @dataclasses.dataclass(frozen=True)
 class ScrapeFaultRule:
-    """``<rank>/<kind>=<rate>`` — rank ``*`` matches every rank."""
+    """``<rank>/<kind>=<rate>[:burst:<period>/<duty>]`` — rank ``*``
+    matches every rank. With a burst modifier the rule is only live
+    during the leading ``duty`` fraction of every ``period``-fetch
+    window of the rank's own fetch count (a square wave; see module
+    docstring)."""
     rank: str
     kind: str
     rate: float
+    burst_period: Optional[int] = None
+    burst_duty: Optional[float] = None
 
     def __post_init__(self):
         if self.kind not in SCRAPE_FAULT_KINDS:
@@ -69,24 +91,70 @@ class ScrapeFaultRule:
         if not (0.0 < self.rate <= 1.0):
             raise ValueError(
                 f"rate must be in (0, 1], got {self.rate}")
+        if (self.burst_period is None) != (self.burst_duty is None):
+            raise ValueError(
+                "burst_period and burst_duty must be set together")
+        if self.burst_period is not None:
+            if self.burst_period < 2:
+                raise ValueError(
+                    f"burst period must be >= 2 fetches, "
+                    f"got {self.burst_period}")
+            if not (0.0 < self.burst_duty < 1.0):
+                raise ValueError(
+                    f"burst duty must be in (0, 1), got {self.burst_duty} "
+                    f"(duty 1 is just a flat rate — drop the modifier)")
 
     @classmethod
     def parse(cls, text: str) -> "ScrapeFaultRule":
-        head, sep, rate = text.partition("=")
+        head, sep, tail = text.partition("=")
         rank, sep2, kind = head.partition("/")
+        rate, _, modifier = tail.partition(":")
         if not sep or not sep2 or not rank or not kind or not rate:
             raise ValueError(
                 f"bad scrape fault rule {text!r}; want "
-                f"'<rank>/<kind>=<rate>' (e.g. '*/fail=0.2', "
-                f"'3/partition-window=0.05')")
+                f"'<rank>/<kind>=<rate>[:burst:<period>/<duty>]' "
+                f"(e.g. '*/fail=0.2', '3/partition-window=0.05', "
+                f"'*/fail=0.6:burst:8/0.25')")
         try:
             rate_f = float(rate)
         except ValueError:
             raise ValueError(f"bad rate in scrape fault rule {text!r}")
-        return cls(rank=rank.strip(), kind=kind.strip(), rate=rate_f)
+        period = duty = None
+        if modifier:
+            mkind, _, spec = modifier.partition(":")
+            p_s, psep, d_s = spec.partition("/")
+            if mkind != "burst" or not psep or not p_s or not d_s:
+                raise ValueError(
+                    f"bad modifier in scrape fault rule {text!r}; want "
+                    f":burst:<period>/<duty> (e.g. ':burst:8/0.25')")
+            try:
+                period, duty = int(p_s), float(d_s)
+            except ValueError:
+                raise ValueError(
+                    f"bad burst period/duty in scrape fault rule {text!r}")
+        return cls(rank=rank.strip(), kind=kind.strip(), rate=rate_f,
+                   burst_period=period, burst_duty=duty)
 
     def matches(self, rank: int) -> bool:
         return self.rank == "*" or int(self.rank) == rank
+
+    # -- burst phasing ----------------------------------------------------
+
+    def burst_index(self, fetch_index: int) -> Optional[int]:
+        """Which oscillation window a fetch lands in (None: no burst)."""
+        if self.burst_period is None:
+            return None
+        return fetch_index // self.burst_period
+
+    def live(self, fetch_index: int) -> bool:
+        """Whether the rule's rate applies at this fetch of the rank.
+        Rules without a burst modifier are always live; burst rules are
+        live for the leading ceil-free ``duty * period`` fetches of each
+        window (at least one fetch per window, by the duty bounds)."""
+        if self.burst_period is None:
+            return True
+        phase = fetch_index % self.burst_period
+        return phase < self.burst_duty * self.burst_period
 
 
 class ScrapeFaultInjector:
@@ -115,9 +183,15 @@ class ScrapeFaultInjector:
         self._lag: Dict[str, str] = {}
         #: rank -> failing fetches remaining in its partition window
         self._partition: Dict[int, int] = {}
+        #: rank -> fetches seen, the clock burst phasing runs on
+        self._fetches: Dict[int, int] = {}
         #: (rank, kind) -> injections, the soak-report evidence that the
         #: configured mix actually fired (mirrors FaultingAPIServer)
         self.faults_injected: Dict[Tuple[int, str], int] = {}
+        #: (rank, burst window index) pairs that actually injected — a
+        #: soak asserts len(set of windows) >= 2 to prove the oscillation
+        #: spanned storms, not one lucky streak
+        self.bursts_fired: List[Tuple[int, int]] = []
 
     # -- bookkeeping ------------------------------------------------------
 
@@ -134,11 +208,35 @@ class ScrapeFaultInjector:
         """Ranks whose partition window is currently open."""
         return sorted(r for r, n in self._partition.items() if n > 0)
 
-    def _roll(self, rank: int) -> Optional[str]:
+    def burst_windows_hit(self) -> int:
+        """Distinct (rank, window index) bursts that actually injected."""
+        return len(set(self.bursts_fired))
+
+    def _roll(self, rank: int,
+              fetch_index: int) -> Tuple[Optional[str], Optional[int]]:
+        """(kind, burst window index) of the first rule that fires, or
+        (None, None). The rng is only rolled for LIVE rules so a burst
+        rule's silent phase consumes no randomness — phasing and rolls
+        stay independently reproducible."""
         for rule in self.rules:
-            if rule.matches(rank) and self.rng.random() < rule.rate:
-                return rule.kind
-        return None
+            if not (rule.matches(rank) and rule.live(fetch_index)):
+                continue
+            if self.rng.random() < rule.rate:
+                burst = rule.burst_index(fetch_index)
+                if burst is not None:
+                    self.bursts_fired.append((rank, burst))
+                return rule.kind, burst
+        return None, None
+
+    def _tag(self, burst: Optional[int]) -> str:
+        """The reproducer suffix every injected message carries: the
+        seed always, plus the burst window index when the fault came
+        from an oscillating rule (a seed pins the roll sequence; the
+        index pins WHICH storm, so a reproducer can skip straight
+        there)."""
+        if burst is None:
+            return f"(seed={self.seed})"
+        return f"(seed={self.seed}, burst={burst})"
 
     # -- the fetch wrapper ------------------------------------------------
 
@@ -146,27 +244,29 @@ class ScrapeFaultInjector:
               real_fetch: Callable[[str], str]) -> str:
         """One per-pod fetch, faults applied. An OPEN partition window
         dominates any roll (the rank is dark, full stop); otherwise the
-        first matching rule that fires decides the fault."""
+        first matching live rule that fires decides the fault."""
+        fetch_index = self._fetches.get(rank, 0)
+        self._fetches[rank] = fetch_index + 1
         left = self._partition.get(rank, 0)
         if left > 0:
             self._partition[rank] = left - 1
             self._count(rank, "partition-window")
             raise IOError(
                 f"injected: rank {rank} partitioned, {url} unreachable "
-                f"(seed={self.seed})")
-        kind = self._roll(rank)
+                f"{self._tag(None)}")
+        kind, burst = self._roll(rank, fetch_index)
         if kind == "fail":
             self._count(rank, "fail")
             raise IOError(
                 f"injected: scrape of rank {rank} failed ({url}) "
-                f"(seed={self.seed})")
+                f"{self._tag(burst)}")
         if kind == "partition-window":
             self._partition[rank] = self.partition_fetches
             self._count(rank, "partition-window")
             raise IOError(
                 f"injected: rank {rank} partition window opened "
                 f"({self.partition_fetches} fetches dark) "
-                f"(seed={self.seed})")
+                f"{self._tag(burst)}")
         if kind == "stale-replay" and url in self._last:
             # replay WITHOUT refreshing _last: consecutive stale-replays
             # keep serving the same snapshot, like a genuinely stuck
@@ -184,7 +284,7 @@ class ScrapeFaultInjector:
             if lagged is None:
                 raise IOError(
                     f"injected: scrape of rank {rank} timed out ({url}) "
-                    f"(seed={self.seed})")
+                    f"{self._tag(burst)}")
             self._last[url] = lagged
             return lagged
         text = real_fetch(url)

@@ -61,6 +61,7 @@ from .prometheus import escape_label_value, format_value
 logger = logging.getLogger("mpi_operator_tpu.telemetry.collector")
 
 WORKER_PREFIX = "tpu_worker_"
+ROUTER_PREFIX = "tpu_router_"
 JOB_PREFIX = "tpu_job_"
 
 # timeline.jsonl size cap (0/unset = the historical full-rewrite mode).
@@ -138,6 +139,20 @@ def _gauge_is_summed(name: str) -> bool:
             or any(m in name for m in _SUM_GAUGE_MARKERS))
 
 
+def _fed_out(name: str) -> Optional[str]:
+    """Federated output name for a scraped series, or None when the
+    series does not federate. ``tpu_worker_X`` → ``tpu_job_X``;
+    ``tpu_router_X`` → ``tpu_job_router_X`` (the front door is one
+    process, not a gang member — keeping its series in their own
+    ``router_`` namespace means a fleet's queue_wait can never collide
+    with a worker series of the same name)."""
+    if name.startswith(WORKER_PREFIX):
+        return JOB_PREFIX + name[len(WORKER_PREFIX):]
+    if name.startswith(ROUTER_PREFIX):
+        return JOB_PREFIX + "router_" + name[len(ROUTER_PREFIX):]
+    return None
+
+
 def _lkey(labels: Dict[str, str]) -> Tuple[Tuple[str, str], ...]:
     return tuple(sorted((k, v) for k, v in labels.items() if k != "le"))
 
@@ -156,7 +171,8 @@ class MetricsFederation:
 
     Feed the latest scrape per replica_rank via ingest(); render() emits
     the aggregate plus per-pod scrape-health meta-series. Only
-    ``tpu_worker_*`` names federate — operator and meta series are not
+    ``tpu_worker_*`` and ``tpu_router_*`` names federate (the latter as
+    ``tpu_job_router_*``) — operator and meta series are not
     re-aggregated."""
 
     def __init__(self, job: str, clock: Callable[[], float] = time.time,
@@ -217,6 +233,41 @@ class MetricsFederation:
         is absent (no attempt, no verdict)."""
         return sorted(r for r, p in self.pods.items() if not p["ok"])
 
+    def histogram_quantile(self, base: str, q: float) -> Optional[float]:
+        """Bucket-walk quantile over the federated histogram `base`
+        (scraped-side name, e.g. ``tpu_worker_ttft_seconds``), label
+        sets merged. Returns the upper bound of the first cumulative
+        bucket covering the target rank — the conservative (over-)
+        estimate an SLO comparison wants — or None when the histogram
+        is empty or every observation landed in +Inf."""
+        if not 0.0 <= q <= 1.0:
+            raise ValueError(f"quantile must be in [0, 1], got {q}")
+        _counters, _gauges, hists, _kinds = self._aggregate()
+        buckets: Dict[str, float] = {}
+        for (name, _lk), h in hists.items():
+            if name != base:
+                continue
+            for le, v in h["buckets"].items():
+                buckets[le] = buckets.get(le, 0.0) + v
+        total = buckets.get("+Inf", 0.0)
+        if total <= 0:
+            return None
+        target = q * total
+        for le in sorted(buckets, key=self._le_sort_key):
+            if buckets[le] >= target and le != "+Inf":
+                return float(le)
+        return None
+
+    def gauge_value(self, name: str) -> Optional[float]:
+        """The federated value of one gauge (scraped-side name), label
+        sets folded with the same SUM/MAX rule _aggregate applies
+        across pods. None when no pod reported it."""
+        _counters, gauges, _hists, _kinds = self._aggregate()
+        vals = [v for (n, _lk), v in gauges.items() if n == name]
+        if not vals:
+            return None
+        return sum(vals) if _gauge_is_summed(name) else max(vals)
+
     def _aggregate(self):
         counters: Dict[Tuple, float] = {}
         gauges: Dict[Tuple, float] = {}
@@ -227,7 +278,7 @@ class MetricsFederation:
             for name, labels, value in pod["samples"]:
                 base = _hist_base(name, types)
                 if base is not None:
-                    if not base.startswith(WORKER_PREFIX):
+                    if _fed_out(base) is None:
                         continue
                     key = (base, _lkey(labels))
                     h = hists.setdefault(key, {"buckets": {}, "sum": 0.0,
@@ -241,7 +292,7 @@ class MetricsFederation:
                         h["count"] += value
                     kinds[base] = "histogram"
                     continue
-                if not name.startswith(WORKER_PREFIX):
+                if _fed_out(name) is None:
                     continue
                 kind = types.get(name, "gauge")
                 key = (name, _lkey(labels))
@@ -275,27 +326,26 @@ class MetricsFederation:
         lines: List[str] = []
         seen = set()
 
-        def head(out_name: str, kind: str):
+        def head(out_name: str, kind: str, src: str):
             if out_name not in seen:
                 seen.add(out_name)
                 lines.append(f"# HELP {out_name} federated from "
-                             f"{WORKER_PREFIX}{out_name[len(JOB_PREFIX):]}"
-                             f" across the gang")
+                             f"{src} across the gang")
                 lines.append(f"# TYPE {out_name} {kind}")
 
         for (name, lkey), value in sorted(counters.items()):
-            out = JOB_PREFIX + name[len(WORKER_PREFIX):]
-            head(out, "counter")
+            out = _fed_out(name)
+            head(out, "counter", name)
             lines.append(f"{out}{self._out_labels(lkey)} "
                          f"{format_value(value)}")
         for (name, lkey), value in sorted(gauges.items()):
-            out = JOB_PREFIX + name[len(WORKER_PREFIX):]
-            head(out, "gauge")
+            out = _fed_out(name)
+            head(out, "gauge", name)
             lines.append(f"{out}{self._out_labels(lkey)} "
                          f"{format_value(value)}")
         for (base, lkey), h in sorted(hists.items()):
-            out = JOB_PREFIX + base[len(WORKER_PREFIX):]
-            head(out, "histogram")
+            out = _fed_out(base)
+            head(out, "histogram", base)
             for le in sorted(h["buckets"], key=self._le_sort_key):
                 lines.append(f"{out}_bucket"
                              f"{self._out_labels(lkey, {'le': le})} "
@@ -1054,4 +1104,5 @@ if __name__ == "__main__":
 __all__ = ["parse_prometheus", "MetricsFederation", "ClockSync",
            "merge_timeline", "goodput_ledger", "ledger_lines",
            "resize_ledger", "resize_lines", "RESIZE_BUCKETS",
-           "JobObservatory", "latest_boot_id", "main"]
+           "JobObservatory", "latest_boot_id", "main",
+           "WORKER_PREFIX", "ROUTER_PREFIX", "JOB_PREFIX"]

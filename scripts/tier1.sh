@@ -28,6 +28,14 @@
 #   colocated paged engine and the two-pool DisaggEngine, gated on
 #   token identity + the per-pool compile pins + actual KV handoffs.
 #
+#   ./scripts/tier1.sh --router runs the OUT-OF-PROCESS front-door
+#   smoke: 2 in-process engine replicas behind the prefix-affinity
+#   router on a shared-system-prompt trace, gated on token identity vs
+#   the single-engine oracle, a nonzero (and A/B-higher) affinity hit
+#   rate, zero sheds at low load, and >= 1 shed + clean recovery at the
+#   overload burst. Budget: ~5 min of the 10-min leg timeout on a cold
+#   CPU cache (mirrored in ROADMAP.md).
+#
 #   ./scripts/tier1.sh --elastic runs the OUT-OF-PROCESS gang-resize
 #   smoke: one training run resized 4 -> 2 -> 4 CPU-host devices via
 #   SIGTERM drain + resharding restore (TPU_RESHARD_RESTORE=1), gated
@@ -75,6 +83,61 @@ if [ "${1:-}" = "--serving" ]; then
   done
   echo "serving smoke: OK (disagg A/B token-identical, pool pins held," \
        "$(grep -o '"disagg_handoffs": [0-9]*' "$dir/disagg.json" | grep -o '[0-9]*') handoffs)"
+  exit 0
+fi
+
+if [ "${1:-}" = "--router" ]; then
+  # Front-door smoke via the benchmark CLI (examples/serve_benchmark.py
+  # --router): one subprocess builds replica fleets from the same
+  # params, replays one seeded multi-tenant shared-prefix trace with
+  # affinity ON vs OFF plus an overload burst, and prints a JSON line.
+  # On CPU the latency split is structural, so the gates are the
+  # CORRECTNESS contracts below.
+  set -u
+  dir=$(mktemp -d)
+  trap 'rm -rf "$dir"' EXIT
+  echo "== router smoke: prefix-affinity front door over 2 replicas =="
+  timeout -k 10 600 env JAX_PLATFORMS=cpu \
+    python -m mpi_operator_tpu.examples.serve_benchmark \
+    --router --size test --slots 4 --num-requests 12 --page-size 16 \
+    > "$dir/router.json" 2> "$dir/router.log"
+  rc=$?
+  if [ "$rc" -ne 0 ]; then
+    echo "FAIL: router benchmark exited $rc"
+    tail -20 "$dir/router.log"; exit 1
+  fi
+  if ! grep -q '"router_token_identical": true' "$dir/router.json"; then
+    echo "FAIL: routed tokens differ from the single-engine oracle"
+    cat "$dir/router.json"; exit 1
+  fi
+  if ! grep -q '"router_affinity_nonzero": true' "$dir/router.json"; then
+    echo "FAIL: zero affinity hit rate — routing never found a warm chain"
+    cat "$dir/router.json"; exit 1
+  fi
+  if ! grep -q '"router_affinity_hit_gain": true' "$dir/router.json"; then
+    echo "FAIL: affinity routing did not beat load-only on replica-side hit rate"
+    cat "$dir/router.json"; exit 1
+  fi
+  if ! grep -q '"router_shed_low_load": 0' "$dir/router.json"; then
+    echo "FAIL: the router shed requests at low offered load"
+    cat "$dir/router.json"; exit 1
+  fi
+  if grep -q '"router_burst_sheds": 0' "$dir/router.json"; then
+    echo "FAIL: the overload burst shed nothing — admission control never fired"
+    cat "$dir/router.json"; exit 1
+  fi
+  if ! grep -q '"router_burst_recovery_clean": true' "$dir/router.json"; then
+    echo "FAIL: post-burst recovery requests did not complete cleanly"
+    cat "$dir/router.json"; exit 1
+  fi
+  if ! grep -q '"router_compile_pins_held": true' "$dir/router.json"; then
+    echo "FAIL: a replica broke the compile-count pins"
+    cat "$dir/router.json"; exit 1
+  fi
+  echo "router smoke: OK (token-identical, hit rate" \
+       "$(grep -o '"router_affinity_hit_rate": [0-9.]*' "$dir/router.json" | grep -o '[0-9.]*$') vs" \
+       "$(grep -o '"router_noaffinity_hit_rate": [0-9.]*' "$dir/router.json" | grep -o '[0-9.]*$') load-only," \
+       "$(grep -o '"router_burst_sheds": [0-9]*' "$dir/router.json" | grep -o '[0-9]*$') burst sheds, clean recovery)"
   exit 0
 fi
 
@@ -235,8 +298,11 @@ fi
 #   must produce a DegradedGang window and ZERO restarts; a wedged
 #   serving gang must be caught via the frozen token frontier within
 #   progressDeadlineSeconds; request timeouts must leak zero slots and
-#   zero KV pages. Deterministic per seed; the reproducer seed is
-#   printed on failure (and a deliberately-failing run below proves it).
+#   zero KV pages; bursty (time-varying) scrape faults must neither trip
+#   nor disarm the serving lease; and a mid-trace replica kill behind
+#   the router must lose zero requests. Deterministic per seed; the
+#   reproducer seed is printed on failure (and a deliberately-failing
+#   run below proves it).
 
 if [ "${1:-}" = "--chaos" ]; then
   set -u
@@ -295,6 +361,20 @@ if [ "${1:-}" = "--chaos" ]; then
   if grep -q '"request_timeouts": 0' "$dir/chaos.json" \
       || ! grep -q '"request_timeouts":' "$dir/chaos.json"; then
     echo "FAIL: the request-timeout leg retired nothing"
+    cat "$dir/chaos.json"; exit 1
+  fi
+  # bursty scrape faults must oscillate without a false-positive restart
+  # and still catch the real post-burst stall (lease re-armed)
+  if ! grep -q '"burst_false_positive_restarts": 0' "$dir/chaos.json" \
+      || ! grep -q '"burst_real_stall_detected": 1' "$dir/chaos.json"; then
+    echo "FAIL: the bursty-scrape leg tripped the lease (or never ran)"
+    cat "$dir/chaos.json"; exit 1
+  fi
+  # the router must survive a mid-trace replica kill with zero lost
+  # requests (resubmits to survivors, token-identical replays)
+  if ! grep -q '"router_failover_lost": 0' "$dir/chaos.json" \
+      || grep -q '"router_resubmitted": 0' "$dir/chaos.json"; then
+    echo "FAIL: the router-failover leg lost or never resubmitted requests"
     cat "$dir/chaos.json"; exit 1
   fi
   # failure discipline: a soak that DOES fail must print the reproducer
