@@ -310,6 +310,16 @@ class TPUJobSpec:
     # worker topology its own way).
     serving: Optional[ServingSpec] = None
 
+    # Fleet-scheduler priority (controller/scheduler.py): when the
+    # controller runs with a bounded slice pool (ControllerConfig.
+    # sched_pool_chips), jobs that do not fit are queued (a Queued
+    # condition) ordered by descending priority then creation time, and
+    # a higher-priority pending job may shrink a LOWER-priority elastic
+    # gang (status.sched_tpus, the elastic_tpus status-override
+    # discipline) to get admitted — grown back once slices free. 0 (the
+    # default) is the lowest priority; must be >= 0.
+    priority: int = 0
+
 
 # ---------------------------------------------------------------------------
 # Status — v1alpha2 condition model (ref common_types.go:23-156)
@@ -336,6 +346,18 @@ COND_STUCK = "StuckGang"
 # Flipped False with reason PartitionHealed once every rank scrapes
 # again. Distinct from COND_DEGRADED, which is the elastic-shrink state.
 COND_DEGRADED_GANG = "DegradedGang"
+# beyond the reference (fleet scheduler): True while the job is held in
+# the admission queue because the slice pool cannot fit it; flipped
+# False with reason SchedAdmit when capacity (possibly reclaimed by a
+# preemption) admits it. The True transition time is the queue-wait
+# anchor the scheduler's cost gate measures against.
+COND_QUEUED = "Queued"
+# beyond the reference (fleet scheduler): True while the scheduler has
+# this elastic gang shrunk below its own entitlement to serve a
+# higher-priority job (status.sched_tpus set); the message names the
+# beneficiary. Flipped False with reason SchedGrowBack when the gang is
+# restored to full size.
+COND_PREEMPTED = "Preempted"
 
 # v1alpha1 launcher status surface kept for parity (ref types.go:102-116)
 LAUNCHER_ACTIVE = "Active"
@@ -391,6 +413,22 @@ class TPUJobStatus:
     # the ordinary template-hash restart. None = run at the spec size.
     serving_decode_replicas: Optional[int] = None
     serving_scaled_at: Optional[float] = None
+    # fleet scheduler (controller/scheduler.py): the chip count a
+    # preempted elastic gang currently runs at (same status-override
+    # discipline as elastic_tpus — the spec is never edited; the
+    # allocation path takes min(elastic_tpus, sched_tpus) when both
+    # overrides are live), and when the last scheduler action against
+    # this job landed (the grow-back cooldown reference).
+    sched_tpus: Optional[int] = None
+    sched_scaled_at: Optional[float] = None
+    # degraded-rank pod migrations performed (dark pod deleted so the
+    # StatefulSet reschedules it) — counted DISTINCTLY from gang
+    # restarts: a migration never tears the gang down and never charges
+    # backoffLimit. migrated_window is the idempotency marker: the
+    # DegradedGang window id ("<transition_ts>:<pod_uid>") already
+    # migrated, so crash replays within one window never delete twice.
+    migration_count: int = 0
+    migrated_window: Optional[str] = None
 
     # -- condition helpers (ref: v1alpha2 intent; pkg has no impl) ----------
     def get_condition(self, cond_type: str) -> Optional[JobCondition]:
@@ -476,6 +514,7 @@ __all__ = [
     "TPUJobStatus", "TPUJob",
     "COND_CREATED", "COND_RUNNING", "COND_RESTARTING", "COND_SUCCEEDED",
     "COND_FAILED", "COND_DEGRADED", "COND_STUCK", "COND_DEGRADED_GANG",
+    "COND_QUEUED", "COND_PREEMPTED",
     "LAUNCHER_ACTIVE", "LAUNCHER_SUCCEEDED", "LAUNCHER_FAILED",
     "new_tpu_job", "deepcopy_obj",
 ]

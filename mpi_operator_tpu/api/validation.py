@@ -385,6 +385,16 @@ def validate_spec(spec: TPUJobSpec,
             f"{spec.progress_deadline_seconds}"
         )
 
+    if not isinstance(spec.priority, int) or isinstance(spec.priority, bool) \
+            or spec.priority < 0:
+        # fleet-scheduler ordering key: descending priority then creation
+        # time. Negative (or non-integer) priorities would make the queue
+        # order ambiguous against the 0 default.
+        errs.append(
+            f"spec.priority must be an integer >= 0, got "
+            f"{spec.priority!r}"
+        )
+
     if spec.clean_pod_policy not in ("Running", "All", "None"):
         # ref: v1alpha2/types.go:55-66 CleanPodPolicy
         errs.append(

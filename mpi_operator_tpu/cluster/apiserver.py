@@ -22,6 +22,7 @@ from __future__ import annotations
 
 import itertools
 import threading
+import time
 from collections import deque
 from dataclasses import dataclass, field
 from typing import Callable, Dict, List, Optional, Tuple
@@ -209,6 +210,10 @@ class InMemoryAPIServer:
             obj.metadata.resource_version = next(self._rv)
             if not obj.metadata.uid:
                 obj.metadata.uid = f"uid-{next(self._uid)}"
+            if obj.metadata.creation_timestamp is None:
+                # real API servers stamp this; the fleet scheduler's
+                # creation-order tie-breaking depends on it
+                obj.metadata.creation_timestamp = time.time()
             self._store[key] = obj
             self._record("create", obj)
             self._notify(obj.kind, "ADDED", obj)

@@ -284,6 +284,7 @@ def _tpujob_spec_to_manifest(s: TPUJobSpec) -> dict:
         "elastic": s.elastic or None,
         "minTpus": s.min_tpus,
         "resize": s.resize,
+        "priority": s.priority or None,
         "template": template_to_manifest(s.template),
     })
 
@@ -309,6 +310,7 @@ def _tpujob_spec_from_manifest(m: dict) -> TPUJobSpec:
         elastic=bool(m.get("elastic", False)),
         min_tpus=m.get("minTpus"),
         resize=m.get("resize"),
+        priority=int(m.get("priority", 0)),
         template=template_from_manifest(m.get("template") or {}),
     )
 
@@ -322,6 +324,10 @@ def _tpujob_status_to_manifest(st: TPUJobStatus) -> dict:
         "restartCount": st.restart_count or None,
         "elasticTpus": st.elastic_tpus,
         "elasticSince": rfc3339(st.elastic_since),
+        "schedTpus": st.sched_tpus,
+        "schedScaledAt": rfc3339(st.sched_scaled_at),
+        "migrationCount": st.migration_count or None,
+        "migratedWindow": st.migrated_window,
         "conditions": [
             _prune({
                 "type": c.type,
@@ -350,6 +356,10 @@ def _tpujob_status_from_manifest(m: dict) -> TPUJobStatus:
         restart_count=int(m.get("restartCount", 0)),
         elastic_tpus=m.get("elasticTpus"),
         elastic_since=parse_time(m.get("elasticSince")),
+        sched_tpus=m.get("schedTpus"),
+        sched_scaled_at=parse_time(m.get("schedScaledAt")),
+        migration_count=int(m.get("migrationCount", 0)),
+        migrated_window=m.get("migratedWindow"),
     )
     for c in m.get("conditions") or []:
         st.conditions.append(JobCondition(

@@ -9,7 +9,11 @@ depends on:
     concurrency-safety story (SURVEY §5 "Race detection");
   - Add while processing marks dirty → key is re-queued on Done;
   - AddRateLimited implements per-item exponential backoff;
-  - Forget resets the backoff counter (ref :399-404).
+  - Forget resets the backoff counter (ref :399-404);
+  - duplicate delayed adds for one key coalesce to the EARLIEST
+    deadline (ref delaying_queue.go waitingEntryByData): the scheduler's
+    hysteresis arms a wake-up on almost every sync, and without
+    coalescing each re-sync would stack another heap entry per key.
 """
 from __future__ import annotations
 
@@ -28,8 +32,12 @@ class RateLimitingQueue:
         self._failures: Dict[str, int] = {}
         self._base_delay = base_delay
         self._max_delay = max_delay
-        # delayed items: heap of (ready_time, key)
+        # delayed items: heap of (ready_time, key) plus the authoritative
+        # per-key deadline. The heap may hold superseded entries for a
+        # key (lazy invalidation); only an entry matching
+        # _waiting_deadlines[key] is live.
         self._waiting: List[tuple] = []
+        self._waiting_deadlines: Dict[str, float] = {}
         self._shutting_down = False
 
     # -- core queue (workqueue.Interface) -----------------------------------
@@ -86,21 +94,20 @@ class RateLimitingQueue:
             n = self._failures.get(key, 0)
             self._failures[key] = n + 1
             delay = min(self._base_delay * (2 ** n), self._max_delay)
-            heapq.heappush(self._waiting, (time.monotonic() + delay, key))
-            self._lock.notify()
+            self._arm_locked(key, time.monotonic() + delay)
 
     def add_after(self, key: str, delay: float) -> None:
         """Enqueue `key` after `delay` seconds WITHOUT touching the
         failure counter (workqueue.AddAfter): for scheduled re-syncs —
-        timeout checks, retry windows — not error backoff."""
+        timeout checks, retry windows — not error backoff. Duplicate
+        calls for one key coalesce to the earliest deadline."""
         if delay <= 0:
             self.add(key)
             return
         with self._lock:
             if self._shutting_down:
                 return
-            heapq.heappush(self._waiting, (time.monotonic() + delay, key))
-            self._lock.notify()
+            self._arm_locked(key, time.monotonic() + delay)
 
     def forget(self, key: str) -> None:
         with self._lock:
@@ -119,7 +126,7 @@ class RateLimitingQueue:
         with self._lock:
             return {
                 "queue": list(self._queue),
-                "waiting": sorted(key for _, key in self._waiting),
+                "waiting": sorted(self._waiting_deadlines),
                 "processing": set(self._processing),
                 "dirty": set(self._dirty),
                 "failures": dict(self._failures),
@@ -134,14 +141,29 @@ class RateLimitingQueue:
 
     def __len__(self) -> int:
         with self._lock:
-            return len(self._queue) + len(self._waiting)
+            return len(self._queue) + len(self._waiting_deadlines)
 
     # -- internal -----------------------------------------------------------
+
+    def _arm_locked(self, key: str, deadline: float) -> None:
+        """Coalesce: one live deadline per waiting key, the earliest
+        wins. A later-deadline duplicate is a no-op; an earlier one
+        pushes a new heap entry and retargets the live deadline (the
+        superseded entry is skipped lazily at drain time)."""
+        current = self._waiting_deadlines.get(key)
+        if current is not None and current <= deadline:
+            return
+        self._waiting_deadlines[key] = deadline
+        heapq.heappush(self._waiting, (deadline, key))
+        self._lock.notify()
 
     def _drain_waiting_locked(self) -> None:
         now = time.monotonic()
         while self._waiting and self._waiting[0][0] <= now:
-            _, key = heapq.heappop(self._waiting)
+            ready, key = heapq.heappop(self._waiting)
+            if self._waiting_deadlines.get(key) != ready:
+                continue                     # superseded by an earlier arm
+            del self._waiting_deadlines[key]
             if key not in self._dirty:
                 self._dirty.add(key)
                 if key not in self._processing:

@@ -514,12 +514,14 @@ def soak(seed: int = 0, lifecycles: int = 25,
 
 def _observed_harness(seed: int, fetch: Callable[[str], str],
                       scrape_faults: Sequence = (),
-                      serving_rate_floor: Optional[float] = None):
+                      serving_rate_floor: Optional[float] = None,
+                      config: Optional[ControllerConfig] = None):
     """A harness + fake-clock observatory wired for data-plane legs:
     scrapes go through `fetch` (and the harness's injector, when rules
     are given), time is the returned clock dict — no wall-clock
     dependence, so a (seed, rules) pair replays exactly."""
-    h = ChaosHarness(config=ControllerConfig(worker_metrics_port=9100),
+    h = ChaosHarness(config=config or ControllerConfig(
+                         worker_metrics_port=9100),
                      seed=seed, scrape_faults=scrape_faults)
     clock = {"now": 1000.0}
     obs = JobObservatory(events_dir=tempfile.mkdtemp(prefix="dp-chaos-"),
@@ -969,6 +971,215 @@ def data_plane_soak(seed: int = 0,
     return report
 
 
+# ---------------------------------------------------------------------------
+# scheduler soak: fleet-scheduler lifecycles (preempt-to-admit, grow-back,
+# anti-thrash refusal, degraded-rank migration) under the same fault +
+# crash-at-every-write schedule. Like the data-plane legs these are not
+# oracle-diffed — the queue/preempt conditions only exist in a contended
+# universe — so each asserts its contract explicitly.
+# ---------------------------------------------------------------------------
+
+def scheduler_rebalance(seed: int = 0, rules: Sequence = DEFAULT_RULES,
+                        crash_every_write: bool = True) -> Dict:
+    """The full preempt-to-admit / grow-back lifecycle with the
+    controller killed at every write boundary: a priority-1 job lands on
+    a full pool, the priority-0 elastic gang shrinks 8 -> 4 chips
+    through the ordinary drain/resize protocol (never a counted
+    restart), the high-priority job runs to completion, and the victim
+    grows back to its entitlement — zero double-shrinks, zero lost
+    admissions, zero leaks, zero wedged keys. The cooldown floor is 0
+    here: controller kills replace the clock-bearing process, so the
+    hysteresis brake is exercised by scheduler_thrash instead."""
+    h = ChaosHarness(rules=rules, seed=seed,
+                     crash_every_write=crash_every_write,
+                     config=ControllerConfig(
+                         sched_pool_chips=8,
+                         sched_cooldown_floor_seconds=0.0))
+    h.create_job("lo", tpus=8, priority=0, elastic=True, min_tpus=2)
+    _run_to_running(h, "lo")
+    h.create_job("hi", tpus=4, priority=1)
+    h.drive_until(
+        lambda: (h.job("lo").status.sched_tpus == 4
+                 and h.cond("hi", api.COND_QUEUED) == "False"),
+        "scheduler: preempt-to-admit")
+    if h.job("lo").status.sched_tpus != 4:
+        raise ConvergenceError(
+            f"scheduler leg: victim double-shrunk to "
+            f"{h.job('lo').status.sched_tpus}", seed)
+    if h.job("lo").status.restart_count:
+        raise ConvergenceError(
+            "scheduler leg: preemption burned the victim's restart "
+            "budget", seed)
+    h.drive_until(
+        lambda: (h.worker_sets("lo")
+                 and all(s.spec.replicas == 1 for s in h.worker_sets("lo"))),
+        "scheduler: victim shrink materialized")
+    h.make_workers_ready("lo")
+    _run_to_running(h, "hi")
+    h.finish_launcher("hi")
+    h.drive_until(lambda: h.cond("hi", COND_SUCCEEDED) == "True",
+                  "scheduler: hi Succeeded")
+    h.drive_until(
+        lambda: (h.job("lo").status.sched_tpus is None
+                 and h.cond("lo", api.COND_PREEMPTED) == "False"),
+        "scheduler: grow-back")
+    h.drive_until(
+        lambda: (h.worker_sets("lo")
+                 and all(s.spec.replicas == 2 for s in h.worker_sets("lo"))),
+        "scheduler: victim restored to entitlement")
+    h.make_workers_ready("lo")
+    h.drive_until(lambda: h.launcher("lo") is not None,
+                  "scheduler: victim launcher recreated")
+    h.set_launcher_active("lo")
+    h.finish_launcher("lo")
+    h.drive_until(lambda: h.cond("lo", COND_SUCCEEDED) == "True",
+                  "scheduler: lo Succeeded")
+    if h.job("lo").status.restart_count:
+        raise ConvergenceError(
+            "scheduler leg: rebalancing counted gang restarts", seed)
+    for name in ("hi", "lo"):
+        leaked = h.teardown(name)
+        if leaked:
+            raise ConvergenceError(
+                f"scheduler leg: {name} leaked {leaked}", seed)
+    wedged = h.queue_wedged()
+    if wedged:
+        raise ConvergenceError(
+            f"scheduler leg: wedged workqueue keys: {wedged}", seed)
+    return {
+        "sched_preempts": 1,
+        "sched_grow_backs": 1,
+        "sched_admissions_lost": 0,
+        "sched_double_shrinks": 0,
+        "sched_restarts_burned": 0,
+        "sched_leaked": 0,
+    }
+
+
+def scheduler_thrash(seed: int = 0) -> Dict:
+    """The anti-thrash pin: with a cost floor far above any accrued
+    queue wait, the scheduler must REFUSE to preempt — the pending job
+    stays Queued, the victim keeps its chips, and the refusal is an
+    explicit sched_skip timeline record carrying the predicted cost vs
+    the reclaimable wait (the postmortem's evidence that the gate, not
+    an accident, held the action back)."""
+    h = ChaosHarness(seed=seed, config=ControllerConfig(
+        sched_pool_chips=8, sched_cooldown_floor_seconds=3600.0))
+    obs = JobObservatory(events_dir=tempfile.mkdtemp(prefix="sched-chaos-"),
+                         scrape_interval=0.0)
+    h.attach_observatory(obs)
+    sync = lambda n: h.controller.sync_handler(f"{h.ns}/{n}")  # noqa: E731
+    h.create_job("lo", tpus=8, priority=0, elastic=True, min_tpus=2)
+    sync("lo")
+    h.resync()
+    h.make_workers_ready("lo")
+    sync("lo")
+    h.set_launcher_active("lo")
+    h.resync()
+    sync("lo")
+    h.create_job("hi", tpus=4, priority=1)
+    for _ in range(4):
+        sync("hi")
+        sync("lo")
+    if h.job("lo").status.sched_tpus is not None:
+        raise ConvergenceError(
+            "thrash leg: the gate approved a preemption whose predicted "
+            "cost exceeds the reclaimable queue wait", seed)
+    if h.cond("hi", api.COND_QUEUED) != "True":
+        raise ConvergenceError(
+            "thrash leg: refused admission did not stay Queued", seed)
+    skips = [r for r in obs.merged_records("hi")
+             if r["event"] == "sched_skip"]
+    if not skips:
+        raise ConvergenceError(
+            "thrash leg: refusal left no sched_skip timeline record",
+            seed)
+    rec = skips[-1]
+    if not (rec.get("predicted_cost_seconds", 0)
+            > rec.get("reclaim_seconds", 0) + 1):
+        raise ConvergenceError(
+            f"thrash leg: sched_skip record does not show predicted "
+            f"cost above reclaimable wait: {rec}", seed)
+    return {"sched_skips_recorded": len(skips),
+            "sched_thrash_resizes": 0}
+
+
+def scheduler_migration(seed: int = 0,
+                        scrape_faults: Sequence = DEFAULT_SCRAPE_RULES,
+                        ) -> Dict:
+    """Degraded-rank migration: rank 0 hard-dark while rank 1's frontier
+    advances. The dark pod must be migrated AT MOST ONCE per degraded
+    window (the status marker survives replayed syncs) and counted as
+    migration_count — NEVER as a gang restart; the advancing remainder
+    must never be restarted."""
+    step = {"v": 5}
+
+    def fetch(url):
+        if url.endswith("/metrics"):
+            return f"tpu_worker_step {step['v']}\n"
+        raise IOError("no events endpoint in this universe")
+
+    h, obs, clock = _observed_harness(
+        seed, fetch, scrape_faults=scrape_faults,
+        config=ControllerConfig(worker_metrics_port=9100,
+                                sched_cooldown_floor_seconds=0.0))
+    name = "sched-migrate"
+    h.create_job(name, restart_policy="OnFailure")
+    sync = lambda: h.controller.sync_handler(f"{h.ns}/{name}")  # noqa: E731
+    sync()
+    h.resync()
+    h.make_workers_ready(name)
+    sync()
+    h.resync()
+    h.set_launcher_active(name)
+    h.resync()
+    sync()
+    h.resync()
+    for _ in range(8):
+        clock["now"] += 10
+        step["v"] += 1
+        sync()
+        h.resync()
+        job = h.job(name)
+        if job.status.restart_count:
+            raise ConvergenceError(
+                "migration leg: a partial partition with an advancing "
+                "frontier restarted the gang", seed)
+        if job.status.migration_count > 1:
+            raise ConvergenceError(
+                f"migration leg: {job.status.migration_count} migrations "
+                f"in one degraded window (at most one allowed)", seed)
+    job = h.job(name)
+    if job.status.migration_count != 1:
+        raise ConvergenceError(
+            f"migration leg: expected exactly one migration, got "
+            f"{job.status.migration_count}", seed)
+    if not job.status.migrated_window:
+        raise ConvergenceError(
+            "migration leg: migration landed without its window marker "
+            "(a replayed sync would migrate again)", seed)
+    migrations = [r for r in obs.merged_records(name)
+                  if r["event"] == "sched_migrate"]
+    if len(migrations) != 1:
+        raise ConvergenceError(
+            f"migration leg: expected one sched_migrate timeline record, "
+            f"got {len(migrations)}", seed)
+    return {"sched_migrations": 1,
+            "sched_migration_restarts": 0,
+            "sched_migrations_per_window_max": 1}
+
+
+def scheduler_soak(seed: int = 0, rules: Sequence = DEFAULT_RULES,
+                   crash_every_write: bool = True) -> Dict:
+    """All scheduler legs; one merged report (the soak report's
+    "scheduler" section)."""
+    report: Dict = {}
+    report.update(scheduler_rebalance(seed, rules, crash_every_write))
+    report.update(scheduler_thrash(seed))
+    report.update(scheduler_migration(seed))
+    return report
+
+
 def main(argv: Optional[Sequence[str]] = None) -> int:
     import argparse
     import logging
@@ -996,6 +1207,9 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     parser.add_argument("--no-data-plane", action="store_true",
                         help="control-plane soak only (skip scrape-fault, "
                              "serving-lease, and request-timeout legs)")
+    parser.add_argument("--no-scheduler", action="store_true",
+                        help="skip the fleet-scheduler legs (preempt-to-"
+                             "admit, grow-back, anti-thrash, migration)")
     opts = parser.parse_args(argv)
     rules = opts.rule if opts.rule is not None else DEFAULT_RULES
     scrape_rules = (opts.scrape_faults if opts.scrape_faults is not None
@@ -1003,6 +1217,10 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     try:
         report = soak(seed=opts.seed, lifecycles=opts.lifecycles,
                       rules=rules, crash_every_write=not opts.no_crash)
+        if not opts.no_scheduler:
+            report["scheduler"] = scheduler_soak(
+                seed=opts.seed, rules=rules,
+                crash_every_write=not opts.no_crash)
         if not opts.no_data_plane:
             report["data_plane"] = data_plane_soak(
                 seed=opts.seed, scrape_faults=scrape_rules)

@@ -60,6 +60,9 @@ from ..cluster.resources import (
     StatefulSetSpec,
 )
 from ..cluster.workqueue import RateLimitingQueue, meta_namespace_key, split_key
+from ..telemetry.events import (
+    SCHED_ADMIT, SCHED_GROW_BACK, SCHED_MIGRATE, SCHED_PREEMPT,
+    SCHED_QUEUE, SCHED_SKIP)
 from .packing import COND_PACKED, PackPlan, plan_packing, slices_used
 
 logger = logging.getLogger("tpujob-controller")
@@ -317,6 +320,21 @@ class ControllerConfig:
     # frontier creeps below this rate arms the lease like a frozen one.
     # None keeps the lease purely wall-clock.
     serving_rate_floor: Optional[float] = None
+    # fleet scheduler (controller/scheduler.py): treat every TPUJob as a
+    # claim against ONE slice pool of this many chips — jobs that don't
+    # fit are queued by spec.priority, and a higher-priority pending job
+    # may shrink a lower-priority elastic gang (status.sched_tpus) to
+    # get admitted. None disables admission/rebalancing entirely. The
+    # cooldown knobs are the anti-thrash brake, fed by the resize
+    # ledger like the decode autoscaler's.
+    sched_pool_chips: Optional[int] = None
+    sched_cooldown_floor_seconds: float = 60.0
+    sched_cooldown_multiplier: float = 4.0
+    # degraded-rank pod migration (independent of the pool): a
+    # persistent DegradedGang partition deletes the dark worker pod so
+    # the StatefulSet reschedules it — at most once per degraded
+    # window, counted as status.migration_count (never a gang restart)
+    sched_migration: bool = True
 
 
 @dataclass
@@ -631,6 +649,19 @@ class TPUJobController:
             if pack is not None and pack.k > 1:
                 job = self._note_pack_leader(job, pack)
 
+        # fleet scheduler (controller/scheduler.py): with a bounded slice
+        # pool (sched_pool_chips) every job passes admission BEFORE any
+        # resource is created; a held job parks on a Queued condition
+        # owning nothing. Terminal jobs still run the planning pass —
+        # the chips they free are what wakes queued beneficiaries and
+        # preempted victims (delegated by enqueue, never executed in a
+        # foreign sync).
+        if self.config.sched_pool_chips is not None:
+            job, held = self._sched_reconcile(job, key, terminal)
+            if held:
+                self.update_tpu_job_status(job, launcher, [])
+                return
+
         # gang restart (v1alpha2 RestartPolicy, common_types.go:131-156):
         # a failed launcher is recreated when the policy allows it and the
         # backoff budget isn't exhausted; workers stay up (kubelet restarts
@@ -754,6 +785,11 @@ class TPUJobController:
             # never restarted); genuine stalls stay with the progress
             # lease below
             job = self._check_degraded_gang(job)
+            # degraded-rank remainder (fleet scheduler): a partition
+            # that persists past the cost floor MIGRATES the dark pod
+            # (StatefulSet reschedules it) instead of watching forever —
+            # once per degraded window, never a gang restart
+            job = self._sched_migrate_reconcile(job, alloc, key)
             # SLO-driven decode autoscaling consumes the same scrape:
             # decisions land in STATUS (serving_decode_replicas); the
             # next sync materializes the new pool split through the
@@ -955,6 +991,357 @@ class TPUJobController:
             job, "Warning" if up else "Normal",
             "ServingScaleUp" if up else "ServingScaleDown",
             decision.reason)
+        return job
+
+    # ------------------------------------------------------------------
+    # fleet scheduler (controller/scheduler.py) — priority admission,
+    # preempt-to-admit / grow-back, degraded-rank migration
+    # ------------------------------------------------------------------
+
+    def _fleet_scheduler(self):
+        from .scheduler import FleetScheduler
+        return FleetScheduler(
+            pool_chips=self.config.sched_pool_chips or 0,
+            cooldown_floor_seconds=self.config.sched_cooldown_floor_seconds,
+            cooldown_multiplier=self.config.sched_cooldown_multiplier)
+
+    def _sched_chips(self, j: TPUJob, with_sched: bool) -> int:
+        """A job's chip claim against the fleet pool: the allocation its
+        spec + live status overrides produce. with_sched=False masks the
+        scheduler's own override — the ENTITLEMENT the gang returns to
+        on grow-back."""
+        import copy
+        jj = copy.deepcopy(j)
+        if not with_sched:
+            jj.status.sched_tpus = None
+        try:
+            alloc = self.allocate_processing_units(jj, False)
+        except ValueError:
+            return 0            # unallocatable spec claims nothing
+        if alloc.resource_type != RESOURCE_TPU:
+            return 0
+        return alloc.worker_replicas * alloc.units_per_worker
+
+    def _sched_shrink_ladder(self, j: TPUJob, current: int) -> tuple:
+        """Valid shrink targets for an elastic gang, DESCENDING: the v5e
+        ladder below the current entitlement, floored at spec.minTpus,
+        per-worker tiled (the _next_elastic_total rule, enumerated)."""
+        spec = j.spec
+        if not spec.elastic or spec.tpus is None:
+            return ()
+        per = (spec.tpus_per_worker
+               if spec.tpus_per_worker is not None
+               else self.config.tpus_per_worker)
+        floor = spec.min_tpus or 1
+        return tuple(
+            c for c in sorted(api.V5E_VALID_SLICE_CHIPS, reverse=True)
+            if floor <= c < current and (c < per or c % per == 0))
+
+    def _owns_worker_sets(self, j: TPUJob) -> bool:
+        return any(
+            is_controlled_by(sts.metadata, j.metadata)
+            and sts.metadata.labels.get(LABEL_GROUP) == j.metadata.name
+            for sts in self.statefulset_lister.list(j.metadata.namespace))
+
+    def _sched_view(self, j: TPUJob):
+        """One job's scheduler view, derived ONLY from status + spec (so
+        crash-replayed syncs re-derive it identically). Returns None for
+        jobs with no independent claim (packed non-leaders ride their
+        leader's gang)."""
+        from .scheduler import SchedJob, ledger_cost
+        st = j.status
+        packed = st.get_condition(COND_PACKED)
+        if (packed is not None and packed.status == "True"
+                and packed.reason == "PackedWithLeader"):
+            return None
+        qcond = st.get_condition(api.COND_QUEUED)
+        if qcond is not None:
+            pending = qcond.status == "True"
+        else:
+            # no admission verdict yet: a job that already owns its
+            # worker sets predates the scheduler (grandfathered in); a
+            # bare one is a new arrival awaiting admission
+            pending = not self._owns_worker_sets(j)
+        done = st.is_done()
+        chips = self._sched_chips(j, with_sched=False)
+        held = (0 if pending or done
+                else self._sched_chips(j, with_sched=True))
+        last_cost = None
+        if self.observatory is not None:
+            from ..telemetry.collector import resize_ledger
+            resizes = resize_ledger(
+                self.observatory.merged_records(j.metadata.name))
+            # 0.0 default → None: "no measured cost yet"; the policy
+            # substitutes its own floor (never zero — scheduler.ledger_cost)
+            last_cost = ledger_cost(resizes, 0.0) or None
+        beneficiary = None
+        pcond = st.get_condition(api.COND_PREEMPTED)
+        if pcond is not None and pcond.status == "True":
+            for tok in pcond.message.split():
+                if tok.startswith("for="):
+                    beneficiary = tok[4:].rstrip(";,")
+        return SchedJob(
+            name=f"{j.metadata.namespace}/{j.metadata.name}",
+            priority=j.spec.priority or 0,
+            created=j.metadata.creation_timestamp or 0.0,
+            chips=chips,
+            held_chips=held,
+            pending=pending,
+            done=done,
+            elastic=bool(j.spec.elastic),
+            shrink_ladder=self._sched_shrink_ladder(j, held or chips),
+            sched_tpus=st.sched_tpus,
+            sched_scaled_at=st.sched_scaled_at,
+            queued_since=(qcond.last_transition_time
+                          if pending and qcond is not None else None),
+            last_resize_seconds=last_cost,
+            preempt_beneficiary=beneficiary,
+        )
+
+    def _sched_reconcile(self, job: TPUJob, key: str,
+                         terminal: bool) -> Tuple[TPUJob, bool]:
+        """One fleet-planning pass from THIS job's sync. Every decision
+        is status-first and idempotent, so a controller killed at any
+        write boundary replays to the same fleet state:
+
+          admission     — this job's own Queued condition (held = owns
+                          nothing; admitted = reconcile proceeds);
+          preempt       — executed by the BENEFICIARY's sync as a guarded
+                          cross-job status write on the victim
+                          (_preempt_victim re-checks under conflict, so
+                          a replay can never double-shrink);
+          grow-back     — executed only by the VICTIM's own sync;
+          anything aimed at another job — that job is enqueued, its own
+                          sync re-plans and acts.
+
+        Returns (job, held)."""
+        plan_now = self.now()
+        fleet = []
+        me = None
+        for j in self.job_lister.list():
+            view = self._sched_view(j)
+            if view is None:
+                continue
+            fleet.append(view)
+            if view.name == key:
+                me = view
+        if me is None:
+            return job, False
+        plan = self._fleet_scheduler().plan(plan_now, fleet)
+        if plan.wake_after is not None and plan.wake_after > 0:
+            self.queue.add_after(key, plan.wake_after)
+
+        # explicit refusals: timeline evidence for the postmortem,
+        # recorded by the party the refusal protects/blocks
+        if self.observatory is not None:
+            for d in plan.skips:
+                party = d.beneficiary or d.victim
+                if party == key:
+                    self.observatory.note_sched(
+                        job.metadata.name, SCHED_SKIP,
+                        token=f"{d.victim}|{d.beneficiary}",
+                        reason=d.reason,
+                        predicted_cost_seconds=d.predicted_cost_seconds,
+                        reclaim_seconds=d.reclaim_seconds)
+
+        held = False
+        if not terminal and not me.done:
+            if me.pending:
+                via = next((v for n, v in plan.admit if n == key), None)
+                if via is not None:
+                    job = self._sched_admit(job, via)
+                else:
+                    why = next((w for n, w in plan.hold if n == key),
+                               "pool full")
+                    job = self._sched_hold(job, why)
+                    held = True
+            elif (job.status.get_condition(api.COND_QUEUED) is None
+                    and me.held_chips > 0):
+                # grandfathered pre-scheduler job: stamp the admission
+                # verdict so the fleet view stops depending on owned
+                # resources
+                job = self._sched_admit(job, "grandfathered")
+
+        act = plan.action
+        if act is not None:
+            if act.action == "preempt":
+                if act.beneficiary == key:
+                    self._preempt_victim(act)
+                    # the victim's informer event does not fan out to
+                    # this job — replan immediately with its freed chips
+                    self.queue.add(key)
+                else:
+                    self.queue.add(act.beneficiary)
+            elif act.action == "grow_back":
+                if act.victim == key:
+                    job = self._sched_grow_back(job, act)
+                else:
+                    self.queue.add(act.victim)
+        # pending jobs the plan would admit only act in their own sync;
+        # capacity releases (a job completing, a victim shrinking) would
+        # otherwise never reach them
+        for n, _ in plan.admit:
+            if n != key:
+                self.queue.add(n)
+        return job, held
+
+    def _sched_hold(self, job: TPUJob, reason: str) -> TPUJob:
+        cond = job.status.get_condition(api.COND_QUEUED)
+        if cond is not None and cond.status == "True":
+            return job          # already queued; keep the original anchor
+        msg = f"held by the fleet scheduler: {reason}"
+        job.status.set_condition(api.JobCondition(
+            api.COND_QUEUED, "True", "SchedQueued", msg))
+        job = self._update_status_apply(job)
+        self.recorder.event(job, "Normal", "SchedQueued", msg)
+        if self.observatory is not None:
+            fresh = job.status.get_condition(api.COND_QUEUED)
+            self.observatory.note_sched(
+                job.metadata.name, SCHED_QUEUE,
+                token=f"{fresh.last_transition_time}",
+                reason=reason, priority=job.spec.priority or 0)
+        return job
+
+    def _sched_admit(self, job: TPUJob, via: str) -> TPUJob:
+        cond = job.status.get_condition(api.COND_QUEUED)
+        if cond is not None and cond.status == "False":
+            return job
+        waited = (self.now() - cond.last_transition_time
+                  if cond is not None else 0.0)
+        msg = f"admitted via {via} after {waited:.0f}s queued"
+        job.status.set_condition(api.JobCondition(
+            api.COND_QUEUED, "False", "SchedAdmit", msg))
+        job = self._update_status_apply(job)
+        self.recorder.event(job, "Normal", "SchedAdmit", msg)
+        if self.observatory is not None:
+            self.observatory.note_sched(
+                job.metadata.name, SCHED_ADMIT,
+                token=f"{via}:{cond.last_transition_time if cond else 0}",
+                via=via, waited_seconds=round(waited, 3))
+        return job
+
+    def _preempt_victim(self, decision) -> None:
+        """Cross-job preemption write, the one scheduler action executed
+        outside the victim's own sync. Crash/conflict discipline: fresh
+        read → abort if ANY scheduler override is already live (zero
+        double-shrinks even against a concurrent replay) → single status
+        PUT carrying the override + Preempted condition; a 409 loops
+        back to the fresh read, re-checking the guard."""
+        ns, vname = split_key(decision.victim)
+        for _ in range(MAX_CONFLICT_RETRIES):
+            victim = self.api.try_get(api.KIND, ns, vname)
+            if victim is None or victim.status.sched_tpus is not None:
+                return
+            victim.status.sched_tpus = decision.to_chips
+            victim.status.sched_scaled_at = self.now()
+            msg = (f"shrunk {decision.from_chips} -> {decision.to_chips} "
+                   f"chips for={decision.beneficiary} (predicted resize "
+                   f"cost {decision.predicted_cost_seconds:.0f}s vs "
+                   f"queued wait {decision.reclaim_seconds:.0f}s)")
+            victim.status.set_condition(api.JobCondition(
+                api.COND_PREEMPTED, "True", "SchedPreempt", msg))
+            try:
+                self.api.update_status(victim)
+            except ConflictError:
+                self.sync_counters.record_requeue("conflict")
+                continue
+            self.recorder.event(victim, "Warning", "SchedPreempt", msg)
+            if self.observatory is not None:
+                self.observatory.note_sched(
+                    vname, SCHED_PREEMPT,
+                    token=f"{decision.beneficiary}:{decision.to_chips}",
+                    victim=decision.victim,
+                    beneficiary=decision.beneficiary,
+                    from_tpus=decision.from_chips,
+                    to_tpus=decision.to_chips,
+                    predicted_cost_seconds=decision.predicted_cost_seconds)
+            return
+
+    def _sched_grow_back(self, job: TPUJob, decision) -> TPUJob:
+        if job.status.sched_tpus is None:
+            return job          # a replayed sync already restored it
+        shrunk_at = job.status.sched_scaled_at
+        job.status.sched_tpus = None
+        job.status.sched_scaled_at = self.now()
+        msg = (f"restored to {decision.to_chips} chips after "
+               f"preemption at {decision.from_chips}")
+        job.status.set_condition(api.JobCondition(
+            api.COND_PREEMPTED, "False", "SchedGrowBack", msg))
+        job = self._update_status_apply(job)
+        self.recorder.event(job, "Normal", "SchedGrowBack", msg)
+        if self.observatory is not None:
+            self.observatory.note_sched(
+                job.metadata.name, SCHED_GROW_BACK,
+                token=f"{shrunk_at}",
+                from_tpus=decision.from_chips,
+                to_tpus=decision.to_chips)
+        return job
+
+    def _sched_migrate_reconcile(self, job: TPUJob,
+                                 alloc: AllocationResult,
+                                 key: str) -> TPUJob:
+        """Degraded-rank migration: a DegradedGang partition that
+        persists past the cost floor deletes the dark worker pod so the
+        StatefulSet reschedules it onto a healthy node. Crash-consistent
+        ordering mirrors _count_gang_restart: the status write (window
+        marker + migration_count) lands FIRST, then the idempotent pod
+        delete; a replayed sync sees its own marker, skips the count,
+        and re-attempts the delete ONLY while the same pod incarnation
+        still exists. At most one migration per degraded window — the
+        window id is the condition's transition time, which message-only
+        updates (rank-set changes) never bump."""
+        if not self.config.sched_migration or self.observatory is None:
+            return job
+        cond = job.status.get_condition(api.COND_DEGRADED_GANG)
+        if cond is None or cond.status != "True":
+            return job
+        dark, total = self.observatory.partition_state(job.metadata.name)
+        if not dark or len(dark) >= total:
+            return job
+        window = cond.last_transition_time or 0.0
+        rank = min(dark)
+        names = self.worker_pod_names(job, alloc)
+        if rank >= len(names):
+            return job
+        pod_name = names[rank]
+        pod = self.api.try_get("Pod", job.metadata.namespace, pod_name)
+        uid = pod.metadata.uid if pod is not None else pod_name
+        prefix = f"{window:.3f}:"
+        if (job.status.migrated_window or "").startswith(prefix):
+            # replay: the count landed; finish the delete, level-
+            # triggered, only against the SAME pod incarnation (a new
+            # uid means the StatefulSet already rescheduled it)
+            prev_uid = job.status.migrated_window.split(":", 1)[1]
+            if pod is not None and pod.metadata.uid == prev_uid:
+                self._delete_ignore_missing(
+                    "Pod", job.metadata.namespace, pod_name)
+            return job
+        now = self.now()
+        decision = self._fleet_scheduler().migration(
+            now, window_age=now - window, already_migrated=False)
+        if decision.action != "migrate":
+            if decision.wake_after is not None and decision.wake_after > 0:
+                self.queue.add_after(key, decision.wake_after)
+            self.observatory.note_sched(
+                job.metadata.name, SCHED_SKIP,
+                token=f"migrate:{prefix}{uid}", reason=decision.reason,
+                predicted_cost_seconds=decision.predicted_cost_seconds,
+                reclaim_seconds=decision.reclaim_seconds)
+            return job
+        job.status.migrated_window = f"{prefix}{uid}"
+        job.status.migration_count += 1
+        msg = (f"rank {rank} dark for {now - window:.0f}s; migrating pod "
+               f"{pod_name} (migration {job.status.migration_count}, "
+               f"distinct from gang restarts)")
+        job = self._update_status_apply(job)
+        self.recorder.event(job, "Warning", "SchedMigrate", msg)
+        self.observatory.note_sched(
+            job.metadata.name, SCHED_MIGRATE, token=f"{prefix}{uid}",
+            rank=rank, pod=pod_name,
+            migration_count=job.status.migration_count,
+            window_age_seconds=round(now - window, 3))
+        self._delete_ignore_missing("Pod", job.metadata.namespace,
+                                    pod_name)
         return job
 
     def _fail_invalid_spec(self, job: TPUJob, message: str,
@@ -1280,8 +1667,16 @@ class TPUJobController:
                 # the gang at this size (validation guarantees a valid
                 # ladder count and no elastic/serving/packing conflict)
                 total = spec.resize
-            elif spec.elastic and job.status.elastic_tpus is not None:
-                total = job.status.elastic_tpus
+            elif spec.elastic and (job.status.elastic_tpus is not None
+                                   or job.status.sched_tpus is not None):
+                # two independent status overrides may be live at once:
+                # the elastic shrink (capacity loss) and the scheduler
+                # preemption (priority rebalance). The gang runs at the
+                # SMALLER of the two — each owner clears only its own
+                # field, so releasing one never releases the other.
+                total = min(v for v in (job.status.elastic_tpus,
+                                        job.status.sched_tpus)
+                            if v is not None)
             per_worker = (
                 spec.tpus_per_worker
                 if spec.tpus_per_worker is not None

@@ -55,6 +55,19 @@ INCIDENTS = (
     ev.GANG_STUCK, ev.GANG_DEGRADED, ev.REQUEST_TIMEOUT,
 )
 
+#: fleet-scheduler decision kinds — rendered as their own section, with
+#: preempts paired against the resize ledger for predicted-vs-measured
+SCHED_EVENTS = (
+    ev.SCHED_QUEUE, ev.SCHED_PREEMPT, ev.SCHED_ADMIT,
+    ev.SCHED_GROW_BACK, ev.SCHED_SKIP, ev.SCHED_MIGRATE,
+)
+
+#: fields a sched_* record may carry that the report keeps verbatim
+_SCHED_FIELDS = ("victim", "beneficiary", "via", "reason", "priority",
+                 "from_tpus", "to_tpus", "rank", "pod", "migration_count",
+                 "waited_seconds", "window_age_seconds",
+                 "predicted_cost_seconds", "reclaim_seconds")
+
 _DETAIL_FIELDS = ("step", "from_step", "to_step", "last_observed_step",
                   "exit_code", "restart", "replicas", "num_slices", "tpus",
                   "workers", "k", "fault", "signal", "seconds", "leaves",
@@ -134,6 +147,9 @@ def summarize(records: Sequence[Dict]) -> Dict:
     # window (further opens update the rank set in place), the healed=True
     # record — or a terminal event — closes it
     degraded: List[Dict] = []
+    # fleet-scheduler decisions, paired with the resize ledger below so a
+    # preempt shows predicted vs MEASURED cost on one line
+    sched_actions: List[Dict] = []
     for rec in records:
         kind = rec.get("event")
         entry = {
@@ -186,7 +202,14 @@ def summarize(records: Sequence[Dict]) -> Dict:
             if opened["stop_check_every"] is not None:
                 latency["stop_check_every"] = opened["stop_check_every"]
             drain_latencies.append(latency)
-        if kind in MILESTONES:
+        if kind in SCHED_EVENTS:
+            action = {"t": entry["t"], "event": kind,
+                      "job": rec.get("job")}
+            for f in _SCHED_FIELDS:
+                if f in rec:
+                    action[f] = rec[f]
+            sched_actions.append(action)
+        elif kind in MILESTONES:
             # the duration of the phase this milestone CLOSES
             entry["phase_seconds"] = round(rec.get("ts", t0)
                                            - last_milestone_ts, 3)
@@ -210,6 +233,20 @@ def summarize(records: Sequence[Dict]) -> Dict:
         r["t"] = round(r.pop("ts") - t0, 3)
         r.pop("drain_start_ts", None)
         resizes.append(r)
+    # predicted vs measured: a preempt (or grow-back) decision is
+    # actuated as a gang resize, so its MEASURED cost is the
+    # total_seconds of the first completed resize-ledger entry at or
+    # after the decision — the number the scheduler's next ledger_cost()
+    # read will see. Unpaired actions (resize still in flight, or a
+    # controller-only sim with no worker records) stay predicted-only.
+    for action in sched_actions:
+        if action["event"] not in (ev.SCHED_PREEMPT, ev.SCHED_GROW_BACK):
+            continue
+        measured = next(
+            (r["total_seconds"] for r in resizes
+             if r["t"] >= action["t"] and "total_seconds" in r), None)
+        if measured is not None:
+            action["measured_cost_seconds"] = measured
     return {
         "records": len(records),
         "span_seconds": round(records[-1].get("ts", t0) - t0, 3),
@@ -222,9 +259,60 @@ def summarize(records: Sequence[Dict]) -> Dict:
         "stalls": stalls,
         "degraded": degraded,
         "resizes": resizes,
+        "scheduler_actions": sched_actions,
         "other_events": other,
         "ledger": goodput_ledger(records),
     }
+
+
+def _fmt_sched_action(a: Dict) -> str:
+    """One line per fleet-scheduler decision: who it hit, who it served,
+    and the cost arithmetic the scheduler gated it on — predicted from
+    the resize ledger at decision time, measured once the resize the
+    decision caused has completed."""
+    kind = a["event"]
+    job = a.get("job") or "?"
+    if kind == ev.SCHED_PREEMPT:
+        cost = f"predicted {_fmt_duration(float(a['predicted_cost_seconds']))}" \
+            if a.get("predicted_cost_seconds") is not None else "predicted ?"
+        if a.get("measured_cost_seconds") is not None:
+            cost += (f", measured "
+                     f"{_fmt_duration(float(a['measured_cost_seconds']))}")
+        else:
+            cost += ", measured pending"
+        return (f"preempt    victim {a.get('victim', job)} -> beneficiary "
+                f"{a.get('beneficiary', '?')}  "
+                f"{a.get('from_tpus', '?')} -> {a.get('to_tpus', '?')} tpus"
+                f"  ({cost})")
+    if kind == ev.SCHED_GROW_BACK:
+        measured = (f"  (measured "
+                    f"{_fmt_duration(float(a['measured_cost_seconds']))})"
+                    if a.get("measured_cost_seconds") is not None else "")
+        return (f"grow back  {job}  {a.get('from_tpus', '?')} -> "
+                f"{a.get('to_tpus', '?')} tpus{measured}")
+    if kind == ev.SCHED_SKIP:
+        cost = ""
+        if a.get("predicted_cost_seconds") is not None \
+                and a.get("reclaim_seconds") is not None:
+            cost = (f"  (predicted "
+                    f"{_fmt_duration(float(a['predicted_cost_seconds']))}"
+                    f" vs reclaimable "
+                    f"{_fmt_duration(float(a['reclaim_seconds']))})")
+        return f"skip       {job}: {a.get('reason', '?')}{cost}"
+    if kind == ev.SCHED_MIGRATE:
+        return (f"migrate    {job} rank {a.get('rank', '?')} pod "
+                f"{a.get('pod', '?')}  (migration "
+                f"#{a.get('migration_count', '?')}, window dark "
+                f"{_fmt_duration(float(a.get('window_age_seconds', 0.0)))})")
+    if kind == ev.SCHED_ADMIT:
+        waited = (f" after {_fmt_duration(float(a['waited_seconds']))} queued"
+                  if a.get("waited_seconds") is not None else "")
+        return f"admit      {job} via {a.get('via', '?')}{waited}"
+    if kind == ev.SCHED_QUEUE:
+        prio = (f" (priority {a['priority']})"
+                if a.get("priority") is not None else "")
+        return f"queue      {job}{prio}: {a.get('reason', '?')}"
+    return f"{kind}  {job}"
 
 
 def render(summary: Dict, out: TextIO) -> None:
@@ -313,6 +401,12 @@ def render(summary: Dict, out: TextIO) -> None:
             total = (f"  total {_fmt_duration(r['total_seconds'])}"
                      if "total_seconds" in r else "  (never resumed)")
             out.write(f"  resize at t={t:.3f}s{size}  [{phases}]{total}\n")
+
+    sched = summary.get("scheduler_actions") or []
+    if sched:
+        out.write("\nscheduler actions:\n")
+        for a in sched:
+            out.write(f"  {a['t']:>9.3f}s  {_fmt_sched_action(a)}\n")
 
     if summary["incidents"]:
         out.write("\nincidents:\n")

@@ -839,6 +839,24 @@ class JobObservatory:
         self.record(job, ev.GANG_RESIZE if gang else ev.JOB_RESIZED,
                     **fields)
 
+    def note_sched(self, job: str, event: str, token: str,
+                   **fields) -> None:
+        """Record one fleet-scheduler decision (a sched_* event kind),
+        idempotent per (event, token): the controller replays syncs
+        after every crash, and each decision's status write carries the
+        same token the replay re-derives — so the timeline shows each
+        preempt/grow-back/migration exactly once however many times the
+        sync re-runs. sched_skip is the exception (token "" = always
+        emit is wrong — skips also dedupe, the hysteresis would spam one
+        per sync otherwise)."""
+        view = self.view(job)
+        seen = view.setdefault("sched_tokens", set())
+        mark = (event, token)
+        if mark in seen:
+            return
+        seen.add(mark)
+        self.record(job, event, **fields)
+
     def note_terminal(self, job: str, succeeded: bool, **fields) -> None:
         view = self.view(job)
         if view["terminal"]:

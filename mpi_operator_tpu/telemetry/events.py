@@ -83,6 +83,31 @@ JOB_RESIZED = "resize"
 # elastic JOB_RESIZED shrink above. scripts/tier1.sh --elastic greps for
 # this literal.
 GANG_RESIZE = "gang_resize"
+# Fleet-scheduler decisions (controller/scheduler.py). Every record
+# carries the action's principals so the postmortem can explain WHY a
+# gang shrank: victim/beneficiary job names, chip targets, and the
+# ledger-predicted cost the gate charged.
+#   sched_queue    — a job was held at admission (pool full); carries
+#                    needed/free chips
+#   sched_preempt  — a low-priority elastic gang was shrunk to admit a
+#                    higher-priority job (victim=, beneficiary=,
+#                    from_tpus=, to_tpus=, predicted_cost_seconds=)
+#   sched_admit    — a queued job got in (beneficiary=, free chips,
+#                    via="capacity"|"preempt")
+#   sched_grow_back— a preempted gang was restored to full size
+#                    (victim=, to_tpus=)
+#   sched_skip     — the cost gate or hysteresis declined an otherwise
+#                    legal action (reason=, predicted_cost_seconds=,
+#                    reclaim_seconds=) — the anti-thrash evidence
+#   sched_migrate  — a DegradedGang dark pod was deleted so the
+#                    StatefulSet reschedules it (rank=, pod=,
+#                    migration_count=) — distinct from gang restarts
+SCHED_QUEUE = "sched_queue"
+SCHED_PREEMPT = "sched_preempt"
+SCHED_ADMIT = "sched_admit"
+SCHED_GROW_BACK = "sched_grow_back"
+SCHED_SKIP = "sched_skip"
+SCHED_MIGRATE = "sched_migrate"
 JOB_SUCCEEDED = "job_succeeded"
 JOB_FAILED = "job_failed"
 
@@ -299,4 +324,6 @@ __all__ = ["EventLog", "BoundEventLog", "read_events", "event_files",
            "JOB_CREATED", "GANG_RESTART", "GANG_STUCK", "GANG_DEGRADED",
            "PODS_READY", "FIRST_STEP_OBSERVED",
            "JOB_PACKED", "JOB_RESIZED", "GANG_RESIZE",
+           "SCHED_QUEUE", "SCHED_PREEMPT", "SCHED_ADMIT",
+           "SCHED_GROW_BACK", "SCHED_SKIP", "SCHED_MIGRATE",
            "FIRST_RESUME_STEP", "JOB_SUCCEEDED", "JOB_FAILED"]

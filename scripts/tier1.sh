@@ -41,6 +41,14 @@
 #   SIGTERM drain + resharding restore (TPU_RESHARD_RESTORE=1), gated
 #   on oracle loss parity, both gang_resize records in the merged
 #   timeline, the resize_seconds phase split, and nonzero goodput.
+#
+#   ./scripts/tier1.sh --sched runs the OUT-OF-PROCESS fleet-scheduler
+#   smoke: two competing jobs on a fake 4-device pool — the real
+#   FleetScheduler preempts the low-priority elastic gang 4 -> 2 to
+#   admit the high-priority job, grows it back after completion —
+#   gated on BOTH jobs' final losses being token-identical to solo
+#   oracles, the sched_* decision records in the merged timeline, and
+#   the postmortem rendering its "scheduler actions:" section.
 
 if [ "${1:-}" = "--serving" ]; then
   # Disagg A/B smoke via the benchmark CLI (examples/serve_benchmark.py
@@ -288,117 +296,156 @@ if [ "${1:-}" = "--resilience" ]; then
   exit 0
 fi
 
-#   ./scripts/tier1.sh --chaos runs the OUT-OF-PROCESS chaos soak: 25
-#   mixed job lifecycles (create/restart/resize/pack/serving/teardown)
-#   against seeded API fault injection (transient writes, status
-#   conflicts, stale reads, dropped watch events) with the controller
-#   killed at EVERY write boundary, gated on oracle convergence, zero
-#   leaked resources, and zero wedged workqueue keys — PLUS the
-#   data-plane legs: scrape faults (one rank hard-dark, the rest flaky)
-#   must produce a DegradedGang window and ZERO restarts; a wedged
-#   serving gang must be caught via the frozen token frontier within
+#   ./scripts/tier1.sh --chaos runs the OUT-OF-PROCESS chaos soak as a
+#   TWO-SEED matrix (the given seed, default 42, plus seed+1000 — two
+#   independent fault/kill schedules, so a schedule-shaped bug can't
+#   hide behind one lucky seed): 25 mixed job lifecycles
+#   (create/restart/resize/pack/serving/teardown) against seeded API
+#   fault injection (transient writes, status conflicts, stale reads,
+#   dropped watch events) with the controller killed at EVERY write
+#   boundary, gated on oracle convergence, zero leaked resources, and
+#   zero wedged workqueue keys — PLUS the data-plane legs: scrape
+#   faults (one rank hard-dark, the rest flaky) must produce a
+#   DegradedGang window and ZERO restarts; a wedged serving gang must
+#   be caught via the frozen token frontier within
 #   progressDeadlineSeconds; request timeouts must leak zero slots and
 #   zero KV pages; bursty (time-varying) scrape faults must neither trip
 #   nor disarm the serving lease; and a mid-trace replica kill behind
-#   the router must lose zero requests. Deterministic per seed; the
-#   reproducer seed is printed on failure (and a deliberately-failing
-#   run below proves it).
+#   the router must lose zero requests — PLUS the fleet-scheduler legs:
+#   the priority rebalance (preempt -> admit -> grow-back) must converge
+#   under crash-at-every-write with zero double-shrinks and zero lost
+#   admissions, the anti-thrash gate must record an explicit sched_skip
+#   instead of a resize, and the degraded-rank migration must fire at
+#   most ONCE per degraded window with zero gang restarts burned.
+#   Deterministic per seed; each seed's reproducer line is printed on
+#   failure (and a deliberately-failing run per seed proves it).
 
 if [ "${1:-}" = "--chaos" ]; then
   set -u
   dir=$(mktemp -d)
   trap 'rm -rf "$dir"' EXIT
   seed="${2:-42}"
-  echo "== chaos soak: 25 fault-injected, crash-interrupted lifecycles + data plane (seed $seed) =="
+  for s in "$seed" "$((seed + 1000))"; do
+  echo "== chaos soak: 25 fault-injected, crash-interrupted lifecycles + data plane + scheduler (seed $s) =="
   timeout -k 10 1200 env JAX_PLATFORMS=cpu \
     python -m mpi_operator_tpu.controller.chaos \
-    --seed "$seed" --lifecycles 25 \
-    > "$dir/chaos.json" 2> "$dir/chaos.log"
+    --seed "$s" --lifecycles 25 \
+    > "$dir/chaos-$s.json" 2> "$dir/chaos-$s.log"
   rc=$?
   if [ "$rc" -ne 0 ]; then
     echo "FAIL: chaos soak exited $rc (reproduce: python -m" \
-         "mpi_operator_tpu.controller.chaos --seed $seed --lifecycles 25)"
-    tail -30 "$dir/chaos.log"; cat "$dir/chaos.json" 2>/dev/null
+         "mpi_operator_tpu.controller.chaos --seed $s --lifecycles 25)"
+    tail -30 "$dir/chaos-$s.log"; cat "$dir/chaos-$s.json" 2>/dev/null
     exit 1
   fi
-  if ! grep -q '"completed": 25' "$dir/chaos.json"; then
-    echo "FAIL: soak did not complete all 25 lifecycles"
-    cat "$dir/chaos.json"; exit 1
+  if ! grep -q '"completed": 25' "$dir/chaos-$s.json"; then
+    echo "FAIL: seed $s soak did not complete all 25 lifecycles"
+    cat "$dir/chaos-$s.json"; exit 1
   fi
-  if grep -q '"crashes": 0,' "$dir/chaos.json"; then
-    echo "FAIL: zero injected crashes — the kill schedule never ran"
-    cat "$dir/chaos.json"; exit 1
+  if grep -q '"crashes": 0,' "$dir/chaos-$s.json"; then
+    echo "FAIL: seed $s: zero injected crashes — the kill schedule never ran"
+    cat "$dir/chaos-$s.json"; exit 1
   fi
-  if grep -q '"total_faults": 0' "$dir/chaos.json"; then
-    echo "FAIL: zero injected faults — the fault rules never fired"
-    cat "$dir/chaos.json"; exit 1
+  if grep -q '"total_faults": 0' "$dir/chaos-$s.json"; then
+    echo "FAIL: seed $s: zero injected faults — the fault rules never fired"
+    cat "$dir/chaos-$s.json"; exit 1
   fi
   # data-plane gates: the degraded window opened and healed with no
   # false-positive restart, the wedged serving gang was caught via the
   # token frontier, and request timeouts reclaimed every slot and page
-  if ! grep -q '"false_positive_restarts": 0' "$dir/chaos.json"; then
-    echo "FAIL: scrape flakiness restarted a gang (or the degraded leg never ran)"
-    cat "$dir/chaos.json"; exit 1
+  if ! grep -q '"false_positive_restarts": 0' "$dir/chaos-$s.json"; then
+    echo "FAIL: seed $s: scrape flakiness restarted a gang (or the degraded leg never ran)"
+    cat "$dir/chaos-$s.json"; exit 1
   fi
-  if grep -q '"degraded_windows": 0' "$dir/chaos.json" \
-      || ! grep -q '"degraded_windows":' "$dir/chaos.json"; then
-    echo "FAIL: no DegradedGang window under the partial partition"
-    cat "$dir/chaos.json"; exit 1
+  if grep -q '"degraded_windows": 0' "$dir/chaos-$s.json" \
+      || ! grep -q '"degraded_windows":' "$dir/chaos-$s.json"; then
+    echo "FAIL: seed $s: no DegradedGang window under the partial partition"
+    cat "$dir/chaos-$s.json"; exit 1
   fi
-  if grep -q '"scrape_faults_injected": 0' "$dir/chaos.json"; then
-    echo "FAIL: zero injected scrape faults — the data-plane rules never fired"
-    cat "$dir/chaos.json"; exit 1
+  if grep -q '"scrape_faults_injected": 0' "$dir/chaos-$s.json"; then
+    echo "FAIL: seed $s: zero injected scrape faults — the data-plane rules never fired"
+    cat "$dir/chaos-$s.json"; exit 1
   fi
-  if ! grep -q '"serving_stalls_detected": 1' "$dir/chaos.json"; then
-    echo "FAIL: wedged serving gang not detected via the token frontier"
-    cat "$dir/chaos.json"; exit 1
+  if ! grep -q '"serving_stalls_detected": 1' "$dir/chaos-$s.json"; then
+    echo "FAIL: seed $s: wedged serving gang not detected via the token frontier"
+    cat "$dir/chaos-$s.json"; exit 1
   fi
-  if ! grep -q '"leaked_pages": 0' "$dir/chaos.json" \
-      || ! grep -q '"leaked_slots": 0' "$dir/chaos.json"; then
-    echo "FAIL: request timeouts leaked slots or KV pages"
-    cat "$dir/chaos.json"; exit 1
+  if ! grep -q '"leaked_pages": 0' "$dir/chaos-$s.json" \
+      || ! grep -q '"leaked_slots": 0' "$dir/chaos-$s.json"; then
+    echo "FAIL: seed $s: request timeouts leaked slots or KV pages"
+    cat "$dir/chaos-$s.json"; exit 1
   fi
-  if grep -q '"request_timeouts": 0' "$dir/chaos.json" \
-      || ! grep -q '"request_timeouts":' "$dir/chaos.json"; then
-    echo "FAIL: the request-timeout leg retired nothing"
-    cat "$dir/chaos.json"; exit 1
+  if grep -q '"request_timeouts": 0' "$dir/chaos-$s.json" \
+      || ! grep -q '"request_timeouts":' "$dir/chaos-$s.json"; then
+    echo "FAIL: seed $s: the request-timeout leg retired nothing"
+    cat "$dir/chaos-$s.json"; exit 1
   fi
   # bursty scrape faults must oscillate without a false-positive restart
   # and still catch the real post-burst stall (lease re-armed)
-  if ! grep -q '"burst_false_positive_restarts": 0' "$dir/chaos.json" \
-      || ! grep -q '"burst_real_stall_detected": 1' "$dir/chaos.json"; then
-    echo "FAIL: the bursty-scrape leg tripped the lease (or never ran)"
-    cat "$dir/chaos.json"; exit 1
+  if ! grep -q '"burst_false_positive_restarts": 0' "$dir/chaos-$s.json" \
+      || ! grep -q '"burst_real_stall_detected": 1' "$dir/chaos-$s.json"; then
+    echo "FAIL: seed $s: the bursty-scrape leg tripped the lease (or never ran)"
+    cat "$dir/chaos-$s.json"; exit 1
   fi
   # the router must survive a mid-trace replica kill with zero lost
   # requests (resubmits to survivors, token-identical replays)
-  if ! grep -q '"router_failover_lost": 0' "$dir/chaos.json" \
-      || grep -q '"router_resubmitted": 0' "$dir/chaos.json"; then
-    echo "FAIL: the router-failover leg lost or never resubmitted requests"
-    cat "$dir/chaos.json"; exit 1
+  if ! grep -q '"router_failover_lost": 0' "$dir/chaos-$s.json" \
+      || grep -q '"router_resubmitted": 0' "$dir/chaos-$s.json"; then
+    echo "FAIL: seed $s: the router-failover leg lost or never resubmitted requests"
+    cat "$dir/chaos-$s.json"; exit 1
   fi
-  # failure discipline: a soak that DOES fail must print the reproducer
-  # seed. Every rank dark turns the degraded leg's partition total,
-  # which must trip its zero-false-positive assertion — expected exit 1
-  # with the seed named on stderr.
-  echo "== chaos soak: reproducer-seed discipline (deliberate failure) =="
+  # fleet-scheduler gates: the rebalance converged crash-consistently
+  # (no double-shrink, no lost admission, no leak), the anti-thrash
+  # cost gate recorded an explicit skip instead of a resize, and the
+  # degraded-rank migration fired exactly once per window with zero
+  # gang restarts burned
+  if ! grep -q '"sched_double_shrinks": 0' "$dir/chaos-$s.json" \
+      || ! grep -q '"sched_admissions_lost": 0' "$dir/chaos-$s.json" \
+      || ! grep -q '"sched_leaked": 0' "$dir/chaos-$s.json"; then
+    echo "FAIL: seed $s: the scheduler rebalance double-shrank, lost an admission, or leaked"
+    cat "$dir/chaos-$s.json"; exit 1
+  fi
+  if grep -q '"sched_preempts": 0' "$dir/chaos-$s.json" \
+      || grep -q '"sched_grow_backs": 0' "$dir/chaos-$s.json"; then
+    echo "FAIL: seed $s: the scheduler leg never preempted or never grew back"
+    cat "$dir/chaos-$s.json"; exit 1
+  fi
+  if grep -q '"sched_skips_recorded": 0' "$dir/chaos-$s.json" \
+      || ! grep -q '"sched_thrash_resizes": 0' "$dir/chaos-$s.json"; then
+    echo "FAIL: seed $s: the anti-thrash gate resized instead of recording sched_skip"
+    cat "$dir/chaos-$s.json"; exit 1
+  fi
+  if ! grep -q '"sched_migrations": 1' "$dir/chaos-$s.json" \
+      || ! grep -q '"sched_migrations_per_window_max": 1' "$dir/chaos-$s.json" \
+      || ! grep -q '"sched_migration_restarts": 0' "$dir/chaos-$s.json" \
+      || ! grep -q '"sched_restarts_burned": 0' "$dir/chaos-$s.json"; then
+    echo "FAIL: seed $s: degraded-rank migration missing, repeated, or burned a restart"
+    cat "$dir/chaos-$s.json"; exit 1
+  fi
+  # failure discipline: a soak that DOES fail must print THIS seed's
+  # reproducer. Every rank dark turns the degraded leg's partition
+  # total, which must trip its zero-false-positive assertion — expected
+  # exit 1 with the seed named on stderr.
+  echo "== chaos soak: reproducer-seed discipline (deliberate failure, seed $s) =="
   if timeout -k 10 300 env JAX_PLATFORMS=cpu \
       python -m mpi_operator_tpu.controller.chaos \
-      --seed "$seed" --lifecycles 0 --scrape-faults '*/fail=1' \
-      > "$dir/fail.json" 2> "$dir/fail.log"; then
-    echo "FAIL: all-ranks-dark soak was expected to fail and did not"
-    cat "$dir/fail.json"; exit 1
+      --seed "$s" --lifecycles 0 --scrape-faults '*/fail=1' \
+      > "$dir/fail-$s.json" 2> "$dir/fail-$s.log"; then
+    echo "FAIL: seed $s: all-ranks-dark soak was expected to fail and did not"
+    cat "$dir/fail-$s.json"; exit 1
   fi
-  if ! grep -q "CHAOS SOAK FAILED" "$dir/fail.log" \
-      || ! grep -q "seed=$seed" "$dir/fail.log" \
-      || ! grep -q "^reproduce: python -m mpi_operator_tpu.controller.chaos" "$dir/fail.log"; then
-    echo "FAIL: failing soak did not print the reproducer seed line"
-    cat "$dir/fail.log"; exit 1
+  if ! grep -q "CHAOS SOAK FAILED" "$dir/fail-$s.log" \
+      || ! grep -q "seed=$s" "$dir/fail-$s.log" \
+      || ! grep -q "^reproduce: python -m mpi_operator_tpu.controller.chaos" "$dir/fail-$s.log"; then
+    echo "FAIL: seed $s: failing soak did not print the reproducer seed line"
+    cat "$dir/fail-$s.log"; exit 1
   fi
-  echo "chaos soak: OK ($(grep -o '"crashes": [0-9]*' "$dir/chaos.json" | grep -o '[0-9]*') crashes," \
-       "$(grep -o '"total_faults": [0-9]*' "$dir/chaos.json" | grep -o '[0-9]*') API faults," \
-       "$(grep -o '"scrape_faults_injected": [0-9]*' "$dir/chaos.json" | grep -o '[0-9]*$') scrape faults;" \
-       "25 lifecycles converged, degraded window healed, serving stall caught, zero leaks)"
+  echo "chaos soak seed $s: OK ($(grep -o '"crashes": [0-9]*' "$dir/chaos-$s.json" | grep -o '[0-9]*') crashes," \
+       "$(grep -o '"total_faults": [0-9]*' "$dir/chaos-$s.json" | grep -o '[0-9]*') API faults," \
+       "$(grep -o '"scrape_faults_injected": [0-9]*' "$dir/chaos-$s.json" | grep -o '[0-9]*$') scrape faults," \
+       "$(grep -o '"sched_preempts": [0-9]*' "$dir/chaos-$s.json" | grep -o '[0-9]*$') preempts)"
+  done
+  echo "chaos soak: OK (2-seed matrix $seed + $((seed + 1000)): lifecycles converged, degraded windows healed, scheduler crash-consistent, zero leaks)"
   exit 0
 fi
 
@@ -465,6 +512,69 @@ if [ "${1:-}" = "--elastic" ]; then
     cat "$dir/postmortem.txt"; exit 1
   fi
   echo "elastic smoke: OK ($(grep -o '"resize_seconds": \[[^]]*\]' "$dir/elastic.json"); token-identical, goodput intact)"
+  exit 0
+fi
+
+if [ "${1:-}" = "--sched" ]; then
+  # Fleet-scheduler smoke (examples/sched_benchmark.py): two competing
+  # jobs on a fake 4-device pool, every decision made by the REAL
+  # FleetScheduler policy object — lo (priority 0, elastic, 4 devices)
+  # is preempted 4 -> 2 to admit hi (priority 1, 2 devices), hi runs
+  # solo to completion, lo grows back to 4 and finishes. The
+  # orchestrator itself gates phase exit codes, both plan decisions,
+  # 2 completed resizes, and solo-oracle loss parity for BOTH jobs;
+  # the greps below re-check the contracts from the artifacts.
+  set -u
+  dir=$(mktemp -d)
+  trap 'rm -rf "$dir"' EXIT
+  echo "== sched smoke: preempt-to-admit + grow-back on a 4-device pool =="
+  timeout -k 10 1200 env JAX_PLATFORMS=cpu \
+    python -m mpi_operator_tpu.examples.sched_benchmark \
+    --out-dir "$dir" > "$dir/sched.json" 2> "$dir/sched.log"
+  rc=$?
+  if [ "$rc" -ne 0 ]; then
+    echo "FAIL: sched benchmark exited $rc"
+    tail -30 "$dir/sched.log"; cat "$dir/sched.json" 2>/dev/null
+    exit 1
+  fi
+  # the scheduler may cost a job TIME, never data: both final losses
+  # must be token-identical to uninterrupted solo runs
+  if ! grep -q '"lo_token_identical": true' "$dir/sched.json"; then
+    echo "FAIL: preempted job's loss differs from its solo oracle"
+    cat "$dir/sched.json"; exit 1
+  fi
+  if ! grep -q '"hi_token_identical": true' "$dir/sched.json"; then
+    echo "FAIL: admitted job's loss differs from its solo oracle"
+    cat "$dir/sched.json"; exit 1
+  fi
+  if ! grep -q '"action": "preempt"' "$dir/sched.json" \
+      || ! grep -q '"action": "grow_back"' "$dir/sched.json"; then
+    echo "FAIL: the policy object did not decide preempt then grow_back"
+    cat "$dir/sched.json"; exit 1
+  fi
+  # the merged timeline carries the decision records (shrink + grow)
+  for evt in sched_queue sched_preempt sched_admit sched_grow_back; do
+    if ! grep -q "\"event\": \"$evt\"" "$dir/timeline.jsonl"; then
+      echo "FAIL: merged timeline is missing the $evt record"
+      cat "$dir/timeline.jsonl"; exit 1
+    fi
+  done
+  if [ "$(grep -c '"event": "gang_resize"' "$dir/timeline.jsonl")" -ne 2 ]; then
+    echo "FAIL: merged timeline does not carry both gang_resize records"
+    cat "$dir/timeline.jsonl"; exit 1
+  fi
+  # the postmortem tells the scheduler's story, with the preempt's
+  # predicted cost paired against the measured resize total
+  if ! grep -q 'scheduler actions:' "$dir/postmortem.txt"; then
+    echo "FAIL: postmortem does not render the scheduler-actions section"
+    cat "$dir/postmortem.txt"; exit 1
+  fi
+  if ! grep -q 'preempt .*victim .*beneficiary .*measured' "$dir/postmortem.txt" \
+      || ! grep -q 'grow back' "$dir/postmortem.txt"; then
+    echo "FAIL: postmortem scheduler section missing the preempt/grow-back lines"
+    cat "$dir/postmortem.txt"; exit 1
+  fi
+  echo "sched smoke: OK ($(grep -o '"resize_seconds": \[[^]]*\]' "$dir/sched.json"); both jobs token-identical, scheduler actions rendered)"
   exit 0
 fi
 

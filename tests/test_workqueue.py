@@ -164,6 +164,55 @@ def test_snapshot_reports_wedge_evidence():
 
 
 # ---------------------------------------------------------------------------
+# add_after coalescing: duplicate wake-ups collapse to the EARLIEST
+# deadline (the fleet scheduler arms a wake per skip decision every
+# sync — without coalescing each sync would stack another timer)
+# ---------------------------------------------------------------------------
+
+def test_add_after_duplicates_coalesce_to_earliest():
+    q = RateLimitingQueue()
+    q.add_after("ns/a", 0.2)
+    q.add_after("ns/a", 0.02)       # earlier deadline must win
+    before = time.monotonic()
+    assert q.get(timeout=1.0) == "ns/a"
+    assert time.monotonic() - before < 0.15
+    q.done("ns/a")
+    # ONE delivery total: the superseded 0.2s timer must not fire again
+    assert q.get(timeout=0.3) is None
+    assert len(q) == 0
+
+
+def test_add_after_later_deadline_is_a_noop():
+    q = RateLimitingQueue()
+    q.add_after("ns/a", 0.02)
+    q.add_after("ns/a", 30.0)       # must NOT push the wake out
+    before = time.monotonic()
+    assert q.get(timeout=1.0) == "ns/a"
+    assert time.monotonic() - before < 0.5
+    q.done("ns/a")
+    assert q.get(timeout=0.1) is None
+    assert len(q) == 0              # no ghost waiting entry left behind
+
+
+def test_add_after_waiting_len_and_snapshot_truthful():
+    q = RateLimitingQueue()
+    q.add_after("ns/a", 30.0)
+    q.add_after("ns/a", 60.0)
+    q.add_after("ns/b", 30.0)
+    # two keys waiting, however many timers were armed
+    assert len(q) == 2
+    snap = q.snapshot()
+    assert snap["waiting"] == ["ns/a", "ns/b"]
+    # re-arming one of them to (almost) now delivers it without
+    # disturbing the other key's pending wake
+    q.add_after("ns/a", 0.001)
+    assert q.get(timeout=0.5) == "ns/a"
+    q.done("ns/a")
+    assert len(q) == 1
+    assert q.snapshot()["waiting"] == ["ns/b"]
+
+
+# ---------------------------------------------------------------------------
 # key helpers
 # ---------------------------------------------------------------------------
 
