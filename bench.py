@@ -610,6 +610,29 @@ def main() -> None:
             shared_prefix_len=32 if args.smoke else 128,
             log=lambda s: print(s, file=sys.stderr)))
 
+    def serving_livescale_metrics():
+        # live decode-pool scaling A/B: the same seeded trace through a
+        # ±1 replica cycle done live (pre-warmed attach + graceful
+        # drain, no survivor pause) vs as a gang restart (drain +
+        # in-band fleet rebuild). ONE record carries p99 TTFT and
+        # throughput for both arms, the measured live_scale ledger
+        # totals vs the gang total, and the zero-drop / token-identity
+        # / compile-pin gates.
+        from mpi_operator_tpu.examples.serve_benchmark import (
+            run_livescale_benchmark)
+        return retry_infra_once(lambda: run_livescale_benchmark(
+            size="test" if args.smoke else None,
+            replicas=2,
+            slots=4 if args.smoke else 8,
+            num_requests=12 if args.smoke else 32,
+            prompt_grid=(16, 32) if args.smoke else (32, 64),
+            new_grid=(8, 16) if args.smoke else (32, 64),
+            chunk_buckets=(16, 64) if args.smoke else (32, 128),
+            dtype_name=args.dtype,
+            page_size=16 if args.smoke else 64,
+            shared_prefix_len=32 if args.smoke else 128,
+            log=lambda s: print(s, file=sys.stderr)))
+
     if args.workload == "serving":
         line = {
             "metric": "serving_tokens_per_sec",
@@ -634,6 +657,9 @@ def main() -> None:
         srm = serving_router_metrics()
         line.update(srm)
         emit_leg("serving_router", srm)
+        lsm = serving_livescale_metrics()
+        line.update(lsm)
+        emit_leg("serving_livescale", lsm)
         finish(line)
         return
     if args.workload == "generate":

@@ -413,6 +413,15 @@ class TPUJobStatus:
     # the ordinary template-hash restart. None = run at the spec size.
     serving_decode_replicas: Optional[int] = None
     serving_scaled_at: Optional[float] = None
+    # in-flight live decode-pool scale step (the surgical path: only the
+    # decode StatefulSet's replica count moves, no gang restart). The
+    # marker "decode:<old>-><new>" is written BEFORE the StatefulSet
+    # update — the migrated_window discipline — so a controller crash
+    # between the two replays cleanly: the replay re-derives the same
+    # marker string, the StatefulSet update is idempotent, and the
+    # live_scale timeline record dedupes on the marker as its token
+    # (collector.note_live_scale). Cleared once the step is recorded.
+    scaling_replica: Optional[str] = None
     # fleet scheduler (controller/scheduler.py): the chip count a
     # preempted elastic gang currently runs at (same status-override
     # discipline as elastic_tpus — the spec is never edited; the

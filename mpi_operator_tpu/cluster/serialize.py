@@ -324,6 +324,9 @@ def _tpujob_status_to_manifest(st: TPUJobStatus) -> dict:
         "restartCount": st.restart_count or None,
         "elasticTpus": st.elastic_tpus,
         "elasticSince": rfc3339(st.elastic_since),
+        "servingDecodeReplicas": st.serving_decode_replicas,
+        "servingScaledAt": rfc3339(st.serving_scaled_at),
+        "scalingReplica": st.scaling_replica,
         "schedTpus": st.sched_tpus,
         "schedScaledAt": rfc3339(st.sched_scaled_at),
         "migrationCount": st.migration_count or None,
@@ -356,6 +359,9 @@ def _tpujob_status_from_manifest(m: dict) -> TPUJobStatus:
         restart_count=int(m.get("restartCount", 0)),
         elastic_tpus=m.get("elasticTpus"),
         elastic_since=parse_time(m.get("elasticSince")),
+        serving_decode_replicas=m.get("servingDecodeReplicas"),
+        serving_scaled_at=parse_time(m.get("servingScaledAt")),
+        scaling_replica=m.get("scalingReplica"),
         sched_tpus=m.get("schedTpus"),
         sched_scaled_at=parse_time(m.get("schedScaledAt")),
         migration_count=int(m.get("migrationCount", 0)),

@@ -13,26 +13,32 @@ cluster I/O — so every decision path unit-tests without a controller.
 The controller glue (`TPUJobController._autoscale_reconcile`) feeds it
 observations, lands accepted targets in ``status.serving_decode_replicas``
 (the same status-override discipline as elastic_tpus: the user's spec is
-never edited), and lets the ordinary template-hash resize machinery
-materialize the new pool.
+never edited), and the next sync materializes the delta as a LIVE
+decode-pool step: a replica-count-only StatefulSet update behind the
+``scalingReplica`` status marker — survivors never pause, nothing
+recompiles, no gang restart (that path still exists, but only a USER
+edit of the serving spec takes it).
 
 Hysteresis has three independent brakes:
 
   * breach persistence — a p99 spike must hold for ``breach_seconds``
-    before a scale-up (one bad scrape never restarts a gang);
+    before a scale-up (one bad scrape never moves the fleet);
   * clear persistence — the fleet must run inside SLO for
     ``clear_seconds`` before a scale-down (reclaiming capacity is never
     urgent);
-  * resize-cost cooldown — after any decision, further decisions wait
-    ``cooldown_multiplier`` x the last measured gang-resize cost from
-    the resize ledger (``cooldown_floor_seconds`` until one has been
-    measured). A fleet whose resizes take 90s therefore scales at most
-    once per ~6 minutes by default — scaling can never thrash faster
-    than resizes actually complete.
+  * scale-cost cooldown — after any decision, further decisions wait
+    ``cooldown_multiplier`` x the last measured cost of the action kind
+    the scaler TAKES — the newest ``live_scale`` ledger entry, NOT the
+    newest entry of any kind (``cooldown_floor_seconds`` until one has
+    been measured). Pricing off the cheap action is the point of live
+    scaling's second-order win: a fleet whose live steps take ~2s can
+    react every ~2 minutes at the default floor, where pricing off a
+    stray 90s gang resize would have pinned it to ~6 minutes.
 
-Scaling steps ±1 replica per decision: each resize is a gang restart,
-so the cost of overshooting (another restart to walk back) dwarfs the
-cost of converging over two windows.
+Scaling steps ±1 replica per decision: even with cheap steps, the
+drain/warmup of overshooting (another step to walk back) costs more
+than converging over two windows — and the persistence windows are the
+real reaction-time floor anyway.
 """
 from __future__ import annotations
 
@@ -109,9 +115,10 @@ class DecodeAutoscaler:
 
     def cooldown_seconds(self,
                          last_resize_seconds: Optional[float]) -> float:
-        """The thrash brake: a multiple of the last MEASURED gang-resize
-        cost (drain + restore + recompile from the resize ledger), never
-        below the configured floor."""
+        """The thrash brake: a multiple of the last MEASURED cost of the
+        action this scaler takes — the newest ``live_scale`` entry's
+        drain + warmup from the resize ledger (the controller glue does
+        the kind filtering) — never below the configured floor."""
         slo = self.slo
         if last_resize_seconds is None:
             return slo.cooldown_floor_seconds

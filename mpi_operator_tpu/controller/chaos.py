@@ -50,20 +50,23 @@ from __future__ import annotations
 import json
 import random
 import tempfile
+import time
 from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 from ..api import types as api
 from ..api.types import (
     COND_RUNNING, COND_SUCCEEDED, Container, ObjectMeta,
-    PodTemplateSpec, ServingSpec, TPUJob, TPUJobSpec,
+    PodTemplateSpec, ServingSLO, ServingSpec, TPUJob, TPUJobSpec,
 )
 from ..cluster.apiserver import ApiError, InMemoryAPIServer
 from ..cluster.chaos import ControllerCrash, FaultingAPIServer
 from ..cluster.workqueue import RateLimitingQueue
+from ..telemetry import events as tev
 from ..telemetry.chaos import ScrapeFaultInjector, ScrapeFaultRule
-from ..telemetry.collector import JobObservatory
+from ..telemetry.collector import JobObservatory, resize_ledger
 from .controller import (
-    LAUNCHER_SUFFIX, ControllerConfig, TPUJobController,
+    ANNOTATION_TEMPLATE_HASH, LAUNCHER_SUFFIX, ControllerConfig,
+    TPUJobController,
 )
 from .packing import COND_PACKED
 
@@ -953,21 +956,260 @@ def data_plane_router_failover(seed: int = 0) -> Dict:
             "router_dead_replicas": 1}
 
 
+def data_plane_live_scale(seed: int = 0) -> Dict:
+    """Live decode-pool scaling, control plane, under the nastiest
+    schedule the marker protocol must survive: an SLO breach drives the
+    +1 decode step and a later clear drives the -1, under ``burst:``
+    scrape faults, with the controller KILLED at the scalingReplica
+    marker BOTH times — the marker status write has landed but the
+    StatefulSet update it guards has not. The replay must finish each
+    step as a LIVE step: decode replicas land, the launcher Job
+    survives untouched (same uid), both pools keep their template
+    hashes, restart_count stays 0, zero gang_resize ledger entries —
+    and exactly ONE live_scale record lands per marker token (the
+    note_live_scale dedupe: no double-attach on replay)."""
+    qd = {"v": 0.0}
+
+    def fetch(url):
+        if url.endswith("/metrics"):
+            return f"tpu_worker_queue_depth {qd['v']}\n"
+        raise IOError("no events endpoint in this universe")
+
+    # rank 0 always scrapes (the breach signal must persist through the
+    # storm); rank 1 goes hard-dark in bursts
+    h, obs, clock = _observed_harness(
+        seed, fetch, scrape_faults=("1/fail=1:burst:4/0.5",))
+    pin = lambda: setattr(h.controller, "now",  # noqa: E731
+                          lambda: clock["now"])
+    pin()
+    name = "dp-live-scale"
+    h.create_job(name, tpus=8, serving=ServingSpec(
+        prefill_replicas=1, decode_replicas=1,
+        slo=ServingSLO(queue_depth=4.0, breach_seconds=30.0,
+                       clear_seconds=30.0, cooldown_floor_seconds=0.0,
+                       max_decode_replicas=4)))
+    h.drive_until(lambda: len(h.worker_sets(name)) == 2,
+                  f"{name}: prefill+decode pools")
+    h.make_workers_ready(name)
+    h.drive_until(lambda: h.launcher(name) is not None,
+                  f"{name}: launcher")
+    h.set_launcher_active(name)
+    h.drive_until(lambda: h.cond(name, "Running") == "True",
+                  f"{name}: Running")
+    launcher_uid = h.launcher(name).metadata.uid
+    hashes_before = {
+        s.metadata.name: s.metadata.annotations[ANNOTATION_TEMPLATE_HASH]
+        for s in h.worker_sets(name)}
+
+    # kill the controller the instant it issues the decode StatefulSet
+    # update the marker guards (the marker write itself has landed)
+    crash = {"arm_replicas": None, "count": 0}
+    orig_update = h.api.update
+
+    def update_with_marker_crash(obj, **kw):
+        if (getattr(obj, "kind", None) == "StatefulSet"
+                and obj.metadata.name.endswith("-decode")
+                and crash["arm_replicas"] is not None
+                and obj.spec.replicas == crash["arm_replicas"]):
+            crash["arm_replicas"] = None
+            crash["count"] += 1
+            raise ControllerCrash(
+                f"injected: died at the scalingReplica marker "
+                f"(seed={seed})")
+        return orig_update(obj, **kw)
+
+    h.api.update = update_with_marker_crash
+
+    def sync_surviving_crash():
+        try:
+            h.controller.sync_handler(f"{h.ns}/{name}")
+        except ControllerCrash:
+            h.kill_controller()
+            h.attach_observatory(obs)
+            pin()
+        h.resync()
+
+    def decode_sts():
+        return next(s for s in h.worker_sets(name)
+                    if s.metadata.name.endswith("-decode"))
+
+    def step_to(replicas: int, label: str) -> None:
+        crash["arm_replicas"] = replicas
+        for _ in range(10):
+            clock["now"] += 15
+            sync_surviving_crash()
+            # the resized pool's pods come up (or go away) out-of-band;
+            # scrapes only track a ready fleet
+            h.make_workers_ready(name)
+            job = h.job(name)
+            if (decode_sts().spec.replicas == replicas
+                    and job.status.scaling_replica is None):
+                return
+        raise ConvergenceError(
+            f"live-scale leg: decode pool never reached {replicas} "
+            f"replicas with a clean marker ({label})", seed)
+
+    qd["v"] = 9.0                       # breach: queue_depth 9 > 4
+    step_to(2, "scale-out")
+    qd["v"] = 0.0                       # clear: back inside SLO
+    step_to(1, "scale-in")
+
+    if crash["count"] != 2:
+        raise ConvergenceError(
+            f"live-scale leg: expected a marker crash per step, got "
+            f"{crash['count']}", seed)
+    job = h.job(name)
+    if job.status.restart_count:
+        raise ConvergenceError(
+            "live-scale leg: a live scale step counted a gang restart",
+            seed)
+    if h.launcher(name).metadata.uid != launcher_uid:
+        raise ConvergenceError(
+            "live-scale leg: the launcher Job was recreated — a live "
+            "step cold-restarted the fleet", seed)
+    hashes_after = {
+        s.metadata.name: s.metadata.annotations[ANNOTATION_TEMPLATE_HASH]
+        for s in h.worker_sets(name)}
+    if hashes_after != hashes_before:
+        raise ConvergenceError(
+            f"live-scale leg: template hashes drifted across a "
+            f"replica-count-only step ({hashes_before} -> "
+            f"{hashes_after})", seed)
+    records = [r for r in obs.merged_records(name)
+               if r["event"] == tev.LIVE_SCALE]
+    tokens = [r.get("token") for r in records]
+    if len(records) != 2 or len(set(tokens)) != 2:
+        raise ConvergenceError(
+            f"live-scale leg: expected one deduped live_scale record "
+            f"per step, got tokens {tokens} (double-attach on replay?)",
+            seed)
+    ledger = resize_ledger(obs.merged_records(name))
+    gang = [r for r in ledger if r.get("kind") != tev.LIVE_SCALE]
+    if gang:
+        raise ConvergenceError(
+            f"live-scale leg: {len(gang)} gang_resize ledger entries "
+            f"from autoscaler-driven steps", seed)
+    faults = h.scrape_injector.fault_count() if h.scrape_injector else 0
+    if not faults:
+        raise ConvergenceError(
+            "live-scale leg: the burst schedule never injected — the "
+            "storm was not exercised", seed)
+    return {
+        "live_scale_out_replicas": 2,
+        "live_scale_in_replicas": decode_sts().spec.replicas,
+        "live_scale_ledger_records": len(records),
+        "live_scale_double_records": len(records) - len(set(tokens)),
+        "live_scale_gang_entries": len(gang),
+        "live_scale_marker_crashes": crash["count"],
+        "live_scale_burst_faults": faults,
+    }
+
+
+def data_plane_live_scale_engines(seed: int = 0) -> Dict:
+    """Live decode-pool scaling, data plane: a real-engine router runs
+    a trace through BOTH live steps — a pre-warmed attach (+1, warmed
+    out-of-band so the pin never lands on the trace clock) and a
+    graceful detach (-1, queued requests failed over to survivors,
+    residents finishing in place, pages/slots verified reclaimed).
+    Gates: zero lost, zero shed, every request's tokens
+    bitwise-identical to the single-engine greedy oracle, zero leaked
+    pages. Imports jax lazily like the router-failover leg."""
+    import jax
+    import jax.numpy as jnp
+    from flax.core import meta as flax_meta
+
+    from ..models import CausalLM, gpt2_config
+    from ..serve import (EngineConfig, Request, Router, RouterConfig,
+                         ServingEngine)
+
+    cfg = gpt2_config("test", attention="dense", dtype=jnp.float32,
+                      vocab_size=64, max_len=64)
+    model = CausalLM(cfg)
+    probe = jnp.zeros((1, 4), jnp.int32)
+    params = flax_meta.unbox(
+        model.init(jax.random.PRNGKey(seed), probe))["params"]
+
+    def mk():
+        return ServingEngine(model, params, EngineConfig(
+            slots=2, chunk_buckets=(4, 8), paged=True, page_size=8,
+            rng_seed=seed))
+
+    rng = random.Random(seed)
+    reqs = [Request(i, [1 + rng.randrange(60) for _ in range(4 + i % 5)],
+                    max_new_tokens=5, arrival=0.002 * i)
+            for i in range(8)]
+    oracle = {}
+    for r in reqs:
+        oracle[r.id] = mk().run(
+            [Request(r.id, r.prompt, r.max_new_tokens)])[r.id].tokens
+
+    # the +1 engine is built AND warmed out-of-band — that is live
+    # scaling's whole point; only the measured warmup cost rides along
+    newcomer = mk()
+    warm_t0 = time.perf_counter()
+    newcomer.run([Request(10_000, [1, 2, 3, 4], max_new_tokens=2)])
+    warmup = time.perf_counter() - warm_t0
+
+    router = Router([mk(), mk()], RouterConfig(max_inflight=8))
+    router.schedule_attach(0.004, newcomer, warmup_seconds=warmup)
+    router.schedule_detach(0.01, 0)
+    results = router.run([Request(r.id, r.prompt, r.max_new_tokens,
+                                  arrival=r.arrival) for r in reqs])
+    lost = [r.id for r in reqs if r.id not in results
+            or results[r.id].finish_reason == "shed"]
+    if lost:
+        raise ConvergenceError(
+            f"live-scale engine leg: requests {lost} lost across the "
+            f"scale steps", seed)
+    wrong = [r.id for r in reqs if results[r.id].tokens != oracle[r.id]]
+    if wrong:
+        raise ConvergenceError(
+            f"live-scale engine leg: tokens diverged from the greedy "
+            f"oracle for requests {wrong}", seed)
+    if router.detached_replicas() != [0] or router.dead_replicas():
+        raise ConvergenceError(
+            f"live-scale engine leg: expected a clean detach of replica "
+            f"0, got detached={router.detached_replicas()} "
+            f"dead={router.dead_replicas()}", seed)
+    actions = [e["action"] for e in router.live_scale_log]
+    if actions != ["attach", "detach"]:
+        raise ConvergenceError(
+            f"live-scale engine leg: expected [attach, detach] steps, "
+            f"got {actions}", seed)
+    leaked = 0
+    for rep in router.replicas:
+        alloc = rep.engine.page_allocator
+        alloc.check()
+        leaked += alloc.in_use
+    if leaked:
+        raise ConvergenceError(
+            f"live-scale engine leg: {leaked} KV pages still pinned "
+            f"after the trace", seed)
+    return {"live_scale_lost": 0,
+            "live_scale_shed": router.shed_count(),
+            "live_scale_token_mismatches": 0,
+            "live_scale_leaked_pages": leaked,
+            "live_scale_attaches": 1,
+            "live_scale_detaches": 1}
+
+
 def data_plane_soak(seed: int = 0,
                     scrape_faults: Sequence = DEFAULT_SCRAPE_RULES,
                     engine_leg: bool = True) -> Dict:
     """All data-plane legs; one merged report. `engine_leg=False` skips
-    the jax-importing request-timeout and router-failover legs (unit
-    tests cover them in-process; the out-of-process soak runs
-    everything)."""
+    the jax-importing request-timeout, router-failover, and live-scale
+    engine legs (unit tests cover them in-process; the out-of-process
+    soak runs everything)."""
     report: Dict = {}
     report.update(data_plane_degraded(seed, scrape_faults))
     report.update(data_plane_serving_lease(seed))
     report.update(data_plane_tpot_slope(seed))
     report.update(data_plane_scrape_bursts(seed))
+    report.update(data_plane_live_scale(seed))
     if engine_leg:
         report.update(data_plane_request_timeouts(seed))
         report.update(data_plane_router_failover(seed))
+        report.update(data_plane_live_scale_engines(seed))
     return report
 
 

@@ -22,7 +22,7 @@ from __future__ import annotations
 import logging
 import threading
 import time
-from dataclasses import dataclass, field
+from dataclasses import dataclass, field, replace
 from typing import Dict, List, Optional, Tuple
 
 from ..api import types as api
@@ -938,14 +938,18 @@ class TPUJobController:
 
         Policy lives in controller/autoscale.py (pure hysteresis);
         this glue feeds it the federated p99/queue observations from
-        the scrape the observatory just took, the resize-cost cooldown
-        from the ledger, and lands accepted targets in
+        the scrape the observatory just took, the live-scale-cost
+        cooldown from the ledger, and lands accepted targets in
         status.serving_decode_replicas — the elastic_tpus discipline:
         the user's spec is NEVER edited, and the next sync materializes
-        the new pool through the ordinary template-hash gang-restart
-        resize. Pending persistence/cooldown windows schedule their own
+        the delta as a LIVE decode-pool step (replica-count-only
+        StatefulSet update behind the scalingReplica marker — see
+        get_or_create_worker_statefulsets — never a gang restart, so
+        the cooldown prices the cheap action and reaction time stays
+        short). Pending persistence/cooldown windows schedule their own
         queue wake-ups so a quiet cluster still re-evaluates."""
         from ..telemetry.collector import resize_ledger
+        from ..telemetry.events import LIVE_SCALE as LIVE_SCALE_KIND
         from .autoscale import DecodeAutoscaler, SLOObservation
 
         if self.observatory is None:
@@ -968,10 +972,16 @@ class TPUJobController:
                 "tpu_worker_tpot_seconds", 0.99),
             queue_depth=fed.gauge_value("tpu_worker_queue_depth"))
         resizes = resize_ledger(self.observatory.merged_records(name))
-        # newest resize with a MEASURED total (a serving gang that never
-        # stepped after a resize leaves the phase fields partial)
+        # newest MEASURED cost of the action kind this scaler is about
+        # to take: decode deltas materialize as live_scale steps now, so
+        # only live_scale entries price the cooldown — the newest-of-any
+        # -kind read this replaces let one expensive gang resize (user
+        # spec edit, fleet scheduler) pin live-scale cooldowns to
+        # minutes for the rest of the run. No live entry yet → None →
+        # the autoscaler's cooldown floor (the conservative default).
         last_cost = next((r["total_seconds"] for r in reversed(resizes)
-                          if "total_seconds" in r), None)
+                          if "total_seconds" in r
+                          and r.get("kind") == LIVE_SCALE_KIND), None)
         current = (job.status.serving_decode_replicas
                    if job.status.serving_decode_replicas is not None
                    else job.spec.serving.decode_replicas)
@@ -1986,6 +1996,8 @@ class TPUJobController:
             else:
                 self._check_ownership(existing, job)
             changed = False
+            group_stale = False
+            old_replicas = existing.spec.replicas
             if existing.spec.replicas != per_group:            # ref :748-756
                 existing.spec.replicas = per_group
                 changed = True
@@ -2017,11 +2029,40 @@ class TPUJobController:
                         ANNOTATION_TEMPLATE_HASH) != _template_hash(
                         desired.spec.template):
                     stale_groups.append(existing)
+                    group_stale = True
+            # LIVE decode-pool scale: the decode group's replica count
+            # moved but its template did NOT (the env is rendered from
+            # the spec baseline — _template_alloc — so an autoscaler
+            # override delta lands here, a user spec edit goes the
+            # gang-restart path above). Ordinal add/remove under
+            # OnDelete+Parallel is restart-free: no pod deletion, no
+            # launcher teardown, survivors never pause. The status
+            # marker is written BEFORE the StatefulSet update (the
+            # migratedWindow discipline) so a crash between the two
+            # replays cleanly: same drift → same marker string → the
+            # replayed update is a no-op and the timeline record
+            # dedupes on the marker token.
+            live_scale = None
+            if (alloc.serving_pools is not None and slice_id == 1
+                    and old_replicas != per_group
+                    and old_replicas > 0 and per_group > 0
+                    and not group_stale):
+                marker = (f"decode:{old_replicas}->{per_group}"
+                          f"@{job.status.serving_scaled_at}")
+                live_scale = (old_replicas, per_group, marker)
+                if job.status.scaling_replica != marker:
+                    job.status.scaling_replica = marker
+                    fresh = self._update_status_apply(job)
+                    job.metadata.resource_version = \
+                        fresh.metadata.resource_version
+                    job.status = fresh.status
             if changed:
                 existing = self.api.update(existing)
                 if stale_groups and stale_groups[-1].metadata.name \
                         == existing.metadata.name:
                     stale_groups[-1] = existing     # carry the fresh RV
+            if live_scale is not None:
+                self._finish_live_scale(job, *live_scale)
             out.append(existing)
         # prune slice groups a numSlices change orphaned (their stale-
         # topology pods would keep matching the shared Service selector
@@ -2055,13 +2096,14 @@ class TPUJobController:
                     "worker topology changed; gang restarted on the new "
                     "template")
                 if self.observatory is not None:
-                    # spec.resize is the user steering the gang size, a
-                    # serving_decode_replicas override the autoscaler —
-                    # both land in the timeline as gang_resize (the
-                    # resize_seconds ledger keys off it; the autoscale
-                    # cooldown reads its own resize cost back from
-                    # there); every other template drift stays the
-                    # plain elastic resize event
+                    # spec.resize is the user steering the gang size —
+                    # it lands in the timeline as gang_resize (the
+                    # resize_seconds ledger keys off it). An autoscaler
+                    # decode override normally takes the LIVE path above
+                    # and never reaches here; it rides along only when a
+                    # user template edit forces a restart in the same
+                    # sync. Every other template drift stays the plain
+                    # elastic resize event
                     fields = {"replicas": alloc.worker_replicas,
                               "num_slices": alloc.num_slices}
                     if job.spec.resize is not None:
@@ -2084,7 +2126,48 @@ class TPUJobController:
                     job, "Warning", "TPUJobResizeRetry",
                     "worker topology changed but the gang pod deletion "
                     "failed; will retry on the next sync")
+        if job.status.scaling_replica is not None:
+            # crash-orphaned marker: the decode StatefulSet update landed
+            # in a sync that was killed before recording/clearing (the
+            # loop above saw no replica drift, so the live path never
+            # re-ran). Finish the step now — note_live_scale dedupes on
+            # the marker token if the record itself DID land.
+            marker = job.status.scaling_replica
+            body = marker.split("@", 1)[0]
+            old_s, _, new_s = body[len("decode:"):].partition("->")
+            try:
+                self._finish_live_scale(job, int(old_s), int(new_s), marker)
+            except ValueError:
+                # unparseable marker (manual status edit): just clear it
+                self._finish_live_scale(job, 0, 0, marker)
         return out, resized
+
+    def _finish_live_scale(self, job: TPUJob, old: int, new: int,
+                           marker: str) -> None:
+        """Record one completed live decode-pool step and clear its
+        status marker — the tail half of the marker-guarded sequence
+        (marker write → StatefulSet update → here). Idempotent: the
+        timeline record dedupes per marker token, and clearing an
+        already-clear marker is a no-op — so crash replays land each
+        step in the timeline exactly once."""
+        up = new > old
+        if self.observatory is not None and new != old:
+            self.observatory.note_live_scale(
+                job.metadata.name, token=marker,
+                action="attach" if up else "detach",
+                decode_replicas=new,
+                reason=f"decode pool {old}->{new} live")
+        if new != old:
+            self.recorder.event(
+                job, "Normal",
+                "ServingLiveScaleUp" if up else "ServingLiveScaleDown",
+                f"decode pool scaled {old}->{new} in place (ordinal "
+                f"{'add' if up else 'remove'}; no gang restart)")
+        if job.status.scaling_replica is not None:
+            job.status.scaling_replica = None
+            fresh = self._update_status_apply(job)
+            job.metadata.resource_version = fresh.metadata.resource_version
+            job.status = fresh.status
 
     # ------------------------------------------------------------------
     # resource constructors (ref newConfigMap etc. :849-1236)
@@ -2167,6 +2250,17 @@ class TPUJobController:
             # split it exactly
             data["serving-prefill-replicas"] = str(alloc.serving_pools[0])
             data["serving-decode-replicas"] = str(alloc.serving_pools[1])
+            # the LIVE per-pool host lists, split out explicitly. This —
+            # not the worker env — is the authoritative serving topology:
+            # the env lists are rendered from the spec BASELINE so a
+            # decode autoscale step never drifts the template hash, and
+            # this ConfigMap (updated in place, mounted at
+            # CONFIG_MOUNT_PATH) is the restart-free channel that carries
+            # each ±1 replica to the running fleet.
+            pre = alloc.serving_pools[0]
+            for key, pool in (("serving-prefill-hosts", hostnames[:pre]),
+                              ("serving-decode-hosts", hostnames[pre:])):
+                data[key] = "\n".join(pool) + ("\n" if pool else "")
         return ConfigMap(
             metadata=ObjectMeta(
                 name=job.metadata.name + CONFIG_SUFFIX,
@@ -2281,6 +2375,32 @@ class TPUJobController:
             env["TPU_LAUNCHER"] = "1"
         return env
 
+    def _template_alloc(self, job: TPUJob,
+                        alloc: AllocationResult) -> AllocationResult:
+        """The allocation the worker TEMPLATE is rendered from. For
+        serving jobs this is the USER'S spec baseline — the
+        status.serving_decode_replicas override is deliberately
+        excluded, so an autoscaler decode delta never drifts the
+        template hash (which would gang-restart the whole fleet to add
+        one replica: the cost the live-scale path exists to avoid).
+        The LIVE topology still reaches every worker: new_config_map is
+        rendered from the live allocation and updated in place
+        (get_or_create_config_map), and the ConfigMap is mounted at
+        CONFIG_MOUNT_PATH in each pod — the restart-free channel. A
+        USER edit of spec.serving still drifts the template and
+        restarts the gang onto the new partitioning, as before."""
+        if (alloc.serving_pools is None or job.spec.serving is None
+                or job.status.serving_decode_replicas is None):
+            return alloc
+        prefill = job.spec.serving.prefill_replicas
+        decode = job.spec.serving.decode_replicas
+        if alloc.serving_pools == (prefill, decode):
+            return alloc
+        workers = (prefill + decode if alloc.worker_replicas > 0
+                   else alloc.worker_replicas)
+        return replace(alloc, worker_replicas=workers,
+                       serving_pools=(prefill, decode))
+
     def _serving_env(self, job: TPUJob, alloc: AllocationResult,
                      role: Optional[str] = None) -> dict:
         """Disaggregated-serving env (spec.serving): BOTH pools (and the
@@ -2317,6 +2437,10 @@ class TPUJobController:
         Multi-slice: one call per slice — the group's StatefulSet carries
         the slice id env its pods derive their global rank from."""
         name = self.worker_group_names(job, alloc.num_slices)[slice_id]
+        # everything that rides the template (env, labels, selectors) is
+        # rendered from the BASELINE allocation: a live decode-pool step
+        # must move only spec.replicas, never the template hash
+        env_alloc = self._template_alloc(job, alloc)
         template = api.deepcopy_obj(job.spec.template)
         container = template.main_container()
         if alloc.units_per_worker > 0:
@@ -2324,15 +2448,18 @@ class TPUJobController:
             container.limits[alloc.resource_type] = alloc.units_per_worker
         container.env = {
             **container.env,
-            **self._discovery_env(job, alloc, is_launcher=False),
+            **self._discovery_env(job, env_alloc, is_launcher=False),
             **(pack.env() if pack is not None else {}),
         }
         if alloc.serving_pools is not None:
             # role identity + peer addresses in env: covered by the
-            # template hash (like pack.env()), so changing the pool split
-            # gang-restarts onto the new partitioning
+            # template hash (like pack.env()), so a USER edit of the pool
+            # split gang-restarts onto the new partitioning — while the
+            # autoscaler's status override is excluded (_template_alloc)
+            # and flows through the ConfigMap instead
             role = SERVE_ROLES[slice_id]
-            container.env.update(self._serving_env(job, alloc, role=role))
+            container.env.update(
+                self._serving_env(job, env_alloc, role=role))
             template.metadata.labels = {
                 **template.metadata.labels, LABEL_SERVE_ROLE: role}
         if alloc.num_slices > 1:
@@ -2414,7 +2541,7 @@ class TPUJobController:
                 # canonical shape exists
                 from ..api.validation import V5E_TOPOLOGIES
                 shapes = V5E_TOPOLOGIES.get(
-                    alloc.worker_replicas * alloc.units_per_worker)
+                    env_alloc.worker_replicas * env_alloc.units_per_worker)
                 topo = shapes[0] if shapes else None
             if topo:
                 template.node_selector[NS_TOPOLOGY] = topo

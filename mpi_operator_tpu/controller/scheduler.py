@@ -61,8 +61,17 @@ def ledger_cost(resizes: Sequence[Dict], default: float) -> float:
     Cost reads must degrade to the configured floor — never KeyError,
     and never treat the cost as zero (a zero cost would let the gate
     approve every action the moment a ledger entry is incomplete,
-    which is exactly when the fleet is least stable)."""
+    which is exactly when the fleet is least stable).
+
+    Only ``gang_resize`` entries count: scheduler actions (preempt,
+    grow-back, migration-adjacent shrink) all materialize as gang
+    restarts, so pricing them off a sub-second serving ``live_scale``
+    entry would wave every preemption through the cost gate the moment
+    a decode pool scaled once. Entries predating the kind field are
+    all gang."""
     for r in reversed(list(resizes)):
+        if r.get("kind", "gang_resize") != "gang_resize":
+            continue
         total = r.get("total_seconds")
         if total:
             return float(total)

@@ -784,6 +784,254 @@ def run_router_benchmark(
     return out
 
 
+def run_livescale_benchmark(
+    size: Optional[str] = None,
+    family: str = "gpt2",
+    replicas: int = 2,
+    slots: int = 4,
+    num_requests: int = 12,
+    prompt_grid: Sequence[int] = (16, 32),
+    new_grid: Sequence[int] = (8, 16),
+    chunk_buckets: Tuple[int, ...] = (16, 64),
+    dtype_name: str = "bfloat16",
+    decode_kernel: Optional[bool] = None,
+    page_size: int = 16,
+    num_pages: Optional[int] = None,
+    shared_prefix_len: int = 32,
+    num_tenants: int = 4,
+    max_inflight: int = 8,
+    arrival_gap: float = 0.15,
+    scale_up_at: float = 0.3,
+    scale_down_at: float = 0.8,
+    seed: int = 0,
+    log: Callable[[str], None] = print,
+) -> Dict[str, object]:
+    """Live decode-pool scaling vs gang restart: the SAME seeded trace
+    through a ±1 replica cycle both ways.
+
+    LIVE arm: a `replicas`-wide fleet takes one +1 step (a pre-warmed
+    engine attaches at `scale_up_at`; build + warmup happen OUT of the
+    trace clock — production prewarns out of band, which is live
+    scaling's whole point) and one -1 step (replica 0 gracefully drains
+    at `scale_down_at`: queued requests fail over to survivors,
+    residents finish in place, pages/slots verified reclaimed). No
+    survivor pauses, nothing recompiles.
+
+    GANG arm: the same decision at `scale_up_at` materialized the old
+    way — admission closes, in-flight work drains, then the WHOLE fleet
+    is torn down and rebuilt one replica wider with construction,
+    compile, and warmup all in-band; arrivals during the outage queue at
+    a dead front door.
+
+    Gates folded into the JSON record (the tier1 --router greps): zero
+    dropped/shed requests in the live arm, every request's tokens
+    bitwise-identical to the single-engine greedy oracle in BOTH arms
+    (drained-replica failovers included — greedy replay is
+    engine-independent), survivor compile pins untouched, and the
+    measured live_scale ledger totals (through the REAL resize_ledger
+    reader) strictly below the same trace's gang-restart total — the
+    number the autoscaler's cooldown prices."""
+    import time
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from ..models import create_lm
+    from ..parallel import MeshConfig, make_mesh
+    from ..parallel.sharding import shard_init
+    from ..serve import EngineConfig, Request, Router, RouterConfig, \
+        ServingEngine
+    from ..telemetry.collector import resize_ledger
+    from ..telemetry.events import LIVE_SCALE
+
+    dtype = jnp.bfloat16 if dtype_name == "bfloat16" else jnp.float32
+    if decode_kernel is None:
+        decode_kernel = jax.default_backend() == "tpu"
+    need = shared_prefix_len + max(prompt_grid) + max(new_grid)
+    max_len = need if need <= 128 else -(-need // 128) * 128
+    if max_len % page_size:
+        max_len = -(-max_len // page_size) * page_size
+    name = f"{family}-{size}" if size else family
+    model = create_lm(name, dtype=dtype, decode_kernel=decode_kernel,
+                      max_len=max_len)
+    mesh = make_mesh(MeshConfig(dp=jax.device_count()))
+    variables, _ = shard_init(
+        model, mesh, jax.random.PRNGKey(0),
+        jnp.zeros((1, min(prompt_grid)), jnp.int32))
+    params = variables["params"]
+
+    vocab = model.config.vocab_size
+    rs = np.random.RandomState(seed)
+    tenants = [rs.randint(0, vocab, (shared_prefix_len,)).tolist()
+               for _ in range(num_tenants)]
+
+    def make_request(i, arrival):
+        p, n = int(rs.choice(prompt_grid)), int(rs.choice(new_grid))
+        prefix = tenants[i % num_tenants]
+        return Request(
+            id=i, prompt=prefix + rs.randint(0, vocab, (p,)).tolist(),
+            max_new_tokens=n, arrival=arrival)
+
+    trace = [make_request(i, i * arrival_gap) for i in range(num_requests)]
+    assert all(r.temperature == 0.0 for r in trace)
+
+    warm = [Request(10_000 + j,
+                    rs.randint(0, vocab, (shared_prefix_len + p,)).tolist(),
+                    2)
+            for j, p in enumerate(sorted(set(int(v) for v in prompt_grid)))]
+
+    def mk_engine():
+        e = ServingEngine(model, params, EngineConfig(
+            slots=slots, chunk_buckets=tuple(chunk_buckets),
+            decode_kernel=decode_kernel, rng_seed=seed,
+            paged=True, page_size=page_size, num_pages=num_pages))
+        e.run([Request(w.id, list(w.prompt), w.max_new_tokens)
+               for w in warm])
+        e.reset()
+        return e
+
+    def fresh_trace(reqs):
+        return [Request(r.id, list(r.prompt), r.max_new_tokens,
+                        arrival=r.arrival) for r in reqs]
+
+    oracle_engine = mk_engine()
+    oracle = {rid: res.tokens for rid, res in oracle_engine.run(
+        [Request(r.id, list(r.prompt), r.max_new_tokens)
+         for r in trace]).items()}
+
+    def pins_held(router):
+        return all(
+            rep.engine.compile_counts()["step"] <= 3
+            and rep.engine.compile_counts()["prefill"] <= len(chunk_buckets)
+            for rep in router.replicas)
+
+    cfg = RouterConfig(max_inflight=max_inflight)
+
+    # -- LIVE arm: ±1 mid-trace, fleet never pauses -----------------------
+    # the +1 engine is built and warmed OUT of the trace clock; only the
+    # measured cost rides into the ledger as the step's warmup phase
+    warm_t0 = time.perf_counter()
+    newcomer = mk_engine()
+    attach_warmup = time.perf_counter() - warm_t0
+    live_router = Router([mk_engine() for _ in range(replicas)], cfg)
+    live_router.schedule_attach(scale_up_at, newcomer,
+                                warmup_seconds=attach_warmup)
+    live_router.schedule_detach(scale_down_at, 0)
+    t0 = time.perf_counter()
+    live_results = live_router.run(fresh_trace(trace))
+    live_wall = time.perf_counter() - t0
+
+    live_dropped = [r.id for r in trace if r.id not in live_results
+                    or live_results[r.id].finish_reason == "shed"]
+    live_identical = not live_dropped and all(
+        live_results[r.id].tokens == oracle[r.id] for r in trace)
+    live_ttfts = [res.ttft for res in live_results.values()
+                  if res.ttft >= 0.0]
+    live_tokens = sum(len(r.tokens) for r in live_results.values())
+
+    # the live steps through the REAL ledger reader (collector.py):
+    # each live_scale record is self-contained, total = drain + warmup
+    live_entries = resize_ledger(
+        [{"event": LIVE_SCALE, "ts": e["ts"], "action": e["action"],
+          "drain_seconds": e["drain_seconds"],
+          "warmup_seconds": e["warmup_seconds"]}
+         for e in live_router.live_scale_log])
+    live_totals = [e["total_seconds"] for e in live_entries]
+
+    # -- GANG arm: the same +1 decision, materialized as a restart --------
+    gang_results: Dict[int, object] = {}
+    pre = [r for r in trace if r.arrival <= scale_up_at]
+    post = [r for r in trace if r.arrival > scale_up_at]
+    gang_a = Router([mk_engine() for _ in range(replicas)], cfg)
+    g0 = time.perf_counter()
+    gang_results.update(gang_a.run(fresh_trace(pre)))
+    drain_done = time.perf_counter()
+    # the restart window: every engine rebuilt from scratch IN-BAND —
+    # this is the outage the live arm exists to delete
+    gang_b_engines = [mk_engine() for _ in range(replicas + 1)]
+    restart_done = time.perf_counter()
+    gang_shift = restart_done - g0
+    gang_b = Router(gang_b_engines, cfg)
+    gang_results.update(gang_b.run(
+        [Request(r.id, list(r.prompt), r.max_new_tokens,
+                 arrival=max(0.0, r.arrival - gang_shift))
+         for r in post]))
+    gang_wall = time.perf_counter() - g0
+    gang_drain = max(0.0, (drain_done - g0) - scale_up_at)
+    gang_restore = restart_done - drain_done
+    gang_total = gang_drain + gang_restore
+
+    gang_dropped = [r.id for r in trace if r.id not in gang_results
+                    or gang_results[r.id].finish_reason == "shed"]
+    gang_identical = not gang_dropped and all(
+        gang_results[r.id].tokens == oracle[r.id] for r in trace)
+    # phase-2 TTFTs re-anchored to the ORIGINAL arrival timeline: the
+    # queueing a request did at the dead front door is real latency
+    gang_ttfts = [gang_results[r.id].ttft for r in pre
+                  if gang_results[r.id].ttft >= 0.0]
+    for r in post:
+        res = gang_results[r.id]
+        if res.token_times:
+            gang_ttfts.append(
+                (gang_shift + res.token_times[0]) - r.arrival)
+    gang_tokens = sum(len(r.tokens) for r in gang_results.values())
+
+    ledger_ok = bool(live_totals) and max(live_totals) < gang_total
+    ms = lambda v: round(v * 1e3, 3) if v is not None else None  # noqa: E731
+
+    out: Dict[str, object] = {
+        "livescale_replicas_start": replicas,
+        "livescale_requests": num_requests,
+        "livescale_slots": slots,
+        "livescale_page_size": page_size,
+        "livescale_scale_up_at": scale_up_at,
+        "livescale_scale_down_at": scale_down_at,
+        "livescale_attaches": sum(1 for e in live_router.live_scale_log
+                                  if e["action"] == "attach"),
+        "livescale_detaches": sum(1 for e in live_router.live_scale_log
+                                  if e["action"] == "detach"),
+        "livescale_detached_replicas": live_router.detached_replicas(),
+        "livescale_dropped": len(live_dropped),
+        "livescale_sheds": live_router.shed_count(),
+        "livescale_token_identical": bool(live_identical),
+        "livescale_tokens_per_sec": round(live_tokens / live_wall, 1),
+        "livescale_wall_seconds": round(live_wall, 3),
+        "livescale_ttft_p99_ms": ms(_percentiles(live_ttfts)[99]),
+        "livescale_attach_warmup_seconds": round(attach_warmup, 3),
+        "livescale_detach_drain_seconds": round(
+            next((e["drain_seconds"] for e in live_router.live_scale_log
+                  if e["action"] == "detach"), 0.0), 3),
+        "livescale_ledger_total_seconds": round(max(live_totals), 3)
+                                          if live_totals else None,
+        "livescale_compile_pins_held": bool(pins_held(live_router)),
+        "livescale_gang_dropped": len(gang_dropped),
+        "livescale_gang_token_identical": bool(gang_identical),
+        "livescale_gang_tokens_per_sec": round(gang_tokens / gang_wall, 1),
+        "livescale_gang_wall_seconds": round(gang_wall, 3),
+        "livescale_gang_ttft_p99_ms": ms(_percentiles(gang_ttfts)[99]),
+        "livescale_gang_stall_seconds": round(gang_restore, 3),
+        "livescale_gang_total_seconds": round(gang_total, 3),
+        "livescale_ledger_vs_gang_ok": ledger_ok,
+        "livescale_lost_throughput_pct": round(
+            100.0 * (1.0 - (live_wall / gang_wall)), 1)
+            if gang_wall else None,
+    }
+    log(f"livescale {name}: {num_requests} reqs, +1@{scale_up_at}s / "
+        f"-1@{scale_down_at}s: live TTFT p99 "
+        f"{out['livescale_ttft_p99_ms']} ms vs gang "
+        f"{out['livescale_gang_ttft_p99_ms']} ms; "
+        f"{out['livescale_tokens_per_sec']} vs "
+        f"{out['livescale_gang_tokens_per_sec']} tokens/sec; ledger "
+        f"{out['livescale_ledger_total_seconds']}s live vs "
+        f"{out['livescale_gang_total_seconds']}s gang (ok={ledger_ok}); "
+        f"dropped={out['livescale_dropped']}, "
+        f"sheds={out['livescale_sheds']}, "
+        f"token-identical={live_identical}/{gang_identical}, "
+        f"pins={out['livescale_compile_pins_held']}")
+    return out
+
+
 def main(argv=None) -> int:
     import argparse
     import json
@@ -819,6 +1067,20 @@ def main(argv=None) -> int:
                              "identity vs the single-engine oracle, "
                              "hit-rate gain, and per-replica compile "
                              "pins")
+    parser.add_argument("--livescale", action="store_true",
+                        help="live decode-pool scaling A/B: the same "
+                             "trace through a ±1 replica cycle done "
+                             "live (attach pre-warmed / graceful drain, "
+                             "no survivor pause) vs as a gang restart "
+                             "(drain, rebuild the whole fleet in-band); "
+                             "gates zero drops, token identity both "
+                             "arms, and live ledger total < gang total")
+    parser.add_argument("--scale-up-at", type=float, default=0.3,
+                        help="trace time of the +1 attach step "
+                             "(--livescale)")
+    parser.add_argument("--scale-down-at", type=float, default=0.8,
+                        help="trace time of the -1 drain step "
+                             "(--livescale)")
     parser.add_argument("--replicas", type=int, default=2,
                         help="engine replicas behind the router")
     parser.add_argument("--max-inflight", type=int, default=8,
@@ -856,6 +1118,20 @@ def main(argv=None) -> int:
                         help="serve live engine telemetry at "
                              "/metrics on this port (0 = any free port)")
     args = parser.parse_args(argv)
+    if args.livescale:
+        metrics = run_livescale_benchmark(
+            size=args.size, family=args.family, replicas=args.replicas,
+            slots=args.slots, num_requests=args.num_requests,
+            dtype_name=args.dtype, page_size=args.page_size,
+            num_pages=args.num_pages,
+            shared_prefix_len=args.shared_prefix_len or 32,
+            max_inflight=args.max_inflight,
+            scale_up_at=args.scale_up_at,
+            scale_down_at=args.scale_down_at, seed=args.seed)
+        print(json.dumps({"metric": "livescale_tokens_per_sec",
+                          "value": metrics["livescale_tokens_per_sec"],
+                          "unit": "tokens/sec", **metrics}))
+        return 0
     if args.router:
         metrics = run_router_benchmark(
             size=args.size, family=args.family, replicas=args.replicas,

@@ -548,6 +548,9 @@ class ServingEngine:
         self.cache = self._init_cache(self.params)
         self._prev_tok = self._zeros_tok(S)
         self._session = None   # open steppable session (start()/finish())
+        # push-based load reporting (set_heartbeat): (hook, interval)
+        self._heartbeat = None
+        self._heartbeat_last: Optional[float] = None
         # high-water marks over a run(): the capacity story in one pair
         # of numbers (paged mode sustains more slots than contiguous at
         # equal cache bytes exactly when pages_in_use_peak stays under
@@ -1086,6 +1089,37 @@ class ServingEngine:
         self._session = {"results": {}, "pending": None,
                          "on_token": on_token, "now_fn": now_fn}
 
+    def set_heartbeat(self, hook: Callable[..., None],
+                      interval: float) -> None:
+        """Install a push-based load reporter: at most once per
+        `interval` seconds of session time, tick() calls
+        ``hook(now=..., queue_depth=..., free_slots=..., free_pages=...)``
+        with this replica's instantaneous load. The router wires the
+        hook into RouterTelemetry so dispatch can score replicas off
+        published reports instead of probing engine state in-process —
+        the shape a cross-host router actually has to live with."""
+        if interval <= 0:
+            raise ValueError(f"heartbeat interval must be > 0, got "
+                             f"{interval}")
+        self._heartbeat = (hook, float(interval))
+        self._heartbeat_last = None
+
+    def _maybe_heartbeat(self, now: float) -> None:
+        """Rate-limited publish (see set_heartbeat); no-op when no
+        reporter is installed."""
+        if self._heartbeat is None:
+            return
+        hook, interval = self._heartbeat
+        last = self._heartbeat_last
+        if last is not None and now - last < interval:
+            return
+        self._heartbeat_last = now
+        alloc = self.page_allocator
+        hook(now=now,
+             queue_depth=len(self.scheduler.queue),
+             free_slots=len(self.slots.free),
+             free_pages=alloc.available if alloc is not None else 0)
+
     def submit(self, req: Request) -> None:
         """Queue one request into the open session (front-door entry
         point). Raises ValueError for spans the engine can NEVER
@@ -1152,6 +1186,9 @@ class ServingEngine:
             if alloc is not None:
                 tel.pages_in_use.set(alloc.in_use)
                 tel.pages_cached.set(alloc.cached_pages)
+        # heartbeat AFTER admission: the published queue depth is what
+        # is still waiting behind the slots, not this instant's intake
+        self._maybe_heartbeat(now)
         # nothing resident yet and the next arrival is in the future:
         # nothing to advance — report it instead of spinning
         pending = sess["pending"]

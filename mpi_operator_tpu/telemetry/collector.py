@@ -524,7 +524,18 @@ def resize_ledger(records: Iterable[Dict]) -> List[Dict]:
     total     = drain start -> first post-resume step completion — the
                 goodput hole the resize punched into the run.
     Entries missing a phase (job died mid-resize) keep whatever phases
-    were observed; ``total_seconds`` is only set once the gang stepped."""
+    were observed; ``total_seconds`` is only set once the gang stepped.
+
+    Every entry carries ``kind``: ``"gang_resize"`` for the phase-pair
+    machinery above, ``"live_scale"`` for surgical decode-pool steps.
+    A ``live_scale`` record is SELF-CONTAINED (the survivors never
+    paused, so there is no checkpoint/restore/recompile to pair): its
+    entry copies the record's drain_seconds (graceful detach drain) /
+    warmup_seconds (attach compile pin) and total_seconds (defaulting
+    to drain + warmup when the emitter measured only the phases).
+    Cooldown readers MUST filter on kind — pricing a live step off a
+    gang total (or a gang preemption off a live step) inverts the
+    whole point of the split."""
     resizes: List[Dict] = []
     drain_open: Optional[float] = None
     last_drain: Optional[Tuple[float, float]] = None
@@ -537,10 +548,29 @@ def resize_ledger(records: Iterable[Dict]) -> List[Dict]:
         elif kind == ev.EMERGENCY_CHECKPOINT and drain_open is not None:
             last_drain = (drain_open, round(ts - drain_open, 3))
             drain_open = None
+        elif kind == ev.LIVE_SCALE:
+            entry = {"ts": ts, "kind": ev.LIVE_SCALE}
+            for key in ("action", "replicas", "decode_replicas", "reason",
+                        "token"):
+                if key in rec:
+                    entry[key] = rec[key]
+            phases = 0.0
+            for key in ("drain_seconds", "warmup_seconds"):
+                try:
+                    entry[key] = float(rec[key])
+                    phases += entry[key]
+                except (KeyError, TypeError, ValueError):
+                    pass
+            try:
+                entry["total_seconds"] = float(rec["total_seconds"])
+            except (KeyError, TypeError, ValueError):
+                if "drain_seconds" in entry or "warmup_seconds" in entry:
+                    entry["total_seconds"] = round(phases, 3)
+            resizes.append(entry)
         elif kind == ev.GANG_RESIZE:
             if current is not None:
                 resizes.append(current)
-            current = {"ts": ts}
+            current = {"ts": ts, "kind": ev.GANG_RESIZE}
             for key in ("workers", "tpus", "replicas", "num_slices",
                         "reason"):
                 if key in rec:
@@ -588,7 +618,14 @@ def resize_lines(job: str, resizes: List[Dict],
                          for k, v in merged.items())
         return "{" + inner + "}"
 
-    totals = sorted(float(r["total_seconds"]) for r in resizes
+    # the histogram prices GANG resizes only: mixing sub-second live
+    # scale steps into the same series would drag the p99 an alert rule
+    # reads off the distribution it is actually alarming on (entries
+    # predating the kind field are all gang — live_scale always stamps)
+    gang = [r for r in resizes
+            if r.get("kind", ev.GANG_RESIZE) == ev.GANG_RESIZE]
+    live = [r for r in resizes if r.get("kind") == ev.LIVE_SCALE]
+    totals = sorted(float(r["total_seconds"]) for r in gang
                     if "total_seconds" in r)
     lines = [
         "# HELP tpu_job_resize_seconds wall time of a gang resize, drain "
@@ -607,11 +644,11 @@ def resize_lines(job: str, resizes: List[Dict],
     lines += [
         "# HELP tpu_job_resizes_total gang resizes observed",
         "# TYPE tpu_job_resizes_total counter",
-        f"tpu_job_resizes_total{ls()} {len(resizes)}",
+        f"tpu_job_resizes_total{ls()} {len(gang)}",
     ]
     for phase in ("drain", "restore", "recompile"):
         key = f"{phase}_seconds"
-        value = next((r[key] for r in reversed(resizes) if key in r), None)
+        value = next((r[key] for r in reversed(gang) if key in r), None)
         if value is None:
             continue
         lines += [
@@ -621,6 +658,23 @@ def resize_lines(job: str, resizes: List[Dict],
             f"tpu_job_resize_{key}{ls()} "
             f"{format_value(round(float(value), 3))}",
         ]
+    if live:
+        lines += [
+            "# HELP tpu_job_live_scales_total surgical decode-pool "
+            "scale steps (no gang restart)",
+            "# TYPE tpu_job_live_scales_total counter",
+            f"tpu_job_live_scales_total{ls()} {len(live)}",
+        ]
+        value = next((r["total_seconds"] for r in reversed(live)
+                      if "total_seconds" in r), None)
+        if value is not None:
+            lines += [
+                "# HELP tpu_job_live_scale_seconds drain+warmup of the "
+                "most recent live scale step",
+                "# TYPE tpu_job_live_scale_seconds gauge",
+                f"tpu_job_live_scale_seconds{ls()} "
+                f"{format_value(round(float(value), 3))}",
+            ]
     return lines
 
 
@@ -856,6 +910,21 @@ class JobObservatory:
             return
         seen.add(mark)
         self.record(job, event, **fields)
+
+    def note_live_scale(self, job: str, token: str, **fields) -> None:
+        """Record one surgical decode-pool scale step (LIVE_SCALE),
+        idempotent per token — the note_sched discipline applied to
+        live scaling: the controller writes the ``scalingReplica``
+        status marker BEFORE touching the decode StatefulSet and emits
+        with that marker as the token, so a crash replay (marker still
+        set, replicas already landed) re-emits at most once however
+        many times the sync re-runs."""
+        view = self.view(job)
+        seen = view.setdefault("live_scale_tokens", set())
+        if token in seen:
+            return
+        seen.add(token)
+        self.record(job, ev.LIVE_SCALE, token=token, **fields)
 
     def note_terminal(self, job: str, succeeded: bool, **fields) -> None:
         view = self.view(job)

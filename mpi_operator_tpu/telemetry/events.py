@@ -83,6 +83,18 @@ JOB_RESIZED = "resize"
 # elastic JOB_RESIZED shrink above. scripts/tier1.sh --elastic greps for
 # this literal.
 GANG_RESIZE = "gang_resize"
+# surgical decode-pool scale step (serving): ONE replica attached or
+# drained while the rest of the fleet keeps serving — no checkpoint, no
+# fleet recompile, so unlike GANG_RESIZE this is a single self-contained
+# record, not an open/close phase pair. Carries action="attach"|"detach",
+# the decode target, and the measured phase split (drain_seconds for a
+# detach's graceful drain, warmup_seconds for an attach's compile pin,
+# total_seconds = the goodput hole — survivors never pause, so it prices
+# only the stepped replica's own transition). The resize ledger files
+# these under kind="live_scale"; the autoscaler's cooldown reads the
+# newest entry OF ITS OWN KIND so one expensive gang resize cannot pin
+# live-scale reaction times. scripts/tier1.sh greps for this literal.
+LIVE_SCALE = "live_scale"
 # Fleet-scheduler decisions (controller/scheduler.py). Every record
 # carries the action's principals so the postmortem can explain WHY a
 # gang shrank: victim/beneficiary job names, chip targets, and the
@@ -323,7 +335,7 @@ __all__ = ["EventLog", "BoundEventLog", "read_events", "event_files",
            "REPLICA_FROZEN", "RUN_COMPLETE", "REQUEST_TIMEOUT",
            "JOB_CREATED", "GANG_RESTART", "GANG_STUCK", "GANG_DEGRADED",
            "PODS_READY", "FIRST_STEP_OBSERVED",
-           "JOB_PACKED", "JOB_RESIZED", "GANG_RESIZE",
+           "JOB_PACKED", "JOB_RESIZED", "GANG_RESIZE", "LIVE_SCALE",
            "SCHED_QUEUE", "SCHED_PREEMPT", "SCHED_ADMIT",
            "SCHED_GROW_BACK", "SCHED_SKIP", "SCHED_MIGRATE",
            "FIRST_RESUME_STEP", "JOB_SUCCEEDED", "JOB_FAILED"]

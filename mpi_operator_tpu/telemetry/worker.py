@@ -363,7 +363,14 @@ class RouterTelemetry:
     Per-replica dispatch counters follow the bundle-per-label-set
     pattern lazily: ``dispatch_for(i)`` creates the ``{replica="i"}``
     series on first use, so the bundle needs no up-front fleet size
-    (failover can retarget a shrunken fleet without dead series)."""
+    (failover can retarget a shrunken fleet without dead series).
+
+    Push-based load reports land here too: ``note_heartbeat(i, ...)``
+    stores the newest report per replica (``heartbeat(i)`` reads it
+    back — the router's dispatch scoring prefers a fresh report over
+    probing engine state) and mirrors it into lazy
+    ``tpu_router_replica_{queue_depth,free_slots,free_pages}{replica=}``
+    gauges so a scrape sees the same picture the router routes on."""
 
     def __init__(self, registry: Optional[Registry] = None,
                  labels: Optional[Dict[str, str]] = None):
@@ -397,7 +404,17 @@ class RouterTelemetry:
             "tpu_router_queue_wait_seconds",
             "arrival to dispatch wait at the front door",
             lo=1e-5, hi=1e3, labels=labels)
+        self.attach_total = reg.counter(
+            "tpu_router_attach_total",
+            "replicas joined live (scale-up steps, no gang restart)",
+            labels=labels)
+        self.detach_total = reg.counter(
+            "tpu_router_detach_total",
+            "replicas drained and detached live (scale-down steps)",
+            labels=labels)
         self._dispatch: Dict[int, object] = {}
+        self._heartbeats: Dict[int, Dict[str, float]] = {}
+        self._hb_gauges: Dict[int, tuple] = {}
 
     def dispatch_for(self, replica: int):
         """The ``tpu_router_dispatch_total{replica="N"}`` counter,
@@ -411,6 +428,44 @@ class RouterTelemetry:
                 "requests dispatched to this replica", labels=merged)
             self._dispatch[replica] = c
         return c
+
+    def note_heartbeat(self, replica: int, now: float, queue_depth: int,
+                       free_slots: int, free_pages: int) -> None:
+        """Record one replica load report (engine heartbeat). `now` is
+        SESSION time — staleness is judged on the same clock the router
+        runs on, so wall-clock skew can never mark a fresh report
+        stale."""
+        self._heartbeats[replica] = {
+            "now": float(now), "queue_depth": float(queue_depth),
+            "free_slots": float(free_slots),
+            "free_pages": float(free_pages)}
+        gauges = self._hb_gauges.get(replica)
+        if gauges is None:
+            merged = dict(self.labels or {})
+            merged["replica"] = str(replica)
+            gauges = (
+                self.registry.gauge(
+                    "tpu_router_replica_queue_depth",
+                    "queue depth last reported by this replica's "
+                    "heartbeat", labels=merged),
+                self.registry.gauge(
+                    "tpu_router_replica_free_slots",
+                    "free slots last reported by this replica's "
+                    "heartbeat", labels=merged),
+                self.registry.gauge(
+                    "tpu_router_replica_free_pages",
+                    "free+evictable KV pages last reported by this "
+                    "replica's heartbeat", labels=merged))
+            self._hb_gauges[replica] = gauges
+        gauges[0].set(queue_depth)
+        gauges[1].set(free_slots)
+        gauges[2].set(free_pages)
+
+    def heartbeat(self, replica: int) -> Optional[Dict[str, float]]:
+        """The newest load report for one replica (None before the
+        first beat). The caller judges freshness against its own
+        staleness threshold."""
+        return self._heartbeats.get(replica)
 
 
 class WorkerTelemetry:

@@ -146,6 +146,49 @@ if [ "${1:-}" = "--router" ]; then
        "$(grep -o '"router_affinity_hit_rate": [0-9.]*' "$dir/router.json" | grep -o '[0-9.]*$') vs" \
        "$(grep -o '"router_noaffinity_hit_rate": [0-9.]*' "$dir/router.json" | grep -o '[0-9.]*$') load-only," \
        "$(grep -o '"router_burst_sheds": [0-9]*' "$dir/router.json" | grep -o '[0-9]*$') burst sheds, clean recovery)"
+  # Live-scale gate: the SAME trace through one +1 attach and one -1
+  # graceful drain mid-trace. Zero sheds attributable to the steps,
+  # bitwise token identity held for every request (drained-replica
+  # failovers included), and the measured live_scale ledger total must
+  # price strictly below the same trace's gang-restart total.
+  echo "== livescale smoke: +1 attach / -1 drain mid-trace vs gang restart =="
+  timeout -k 10 900 env JAX_PLATFORMS=cpu \
+    python -m mpi_operator_tpu.examples.serve_benchmark \
+    --livescale --size test --slots 4 --num-requests 12 --page-size 16 \
+    > "$dir/livescale.json" 2> "$dir/livescale.log"
+  rc=$?
+  if [ "$rc" -ne 0 ]; then
+    echo "FAIL: livescale benchmark exited $rc"
+    tail -20 "$dir/livescale.log"; exit 1
+  fi
+  if ! grep -q '"livescale_attaches": 1' "$dir/livescale.json" \
+      || ! grep -q '"livescale_detaches": 1' "$dir/livescale.json"; then
+    echo "FAIL: the livescale trace did not execute exactly one attach and one detach"
+    cat "$dir/livescale.json"; exit 1
+  fi
+  if ! grep -q '"livescale_dropped": 0' "$dir/livescale.json" \
+      || ! grep -q '"livescale_sheds": 0' "$dir/livescale.json"; then
+    echo "FAIL: the live scale step dropped or shed a request"
+    cat "$dir/livescale.json"; exit 1
+  fi
+  if ! grep -q '"livescale_token_identical": true' "$dir/livescale.json" \
+      || ! grep -q '"livescale_gang_token_identical": true' "$dir/livescale.json"; then
+    echo "FAIL: tokens diverged from the never-scaled oracle across a scale step"
+    cat "$dir/livescale.json"; exit 1
+  fi
+  if ! grep -q '"livescale_compile_pins_held": true' "$dir/livescale.json"; then
+    echo "FAIL: a survivor (or the newcomer) recompiled across the live step"
+    cat "$dir/livescale.json"; exit 1
+  fi
+  if ! grep -q '"livescale_ledger_vs_gang_ok": true' "$dir/livescale.json"; then
+    echo "FAIL: live_scale ledger total did not beat the gang-restart total"
+    cat "$dir/livescale.json"; exit 1
+  fi
+  echo "livescale smoke: OK (ledger" \
+       "$(grep -o '"livescale_ledger_total_seconds": [0-9.]*' "$dir/livescale.json" | grep -o '[0-9.]*$')s live vs" \
+       "$(grep -o '"livescale_gang_total_seconds": [0-9.]*' "$dir/livescale.json" | grep -o '[0-9.]*$')s gang, p99 TTFT" \
+       "$(grep -o '"livescale_ttft_p99_ms": [0-9.]*' "$dir/livescale.json" | grep -o '[0-9.]*$')ms vs" \
+       "$(grep -o '"livescale_gang_ttft_p99_ms": [0-9.]*' "$dir/livescale.json" | grep -o '[0-9.]*$')ms, zero drops)"
   exit 0
 fi
 
@@ -392,6 +435,25 @@ if [ "${1:-}" = "--chaos" ]; then
   if ! grep -q '"router_failover_lost": 0' "$dir/chaos-$s.json" \
       || grep -q '"router_resubmitted": 0' "$dir/chaos-$s.json"; then
     echo "FAIL: seed $s: the router-failover leg lost or never resubmitted requests"
+    cat "$dir/chaos-$s.json"; exit 1
+  fi
+  # live decode-pool scaling under burst scrape faults with the
+  # controller crashed at the scalingReplica marker: replay must not
+  # double-apply the step (exactly 2 ledger records, zero duplicate
+  # tokens, zero gang entries), and the engine-level attach/drain cycle
+  # must lose nothing and reclaim every page
+  if ! grep -q '"live_scale_marker_crashes": 2' "$dir/chaos-$s.json" \
+      || ! grep -q '"live_scale_ledger_records": 2' "$dir/chaos-$s.json" \
+      || ! grep -q '"live_scale_double_records": 0' "$dir/chaos-$s.json" \
+      || ! grep -q '"live_scale_gang_entries": 0' "$dir/chaos-$s.json"; then
+    echo "FAIL: seed $s: live-scale marker replay double-applied, gang-restarted, or never ran"
+    cat "$dir/chaos-$s.json"; exit 1
+  fi
+  if ! grep -q '"live_scale_lost": 0' "$dir/chaos-$s.json" \
+      || ! grep -q '"live_scale_shed": 0' "$dir/chaos-$s.json" \
+      || ! grep -q '"live_scale_token_mismatches": 0' "$dir/chaos-$s.json" \
+      || ! grep -q '"live_scale_leaked_pages": 0' "$dir/chaos-$s.json"; then
+    echo "FAIL: seed $s: the live attach/drain cycle lost requests, diverged tokens, or leaked pages"
     cat "$dir/chaos-$s.json"; exit 1
   fi
   # fleet-scheduler gates: the rebalance converged crash-consistently

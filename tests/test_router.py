@@ -21,6 +21,11 @@ The contracts under test:
 - **Burst schedule**: ``:burst:<period>/<duty>`` oscillates a rule
   deterministically over per-rank fetch counts, and every in-burst
   injection names its window index next to the seed.
+- **Live topology steps**: a detach mid-drain FAILS OVER the drained
+  replica's queued requests to survivors (never sheds them); an attach
+  joins only a pre-warmed engine (the compile pin) and absorbs overload
+  the incumbent fleet would have shed; dispatch prefers a fresh
+  heartbeat report over in-process probing and falls back when stale.
 """
 import pytest
 
@@ -256,6 +261,149 @@ def test_router_config_validation():
         Router([], RouterConfig())
     with pytest.raises(ValueError):
         Router([_FakeEngine()], RouterConfig(max_inflight=0))
+
+
+# ---------------------------------------------------------------------------
+# live topology: attach / detach / heartbeats (no jax)
+# ---------------------------------------------------------------------------
+
+class _QueueingEngine(_FakeEngine):
+    """Fake with a real admission queue: submissions wait in
+    scheduler.queue until a serving lane frees (`concurrent` at a
+    time) — the queued-behind-slots state a graceful drain must pull
+    back and fail over."""
+
+    def __init__(self, concurrent=1, **kw):
+        super().__init__(**kw)
+        self.concurrent = concurrent
+
+    def submit(self, req):
+        self.submitted.append(req.id)
+        self.scheduler.queue.append(req)
+
+    @property
+    def active(self):
+        return bool(self._work or self.scheduler.queue)
+
+    def tick(self):
+        while self.scheduler.queue and len(self._work) < self.concurrent:
+            req = self.scheduler.queue.pop(0)
+            self._work[req.id] = [req, self.service_ticks]
+        return super().tick()
+
+
+class _WarmableEngine(_QueueingEngine):
+    """Queueing fake that exposes compile_counts — the surface the
+    attach warmup pin checks."""
+
+    def __init__(self, step_compiles=1, **kw):
+        super().__init__(**kw)
+        self._step_compiles = step_compiles
+
+    def compile_counts(self):
+        return {"step": self._step_compiles, "prefill": 0}
+
+
+def test_detach_mid_drain_fails_over_queued_requests():
+    # scale-down RACING submission: four requests land, two queue behind
+    # replica 0's single lane, then the drain begins — the queued ones
+    # must fail over to the survivor (resubmit path), never shed, and
+    # the resident one finishes in place on the draining replica
+    fakes = [_QueueingEngine(service_ticks=2),
+             _QueueingEngine(service_ticks=2)]
+    router = Router(fakes, RouterConfig())
+    orig_tick = fakes[0].tick
+    fired = {"done": False}
+
+    def tick_then_detach():
+        r = orig_tick()
+        if not fired["done"]:
+            fired["done"] = True
+            router.detach_replica(0)
+        return r
+
+    fakes[0].tick = tick_then_detach
+    out = router.run([_req(i, [30 + i, 31 + i, 32 + i]) for i in range(4)])
+    assert set(out) == {0, 1, 2, 3}
+    assert all(r.finish_reason == "eos" for r in out.values())
+    assert router.shed_count() == 0                 # failover, NOT shed
+    assert router.resubmitted_total >= 1            # the pulled-back ones
+    # a graceful exit is a detach, never a death
+    assert router.detached_replicas() == [0]
+    assert router.dead_replicas() == []
+    assert router.active_count() == 1
+    # the completed step landed in the live-scale log with its phase
+    (entry,) = router.live_scale_log
+    assert entry["action"] == "detach" and entry["replica"] == 0
+    assert entry["drain_seconds"] >= 0.0
+    assert entry["total_seconds"] == entry["drain_seconds"]
+    # drained replica handed every page and slot back
+    assert fakes[0].page_allocator.in_use == 0
+
+
+def test_detach_verifies_reclaim_and_guards_last_replica():
+    router = Router([_FakeEngine(), _FakeEngine()], RouterConfig())
+    with pytest.raises(ValueError, match="no live replica"):
+        router.detach_replica(7)
+    router.replicas[1].alive = False
+    with pytest.raises(ValueError, match="last active replica"):
+        router.detach_replica(0)
+
+
+def test_attach_requires_the_compile_pin():
+    router = Router([_FakeEngine()], RouterConfig())
+    with pytest.raises(ValueError, match="PRE-WARMED"):
+        router.attach_replica(_WarmableEngine(step_compiles=0))
+    # engines that don't expose compile_counts duck-pass; warmed pass
+    router.attach_replica(_WarmableEngine(step_compiles=1))
+    assert router.active_count() == 2
+
+
+def test_attach_during_overload_absorbs_queue():
+    # one replica, cap 2, four simultaneous arrivals: without the +1
+    # step two requests shed at the front door (the
+    # test_shed_semantics_end_to_end geometry). A pre-warmed attach at
+    # t=0 absorbs the overflow instead — zero sheds, and the newcomer
+    # never compiled anything new (its pin count is untouched).
+    base = _QueueingEngine(service_ticks=2, concurrent=2)
+    newcomer = _WarmableEngine(step_compiles=1, service_ticks=2,
+                               concurrent=2)
+    router = Router([base], RouterConfig(max_inflight=2))
+    router.schedule_attach(0.0, newcomer, warmup_seconds=0.125)
+    out = router.run([_req(i, [40 + i, 41 + i]) for i in range(4)])
+    assert set(out) == {0, 1, 2, 3}
+    assert router.shed_count() == 0
+    assert all(r.finish_reason == "eos" for r in out.values())
+    assert len(newcomer.submitted) == 2             # absorbed the overflow
+    assert newcomer.compile_counts() == {"step": 1, "prefill": 0}
+    (entry,) = router.live_scale_log
+    assert entry["action"] == "attach"
+    assert entry["warmup_seconds"] == 0.125
+    assert entry["total_seconds"] == 0.125
+    assert router.active_count() == 2
+
+
+def test_heartbeat_preferred_until_stale():
+    from mpi_operator_tpu.telemetry.worker import RouterTelemetry
+
+    tel = RouterTelemetry()
+    fakes = [_FakeEngine(), _FakeEngine()]
+    router = Router(fakes, RouterConfig(affinity=False,
+                                        heartbeat_interval=0.5),
+                    telemetry=tel)
+    # probing sees both replicas empty, but replica 0's PUBLISHED report
+    # says it is buried — a fresh heartbeat must win over the probe
+    tel.note_heartbeat(0, now=0.0, queue_depth=5, free_slots=0,
+                       free_pages=0)
+    tel.note_heartbeat(1, now=0.0, queue_depth=0, free_slots=4,
+                       free_pages=64)
+    assert router._pick(_req(0, [1, 2, 3]), now=0.2).index == 1
+    # past the staleness threshold (2x interval) the report is dead
+    # air: fall back to probing — a tie, so lowest index wins again
+    assert router._pick(_req(1, [1, 2, 3]), now=5.0).index == 0
+    # heartbeats off: the stored report is never consulted
+    router_off = Router(fakes, RouterConfig(affinity=False), telemetry=tel)
+    assert router_off._pick(_req(2, [1, 2, 3]), now=0.2).index == 0
 
 
 # ---------------------------------------------------------------------------
