@@ -59,6 +59,11 @@ class SLOObservation:
     ttft_p99: Optional[float] = None
     tpot_p99: Optional[float] = None
     queue_depth: Optional[float] = None
+    #: trace id of the slowest completed request in the federation's
+    #: exemplar window (TraceFederation.slowest_trace) — pure evidence,
+    #: never part of the breach math; a breach decision carries it so
+    #: the postmortem can render the worst span tree behind the p99
+    exemplar_trace: Optional[int] = None
 
 
 @dataclass
@@ -70,6 +75,10 @@ class AutoscaleDecision:
     target: Optional[int] = None
     reason: str = ""
     wake_after: Optional[float] = None
+    #: the observation's exemplar trace id, copied onto breach-driven
+    #: scale-ups only (hold/scale-down decisions carry None — there is
+    #: no breach to exemplify)
+    exemplar_trace: Optional[int] = None
 
 
 class DecodeAutoscaler:
@@ -161,7 +170,8 @@ class DecodeAutoscaler:
                 target=current + 1,
                 reason=f"SLO breached for >= {slo.breach_seconds:.0f}s "
                        f"({'; '.join(violations)}); scaling decode "
-                       f"{current} -> {current + 1}")
+                       f"{current} -> {current + 1}",
+                exemplar_trace=obs.exemplar_trace)
         self.breach_since = None
         if not self._all_clear(obs):
             # partial evidence: inside SLO where observed, but some

@@ -956,6 +956,132 @@ def data_plane_router_failover(seed: int = 0) -> Dict:
             "router_dead_replicas": 1}
 
 
+def data_plane_trace_complete(seed: int = 0) -> Dict:
+    """Trace-completeness invariants under adversity: the router fleet
+    from the failover leg, but traced (Tracer, sample=1.0) and sized so
+    the front door ALSO sheds (max_inflight=2, six simultaneous
+    arrivals), with replica 0 killed mid-trace. The span log must then
+    satisfy, with no survivors' help:
+
+      * every request that entered the router has EXACTLY ONE root span
+        with a terminal status — ok / timeout / shed / failover — even
+        the ones replayed across the replica death (the tracer's
+        registry hands the replay the same open root, so dedup is by
+        construction, and build_trees double-checks by (trace, span));
+      * zero orphan spans: the killed replica's session span was
+        abandoned, not leaked, and no hop points at a vanished root;
+      * hop durations tile the root — abandon closes the open hop at
+        the failover instant and the replay's queue-wait reopens there,
+        so the sum-vs-root gap stays within rounding even for traces
+        that crossed the dead replica.
+    """
+    import jax
+    import jax.numpy as jnp
+    from flax.core import meta as flax_meta
+
+    from ..models import CausalLM, gpt2_config
+    from ..serve import (EngineConfig, Request, Router, RouterConfig,
+                         ServingEngine)
+    from ..telemetry.trace import (REQUEST_ROOT, Tracer, build_trees,
+                                   orphan_spans, trace_sum_gap)
+
+    cfg = gpt2_config("test", attention="dense", dtype=jnp.float32,
+                      vocab_size=64, max_len=64)
+    model = CausalLM(cfg)
+    probe = jnp.zeros((1, 4), jnp.int32)
+    params = flax_meta.unbox(
+        model.init(jax.random.PRNGKey(seed), probe))["params"]
+
+    def mk():
+        return ServingEngine(model, params, EngineConfig(
+            slots=2, chunk_buckets=(4, 8), paged=True, page_size=8,
+            rng_seed=seed))
+
+    rng = random.Random(seed)
+    reqs = [Request(i, [1 + rng.randrange(60) for _ in range(4 + i % 5)],
+                    max_new_tokens=5, arrival=0.0) for i in range(6)]
+    tracer = Tracer(sample=1.0)
+    router = Router([mk(), mk()], RouterConfig(max_inflight=2),
+                    tracer=tracer)
+    ticks = {"n": 0}
+    victim = router.replicas[0].engine
+    real_tick = victim.tick
+
+    def dying_tick():
+        ticks["n"] += 1
+        if ticks["n"] > 3:
+            raise IOError(f"injected: replica 0 died (seed={seed})")
+        return real_tick()
+
+    victim.tick = dying_tick
+    results = router.run([Request(r.id, r.prompt, r.max_new_tokens,
+                                  arrival=r.arrival) for r in reqs])
+    if not router.resubmitted_total:
+        raise ConvergenceError(
+            "trace leg: replica died mid-trace but nothing was "
+            "resubmitted — the kill landed after the work", seed)
+    if tracer.open_requests():
+        raise ConvergenceError(
+            f"trace leg: request traces left open after the run: "
+            f"{tracer.open_requests()}", seed)
+    spans = list(tracer.ring)
+    trees = build_trees(spans)
+    orphans = orphan_spans(spans)
+    if orphans:
+        raise ConvergenceError(
+            f"trace leg: {len(orphans)} orphan span(s) after the "
+            f"replica kill: {[s['name'] for s in orphans]}", seed)
+    terminal = {"ok", "timeout", "shed", "failover"}
+    shed_roots = 0
+    max_gap = 0.0
+    for r in reqs:
+        tree = trees.get(r.id)
+        root = tree["root"] if tree else None
+        if root is None:
+            raise ConvergenceError(
+                f"trace leg: request {r.id} has no root span", seed)
+        n_roots = sum(1 for s in spans
+                      if s["trace"] == r.id and s["name"] == REQUEST_ROOT)
+        if n_roots != 1:
+            raise ConvergenceError(
+                f"trace leg: request {r.id} has {n_roots} root spans "
+                f"(failover replay dedup broken)", seed)
+        if root["status"] not in terminal:
+            raise ConvergenceError(
+                f"trace leg: request {r.id} root status "
+                f"{root['status']!r} is not terminal", seed)
+        want = ("shed" if results[r.id].finish_reason == "shed" else "ok")
+        if root["status"] != want:
+            raise ConvergenceError(
+                f"trace leg: request {r.id} finished "
+                f"{results[r.id].finish_reason!r} but its root says "
+                f"{root['status']!r}", seed)
+        shed_roots += root["status"] == "shed"
+        gap = trace_sum_gap(tree)
+        if gap is not None and root["seconds"] > 0:
+            max_gap = max(max_gap, gap)
+            if gap > max(0.005, 0.02 * root["seconds"]):
+                raise ConvergenceError(
+                    f"trace leg: request {r.id} hops sum "
+                    f"{gap:.6f}s away from its root duration "
+                    f"({root['seconds']:.6f}s) — the hop chain tore",
+                    seed)
+    failover_roots = sum(
+        1 for t in trees.values()
+        if t["root"] is not None and any(
+            e.get("name") == "failover"
+            for e in t["root"].get("events", [])))
+    if not failover_roots:
+        raise ConvergenceError(
+            "trace leg: resubmits happened but no root carries a "
+            "failover event", seed)
+    return {"trace_complete_requests": len(reqs),
+            "trace_complete_orphans": 0,
+            "trace_complete_shed_roots": shed_roots,
+            "trace_complete_failover_roots": failover_roots,
+            "trace_complete_max_gap_seconds": round(max_gap, 6)}
+
+
 def data_plane_live_scale(seed: int = 0) -> Dict:
     """Live decode-pool scaling, control plane, under the nastiest
     schedule the marker protocol must survive: an SLO breach drives the
@@ -1209,6 +1335,7 @@ def data_plane_soak(seed: int = 0,
     if engine_leg:
         report.update(data_plane_request_timeouts(seed))
         report.update(data_plane_router_failover(seed))
+        report.update(data_plane_trace_complete(seed))
         report.update(data_plane_live_scale_engines(seed))
     return report
 

@@ -949,6 +949,7 @@ class TPUJobController:
         short). Pending persistence/cooldown windows schedule their own
         queue wake-ups so a quiet cluster still re-evaluates."""
         from ..telemetry.collector import resize_ledger
+        from ..telemetry.events import AUTOSCALE_BREACH as EV_AUTOSCALE_BREACH
         from ..telemetry.events import LIVE_SCALE as LIVE_SCALE_KIND
         from .autoscale import DecodeAutoscaler, SLOObservation
 
@@ -970,7 +971,12 @@ class TPUJobController:
                 "tpu_worker_ttft_seconds", 0.99),
             tpot_p99=fed.histogram_quantile(
                 "tpu_worker_tpot_seconds", 0.99),
-            queue_depth=fed.gauge_value("tpu_worker_queue_depth"))
+            queue_depth=fed.gauge_value("tpu_worker_queue_depth"),
+            # the slowest completed request trace in the federation's
+            # exemplar window rides along: a breach decision carries it
+            # so the scale-up event / postmortem can show the actual
+            # span tree behind the p99 number
+            exemplar_trace=self.observatory.slowest_trace(name))
         resizes = resize_ledger(self.observatory.merged_records(name))
         # newest MEASURED cost of the action kind this scaler is about
         # to take: decode deltas materialize as live_scale steps now, so
@@ -997,6 +1003,16 @@ class TPUJobController:
         job.status.serving_decode_replicas = decision.target
         job.status.serving_scaled_at = self.now()
         job = self._update_status_apply(job)
+        if up:
+            # the breach record lands in the job timeline with its
+            # exemplar trace id, so the postmortem's "slow traces:"
+            # section can render the actual span tree behind the p99
+            # that forced this scale-up
+            fields = {"target": decision.target, "reason": decision.reason}
+            if decision.exemplar_trace is not None:
+                fields["exemplar_trace"] = decision.exemplar_trace
+            self.observatory.record(
+                name, EV_AUTOSCALE_BREACH, **fields)
         self.recorder.event(
             job, "Warning" if up else "Normal",
             "ServingScaleUp" if up else "ServingScaleDown",

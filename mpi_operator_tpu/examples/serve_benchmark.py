@@ -165,13 +165,19 @@ def run_serving_benchmark(
                           int(rs.choice(new_grid)))
              for i in range(num_requests)]
 
+    from ..telemetry.trace import (Tracer, build_trees, hop_percentiles,
+                                   trace_sum_gap)
+
     wtel = WorkerTelemetry()
+    # in-memory ring only (no sink file): the per-hop breakdown and the
+    # completeness gate read the ring after the measured run
+    tracer = Tracer(sample=1.0)
     engine = ServingEngine(model, params, EngineConfig(
         slots=slots, chunk_buckets=tuple(chunk_buckets),
         decode_kernel=decode_kernel, rng_seed=seed,
         paged=paged, page_size=page_size, num_pages=num_pages,
         speculative=speculative, draft_k=draft_k),
-        telemetry=wtel.serving)
+        telemetry=wtel.serving, tracer=tracer)
     if metrics_port is not None:
         log(f"worker /metrics listening on port "
             f"{wtel.serve(port=metrics_port).port}")
@@ -210,6 +216,21 @@ def run_serving_benchmark(
     if gap.count:
         gap50_ms = round(gap.percentile(50) * 1e3, 3)
         gap99_ms = round(gap.percentile(99) * 1e3, 3)
+    # per-hop latency breakdown + completeness gate, snapshotted BEFORE
+    # any compare_* rerun replays the same request ids through the
+    # tracer: every measured request must have one root span whose hop
+    # durations tile its end-to-end latency
+    trace_spans = list(tracer.ring)
+    trees = build_trees(trace_spans)
+    req_trees = {r.id: trees.get(r.id) for r in trace}
+    trace_complete = all(
+        t is not None and t["root"] is not None
+        and t["root"]["status"] == "ok" for t in req_trees.values())
+    gaps = [trace_sum_gap(t) for t in req_trees.values()
+            if t is not None and t["root"] is not None]
+    gaps = [g for g in gaps if g is not None]
+    hop_fields = {f"serving_hop_{k}": round(v, 3)
+                  for k, v in hop_percentiles(trace_spans).items()}
 
     out: Dict[str, object] = {
         "serving_tokens_per_sec": round(tps, 1),
@@ -220,6 +241,10 @@ def run_serving_benchmark(
         **lat,
         "serving_host_gap_p50_ms": gap50_ms,
         "serving_host_gap_p99_ms": gap99_ms,
+        **hop_fields,
+        "serving_trace_complete": bool(trace_complete),
+        "serving_trace_max_gap_ms": (round(max(gaps) * 1e3, 3)
+                                     if gaps else None),
         "serving_step_compiles": counts["step"],
         "serving_prefill_compiles": counts["prefill"],
         "serving_no_recompile": bool(no_recompile),
@@ -476,12 +501,16 @@ def run_disagg_benchmark(
                           int(rs.choice(new_grid)))
              for i in range(num_requests)]
 
+    from ..telemetry.trace import (Tracer, build_trees, hop_name,
+                                   hop_percentiles)
+
     cfg = EngineConfig(
         slots=slots, chunk_buckets=tuple(chunk_buckets),
         decode_kernel=decode_kernel, rng_seed=seed,
         paged=True, page_size=page_size, num_pages=num_pages)
     coloc = ServingEngine(model, params, cfg)
-    disagg = DisaggEngine(model, params, cfg)
+    tracer = Tracer(sample=1.0)
+    disagg = DisaggEngine(model, params, cfg, tracer=tracer)
 
     warm = [make_request(10_000 + j, p, 2)
             for j, p in enumerate(sorted(set(int(r) for r in prompt_grid)))]
@@ -516,6 +545,31 @@ def run_disagg_benchmark(
     pins = (pre["step"] == 0 and pre["prefill"] <= len(chunk_buckets)
             and dec["prefill"] == 0 and dec["step"] <= 3)
     handoff = _percentiles([dt for dt, _, _ in disagg.handoff_log])
+    # request traces: every measured request must show the full
+    # prefill -> kv_handoff -> decode hop chain with the page counts the
+    # handoff actually moved riding as hop attrs (warm-batch ids are
+    # excluded so the percentiles describe the measured trace only)
+    idset = {r.id for r in trace}
+    spans = [s for s in tracer.ring if s["trace"] in idset]
+    trees = build_trees(spans)
+    trace_handoff_pages = 0
+    trace_complete = True
+    for r in trace:
+        t = trees.get(r.id)
+        if t is None or t["root"] is None or t["root"]["status"] != "ok":
+            trace_complete = False
+            continue
+        hops = [hop_name(s) for s in t["spans"]
+                if s.get("parent") is not None]
+        if not ("prefill" in hops and "kv_handoff" in hops
+                and "decode" in hops):
+            trace_complete = False
+        for s in t["spans"]:
+            if s.get("parent") is not None and hop_name(s) == "kv_handoff":
+                trace_handoff_pages += int(
+                    (s.get("attrs") or {}).get("pages", 0))
+    hop_fields = {f"disagg_hop_{k}": round(v, 3)
+                  for k, v in hop_percentiles(spans).items()}
 
     out: Dict[str, object] = {
         "disagg_tokens_per_sec": round(total_new / disagg_wall, 1),
@@ -536,6 +590,9 @@ def run_disagg_benchmark(
         "disagg_kv_handoff_p99_ms": ms(handoff[99]),
         "disagg_kv_handoff_pages_total": disagg.transfer.pages_moved,
         "disagg_handoffs": len(disagg.handoff_log),
+        **hop_fields,
+        "disagg_trace_complete": bool(trace_complete),
+        "disagg_trace_handoff_pages": trace_handoff_pages,
         "disagg_token_identical": bool(identical),
         "disagg_pool_pins_held": bool(pins),
         "disagg_prefill_pool_prefill_compiles": pre["prefill"],
@@ -608,6 +665,8 @@ def run_router_benchmark(
     from ..parallel.sharding import shard_init
     from ..serve import EngineConfig, Request, Router, RouterConfig, \
         ServingEngine
+    from ..telemetry.trace import (Tracer, build_trees, hop_percentiles,
+                                   orphan_spans, trace_sum_gap)
 
     dtype = jnp.bfloat16 if dtype_name == "bfloat16" else jnp.float32
     if decode_kernel is None:
@@ -687,16 +746,43 @@ def run_router_benchmark(
                    for rep in router.replicas)
         return hits / (hits + miss) if hits + miss else 0.0, hits
 
-    def fleet_run(affinity):
+    def fleet_run(affinity, tracer=None):
         router = Router([mk_engine() for _ in range(replicas)],
                         RouterConfig(max_inflight=max_inflight,
-                                     affinity=affinity))
+                                     affinity=affinity),
+                        tracer=tracer)
         t0 = time.perf_counter()
         results = router.run(fresh_trace(trace))
         return router, results, time.perf_counter() - t0
 
-    on_router, on_results, on_wall = fleet_run(True)
+    # trace the measured (affinity-ON) arm at sample=1.0: every request
+    # must reconstruct into a queue_wait -> admission -> prefill ->
+    # decode span tree whose hop durations sum to the root e2e within
+    # tolerance — the front-door-to-final-token completeness gate
+    on_tracer = Tracer(sample=1.0)
+    on_router, on_results, on_wall = fleet_run(True, on_tracer)
     off_router, off_results, off_wall = fleet_run(False)
+
+    trace_ids = {r.id for r in trace}
+    trace_spans = [s for s in on_tracer.ring if s["trace"] in trace_ids
+                   or s["trace"] < 0]
+    trees = build_trees(trace_spans)
+    trace_gaps = []
+    trace_complete = len(orphan_spans(trace_spans)) == 0
+    for r in trace:
+        t = trees.get(r.id)
+        if t is None or t["root"] is None or t["root"]["status"] != "ok":
+            trace_complete = False
+            continue
+        gap = trace_sum_gap(t)
+        if gap is None:
+            trace_complete = False
+            continue
+        trace_gaps.append(gap)
+        if gap > max(0.005, 0.02 * t["root"]["seconds"]):
+            trace_complete = False
+    trace_hops = {f"router_hop_{k}": round(v, 3)
+                  for k, v in hop_percentiles(trace_spans).items()}
 
     ms = lambda v: round(v * 1e3, 3) if v is not None else None  # noqa: E731
     adm = lambda r: r.token_times[0] - r.admitted_at  # noqa: E731
@@ -768,6 +854,10 @@ def run_router_benchmark(
         "router_compile_pins_held": bool(
             pins_held(on_router) and pins_held(off_router)
             and pins_held(burst_router)),
+        **trace_hops,
+        "router_trace_complete": bool(trace_complete),
+        "router_trace_max_gap_ms": (round(max(trace_gaps) * 1e3, 3)
+                                    if trace_gaps else None),
     }
     log(f"router {name}: {num_requests} reqs over {replicas}x{slots} "
         f"slots at {out['router_offered_rps']} req/s offered: "
@@ -844,6 +934,8 @@ def run_livescale_benchmark(
         ServingEngine
     from ..telemetry.collector import resize_ledger
     from ..telemetry.events import LIVE_SCALE
+    from ..telemetry.trace import (Tracer, build_trees, hop_percentiles,
+                                   orphan_spans, trace_sum_gap)
 
     dtype = jnp.bfloat16 if dtype_name == "bfloat16" else jnp.float32
     if decode_kernel is None:
@@ -914,7 +1006,12 @@ def run_livescale_benchmark(
     warm_t0 = time.perf_counter()
     newcomer = mk_engine()
     attach_warmup = time.perf_counter() - warm_t0
-    live_router = Router([mk_engine() for _ in range(replicas)], cfg)
+    # trace the live arm end to end: requests that fail over off the
+    # draining replica must still reconstruct as ONE root whose hop
+    # chain stays contiguous across the replay
+    live_tracer = Tracer(sample=1.0)
+    live_router = Router([mk_engine() for _ in range(replicas)], cfg,
+                         tracer=live_tracer)
     live_router.schedule_attach(scale_up_at, newcomer,
                                 warmup_seconds=attach_warmup)
     live_router.schedule_detach(scale_down_at, 0)
@@ -929,6 +1026,25 @@ def run_livescale_benchmark(
     live_ttfts = [res.ttft for res in live_results.values()
                   if res.ttft >= 0.0]
     live_tokens = sum(len(r.tokens) for r in live_results.values())
+
+    live_ids = {r.id for r in trace}
+    live_spans = [s for s in live_tracer.ring if s["trace"] in live_ids
+                  or s["trace"] < 0]
+    live_trees = build_trees(live_spans)
+    live_gaps = []
+    live_trace_complete = len(orphan_spans(live_spans)) == 0
+    for r in trace:
+        t = live_trees.get(r.id)
+        if t is None or t["root"] is None or t["root"]["status"] != "ok":
+            live_trace_complete = False
+            continue
+        gap = trace_sum_gap(t)
+        if gap is None or gap > max(0.005, 0.02 * t["root"]["seconds"]):
+            live_trace_complete = False
+        if gap is not None:
+            live_gaps.append(gap)
+    live_hops = {f"livescale_hop_{k}": round(v, 3)
+                 for k, v in hop_percentiles(live_spans).items()}
 
     # the live steps through the REAL ledger reader (collector.py):
     # each live_scale record is self-contained, total = drain + warmup
@@ -1016,6 +1132,10 @@ def run_livescale_benchmark(
         "livescale_lost_throughput_pct": round(
             100.0 * (1.0 - (live_wall / gang_wall)), 1)
             if gang_wall else None,
+        **live_hops,
+        "livescale_trace_complete": bool(live_trace_complete),
+        "livescale_trace_max_gap_ms": (round(max(live_gaps) * 1e3, 3)
+                                       if live_gaps else None),
     }
     log(f"livescale {name}: {num_requests} reqs, +1@{scale_up_at}s / "
         f"-1@{scale_down_at}s: live TTFT p99 "

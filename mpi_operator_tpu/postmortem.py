@@ -36,6 +36,7 @@ from typing import Dict, List, Optional, Sequence, TextIO
 
 from .telemetry import events as ev
 from .telemetry.collector import goodput_ledger, resize_ledger
+from .telemetry.trace import build_trees, read_trace_spans, render_tree
 from .train.resilience import suggest_stop_check_every
 
 #: milestone kinds, i.e. records that OPEN a new lifecycle phase; every
@@ -53,6 +54,7 @@ INCIDENTS = (
     ev.CHECKPOINT_SAVED, ev.FIRST_RESUME_STEP, ev.DIVERGENCE_ROLLBACK,
     ev.FAULT_INJECTED, ev.REPLICA_FROZEN, ev.INIT_RETRY, ev.CLOCK_ANCHOR,
     ev.GANG_STUCK, ev.GANG_DEGRADED, ev.REQUEST_TIMEOUT,
+    ev.AUTOSCALE_BREACH,
 )
 
 #: fleet-scheduler decision kinds — rendered as their own section, with
@@ -74,7 +76,8 @@ _DETAIL_FIELDS = ("step", "from_step", "to_step", "last_observed_step",
                   "resharded", "stop_check_every", "path", "boot_id",
                   "stall_seconds", "progress_deadline_seconds",
                   "ranks", "partitioned_ranks", "total_ranks", "healed",
-                  "request", "new_tokens", "deadline_seconds")
+                  "request", "new_tokens", "deadline_seconds",
+                  "target", "trace", "exemplar_trace")
 
 
 def read_timeline(path: str) -> List[Dict]:
@@ -150,6 +153,11 @@ def summarize(records: Sequence[Dict]) -> Dict:
     # fleet-scheduler decisions, paired with the resize ledger below so a
     # preempt shows predicted vs MEASURED cost on one line
     sched_actions: List[Dict] = []
+    # SLO breaches with exemplar trace ids: autoscale_breach records
+    # (exemplar_trace=) and request-level incidents that name the trace
+    # directly (request_timeout's trace= IS the request id) — rendered
+    # as the "slow traces:" hop trees when a trace file is supplied
+    slo_breaches: List[Dict] = []
     for rec in records:
         kind = rec.get("event")
         entry = {
@@ -202,6 +210,12 @@ def summarize(records: Sequence[Dict]) -> Dict:
             if opened["stop_check_every"] is not None:
                 latency["stop_check_every"] = opened["stop_check_every"]
             drain_latencies.append(latency)
+        if kind in (ev.AUTOSCALE_BREACH, ev.REQUEST_TIMEOUT):
+            trace = rec.get("exemplar_trace", rec.get("trace"))
+            slo_breaches.append({
+                "t": entry["t"], "event": kind, "trace": trace,
+                "reason": rec.get("reason"),
+                "request": rec.get("request")})
         if kind in SCHED_EVENTS:
             action = {"t": entry["t"], "event": kind,
                       "job": rec.get("job")}
@@ -260,6 +274,7 @@ def summarize(records: Sequence[Dict]) -> Dict:
         "degraded": degraded,
         "resizes": resizes,
         "scheduler_actions": sched_actions,
+        "slo_breaches": slo_breaches,
         "other_events": other,
         "ledger": goodput_ledger(records),
     }
@@ -315,7 +330,8 @@ def _fmt_sched_action(a: Dict) -> str:
     return f"{kind}  {job}"
 
 
-def render(summary: Dict, out: TextIO) -> None:
+def render(summary: Dict, out: TextIO,
+           trees: Optional[Dict[int, Dict]] = None) -> None:
     job = summary["job"] or "<unknown>"
     out.write(f"postmortem: job {job} — {summary['records']} records over "
               f"{_fmt_duration(summary['span_seconds'])} from "
@@ -417,6 +433,33 @@ def render(summary: Dict, out: TextIO) -> None:
             out.write(f"  {i['t']:>9.3f}s  {i['host']:<12} "
                       f"{i['event']:<22}{detail}{drain}\n")
 
+    breaches = summary.get("slo_breaches") or []
+    if breaches:
+        out.write("\nslow traces:\n")
+        rendered = set()
+        for b in breaches:
+            tid = b.get("trace")
+            label = (f"request {b['request']}"
+                     if b.get("request") is not None else f"trace {tid}")
+            why = f": {b['reason']}" if b.get("reason") else ""
+            out.write(f"  {b['t']:>9.3f}s  {b['event']:<22} "
+                      f"{label}{why}\n")
+            if tid is None:
+                out.write("    exemplar pending (no trace id attached — "
+                          "sampled out or federation window empty)\n")
+                continue
+            tree = (trees or {}).get(tid)
+            if tree is None or tree.get("root") is None:
+                out.write(f"    exemplar pending (trace {tid} not in the "
+                          f"trace file yet)\n")
+                continue
+            if tid in rendered:
+                out.write(f"    (trace {tid} rendered above)\n")
+                continue
+            rendered.add(tid)
+            for line in render_tree(tree):
+                out.write(f"    {line}\n")
+
     if summary["other_events"]:
         pairs = ", ".join(f"{k}×{v}"
                           for k, v in sorted(summary["other_events"].items()))
@@ -442,6 +485,10 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     parser.add_argument("--json", action="store_true",
                         help="emit the machine-readable summary instead "
                              "of the human report")
+    parser.add_argument("--traces", default=None, metavar="PATH",
+                        help="traces.jsonl span log (telemetry/trace.py); "
+                             "lets the slow-traces section render each "
+                             "SLO breach's exemplar as a hop tree")
     args = parser.parse_args(argv)
 
     records = read_timeline(args.timeline)
@@ -449,12 +496,18 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         print(f"postmortem: no parseable event records in "
               f"{args.timeline}", file=sys.stderr)
         return 2
+    trees = None
+    if args.traces:
+        try:
+            trees = build_trees(read_trace_spans(args.traces))
+        except OSError:
+            trees = {}        # breaches render "exemplar pending"
     summary = summarize(records)
     if args.json:
         json.dump(summary, sys.stdout, indent=2, sort_keys=True)
         sys.stdout.write("\n")
     else:
-        render(summary, sys.stdout)
+        render(summary, sys.stdout, trees=trees)
     return 0
 
 

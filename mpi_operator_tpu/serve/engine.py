@@ -299,8 +299,13 @@ class ServingEngine:
     #: never holds decode tokens, so it only reserves the prompt span)
     RESERVE = "full"
 
+    #: the hop a request's trace enters when its prompt finishes
+    #: prefilling — decode here; the disaggregated PrefillEngine hands
+    #: off instead (telemetry/trace.py taxonomy)
+    POST_PREFILL_HOP = "serve.decode"
+
     def __init__(self, model, params, config: Optional[EngineConfig] = None,
-                 telemetry=None, events=None, drafter=None):
+                 telemetry=None, events=None, drafter=None, tracer=None):
         """telemetry: a telemetry.ServeTelemetry — live TTFT/TPOT/step
         histograms and queue/occupancy gauges (today these exist only as
         a post-hoc trace reduction in serve_benchmark); events: a
@@ -308,7 +313,13 @@ class ServingEngine:
         Both optional and None-cost when absent. drafter: the
         speculative="draft" proposal hook — callable(history, k) -> up
         to k candidate tokens (history = prompt + generated so far);
-        correctness never depends on what it returns."""
+        correctness never depends on what it returns. tracer: a
+        telemetry.Tracer — per-request span trees (admission / prefill
+        / decode hops on the session clock, batch-level decode/verify
+        spans under a per-session root). All tracing is host-side
+        bookkeeping: no device operand, no rng fold, no compiled
+        program changes — greedy tokens and compile pins are bitwise
+        identical with tracing on or off."""
         cfg = config or EngineConfig()
         mcfg = model.config
         if not mcfg.causal:
@@ -354,6 +365,11 @@ class ServingEngine:
         self._steps_dispatched = 0
         self.telemetry = telemetry
         self.events = events
+        self.tracer = tracer
+        # session clock for trace hops — set while a session (or the
+        # disaggregated run loop) is live; tracing is inert without it
+        self._trace_now: Optional[Callable[[], float]] = None
+        self._session_span = None
         if telemetry is not None:
             telemetry.slots.set(cfg.slots)
             if cfg.paged:
@@ -603,6 +619,8 @@ class ServingEngine:
         # engine replays a trace with identical draws
         self._steps_dispatched = 0
         self._session: Optional[Dict] = None
+        self._session_span = None
+        self._trace_now = None
         self.occupancy_peak = 0
         self.pages_in_use_peak = 0
         self.spec_proposed = 0
@@ -643,6 +661,27 @@ class ServingEngine:
                                           if self.spec_rows else 0.0),
         }
 
+    # -- tracing ----------------------------------------------------------
+
+    def _trace(self, rid: int):
+        """The open RequestTrace for request `rid`, or None — tracer
+        absent, id sampled out, or no session clock to stamp hops with.
+        One dict probe on the traced path, zero work otherwise."""
+        if self.tracer is None or self._trace_now is None:
+            return None
+        return self.tracer.active(rid)
+
+    def trace_abandon(self, now: float) -> None:
+        """This engine is being killed/dropped mid-session (router
+        failover): close its per-session trace root so already-recorded
+        batch spans keep a parent — the zero-orphans invariant. The
+        router abandons each in-flight REQUEST trace itself; those
+        roots stay open for the replay on a surviving replica."""
+        if self._session_span is not None:
+            self._session_span.abandon(now)
+            self._session_span = None
+        self._trace_now = None
+
     # -- the loop ---------------------------------------------------------
 
     def _run_prefill_chunk(self, st: RequestState) -> None:
@@ -660,6 +699,10 @@ class ServingEngine:
             # decode step's sync absorbs any queued prefill work
             self.telemetry.prefill_seconds.observe(time.perf_counter() - t0)
         st.pos = min(p1, w + size)
+        if not st.chunks:
+            rt = self._trace(st.req.id)
+            if rt is not None:
+                rt.begin_hop(self.POST_PREFILL_HOP, self._trace_now())
 
     def _page_table_array(self) -> np.ndarray:
         """[S, nblk] physical-page tables for every slot row; free rows
@@ -702,6 +745,10 @@ class ServingEngine:
             self.telemetry.prefill_seconds.observe(time.perf_counter() - t0)
         for st, w, p1 in done:
             st.pos = max(st.pos, min(p1, w + size))
+            if not st.chunks:
+                rt = self._trace(st.req.id)
+                if rt is not None:
+                    rt.begin_hop(self.POST_PREFILL_HOP, self._trace_now())
             if self.config.prefix_cache:
                 self._publish_prompt_pages(st)
 
@@ -888,6 +935,7 @@ class ServingEngine:
         ps = cfg.page_size if cfg.paged else None
         finished: List[RequestState] = []
         self.spec_steps += 1
+        spec_p0, spec_a0 = self.spec_proposed, self.spec_accepted
         for st in consumers:
             d = planned.get(st.slot, [])
             row_t, row_l = tg[st.slot], lp[st.slot]
@@ -939,6 +987,17 @@ class ServingEngine:
                 st.finish_reason = "length"
             if st.done:
                 finished.append(st)
+        if self._session_span is not None:
+            # batch-level verify span under the session root, stamped
+            # at sync on the session clock; acceptance counts ride as
+            # attributes (the per-request roots cannot own a span that
+            # served the whole batch)
+            dur = t_sync - step_t0
+            self._session_span.child(
+                "serve.verify_step", now - dur, dur,
+                batch=len(consumers),
+                proposed=self.spec_proposed - spec_p0,
+                accepted=self.spec_accepted - spec_a0)
         return finished
 
     def _sync_decode_step(self, pending, now_fn, on_token=None) \
@@ -962,6 +1021,10 @@ class ServingEngine:
             # mode this spans the loop iteration that hid under it)
             tel.decode_step_seconds.observe(t_sync - step_t0)
         now = now_fn()
+        if self._session_span is not None:
+            dur = t_sync - step_t0
+            self._session_span.child("serve.decode_step", now - dur, dur,
+                                     batch=len(consumers))
         finished = []
         for st in consumers:
             if st.done:
@@ -998,6 +1061,15 @@ class ServingEngine:
             if timeout is not None:
                 st.deadline = st.admitted_at + timeout
             self.slots.bind(st)
+            rt = self._trace(st.req.id)
+            if rt is not None:
+                # admission hop ends where the scheduler stamped it; a
+                # fully-cached prompt has no chunks and skips straight
+                # to the post-prefill hop
+                rt.begin_hop("serve.prefill" if st.chunks
+                             else self.POST_PREFILL_HOP,
+                             st.admitted_at,
+                             cached_tokens=st.cached_tokens)
             if self.events is not None:
                 self.events.emit(ev.SLOT_ADMIT, request=st.req.id,
                                  slot=st.slot,
@@ -1035,6 +1107,13 @@ class ServingEngine:
                 new_tokens=len(st.generated))
         if self.telemetry is not None:
             self.telemetry.requests_total.inc()
+        rt = self._trace(st.req.id)
+        if rt is not None:
+            rt.attrs.update(finish_reason=st.finish_reason,
+                            new_tokens=len(st.generated),
+                            cached_tokens=st.cached_tokens)
+            rt.finish("timeout" if st.finish_reason == "timeout"
+                      else "ok", self._trace_now())
         results[st.req.id] = RequestResult(
             id=st.req.id, tokens=list(st.generated),
             logprobs=list(st.logprobs),
@@ -1064,11 +1143,14 @@ class ServingEngine:
             st.chunks = []        # a mid-prefill request stops consuming
             #                       windows; nothing re-plans a done state
             if self.events is not None:
+                # trace= pairs the incident with its span tree — the
+                # postmortem "slow traces:" exemplar link
                 self.events.emit(ev.REQUEST_TIMEOUT, request=st.req.id,
                                  slot=st.slot,
                                  new_tokens=len(st.generated),
                                  deadline_seconds=self.config
-                                 .request_timeout)
+                                 .request_timeout,
+                                 trace=st.req.id)
             self._retire_state(st, results)
 
     # -- steppable session (the router drives replicas through these) -----
@@ -1088,6 +1170,10 @@ class ServingEngine:
             now_fn = lambda: time.perf_counter() - t0   # noqa: E731
         self._session = {"results": {}, "pending": None,
                          "on_token": on_token, "now_fn": now_fn}
+        self._trace_now = now_fn
+        if self.tracer is not None:
+            self._session_span = self.tracer.begin_session(
+                now_fn(), slots=self.config.slots)
 
     def set_heartbeat(self, hook: Callable[..., None],
                       interval: float) -> None:
@@ -1138,6 +1224,16 @@ class ServingEngine:
                     f"pages but the pool has {alloc.usable} usable "
                     f"(raise num_pages or lower max_new_tokens)")
         self.scheduler.submit(req)
+        if self.tracer is not None:
+            # open (or, behind a router / on a failover replay, JOIN)
+            # this request's trace — the router's queue-wait hop closes
+            # where admission begins
+            rt = self.tracer.begin_request(
+                req.id, t0=req.arrival, prompt_len=len(req.prompt),
+                max_new_tokens=req.max_new_tokens)
+            if rt is not None:
+                rt.begin_hop("serve.admission",
+                             max(req.arrival, self._session["now_fn"]()))
 
     @property
     def active(self) -> bool:
@@ -1254,6 +1350,10 @@ class ServingEngine:
             tel.prefill_compiles.set(counts["prefill"])
             tel.queue_depth.set(len(self.scheduler.queue))
             tel.slot_occupancy.set(self.slots.occupied)
+        if self._session_span is not None:
+            self._session_span.end(sess["now_fn"]())
+            self._session_span = None
+        self._trace_now = None
         self._session = None
         return sess["results"]
 
@@ -1284,6 +1384,8 @@ class ServingEngine:
                     if nxt is not None and nxt > now:
                         time.sleep(min(nxt - now, 0.05))
         except Exception:
+            if self._session is not None:
+                self.trace_abandon(self._session["now_fn"]())
             self._session = None
             raise
         return self.finish()
@@ -1302,8 +1404,12 @@ class PrefillEngine(ServingEngine):
 
     RESERVE = "prompt"
 
+    #: a prefilled prompt's next hop in this pool is the page handoff,
+    #: not decode — trace hop names follow the disaggregated flow
+    POST_PREFILL_HOP = "serve.kv_handoff"
+
     def __init__(self, model, params, config: Optional[EngineConfig] = None,
-                 telemetry=None, events=None):
+                 telemetry=None, events=None, tracer=None):
         cfg = config or EngineConfig()
         if not cfg.paged:
             raise ValueError("disaggregated serving requires paged=True "
@@ -1314,7 +1420,7 @@ class PrefillEngine(ServingEngine):
         if cfg.speculative is not None:
             cfg = dataclasses.replace(cfg, speculative=None)
         super().__init__(model, params, cfg, telemetry=telemetry,
-                         events=events)
+                         events=events, tracer=tracer)
 
     def take_prefilled(self) -> List[RequestState]:
         """Pop every state whose prefill just completed: it leaves the
@@ -1342,13 +1448,13 @@ class DecodeEngine(ServingEngine):
     the misses)."""
 
     def __init__(self, model, params, config: Optional[EngineConfig] = None,
-                 telemetry=None, events=None, drafter=None):
+                 telemetry=None, events=None, drafter=None, tracer=None):
         cfg = config or EngineConfig()
         if not cfg.paged:
             raise ValueError("disaggregated serving requires paged=True "
                              "(the handoff unit is a page list)")
         super().__init__(model, params, cfg, telemetry=telemetry,
-                         events=events, drafter=drafter)
+                         events=events, drafter=drafter, tracer=tracer)
 
     def install_handoff(self, req: Request, reserved, now: float,
                         cached_tokens: int = 0,
@@ -1441,7 +1547,8 @@ class DisaggEngine:
 
     def __init__(self, model, params, config: Optional[EngineConfig] = None,
                  *, prefill_config: Optional[EngineConfig] = None,
-                 registry=None, events=None, devices=None, drafter=None):
+                 registry=None, events=None, devices=None, drafter=None,
+                 tracer=None):
         cfg = config or EngineConfig(paged=True)
         pcfg = prefill_config or cfg
         if not cfg.paged or not pcfg.paged:
@@ -1468,12 +1575,14 @@ class DisaggEngine:
         # downstream (cast, init_cache, prefill/step, transfer
         # gather/scatter) follows its committed operands, so the two
         # engines' programs land on the two devices with no mesh code
+        self.tracer = tracer
         self.prefill = PrefillEngine(
             model, jax.device_put(params, self.devices[0]), pcfg,
-            telemetry=pre_tel, events=pre_ev)
+            telemetry=pre_tel, events=pre_ev, tracer=tracer)
         self.decode = DecodeEngine(
             model, jax.device_put(params, self.devices[1]), cfg,
-            telemetry=dec_tel, events=dec_ev, drafter=drafter)
+            telemetry=dec_tel, events=dec_ev, drafter=drafter,
+            tracer=tracer)
         self.transfer = PageTransfer(self.prefill.page_allocator.num_pages,
                                      self.decode.page_allocator.num_pages)
         self.config = cfg
@@ -1550,6 +1659,14 @@ class DisaggEngine:
         st.owned_pages = []
         dt = time.perf_counter() - t0     # host wall, async-dispatch
         self.handoff_log.append((dt, moved, chain_hits))
+        rt = dec._trace(st.req.id)
+        if rt is not None:
+            # page counts land on the kv_handoff hop (which spans
+            # prefill-done → installed here, queue wait included), then
+            # the decode hop opens
+            rt.hop_attrs(pages=moved, cached_pages=chain_hits,
+                         move_seconds=round(dt, 6))
+            rt.begin_hop("serve.decode", now)
         if dec.telemetry is not None:
             dec.telemetry.kv_handoff_seconds.observe(dt)
             dec.telemetry.kv_handoff_pages.inc(moved)
@@ -1580,9 +1697,14 @@ class DisaggEngine:
                 self.events.emit(ev.REQUEST_TIMEOUT, request=st.req.id,
                                  slot=st.slot, new_tokens=0,
                                  deadline_seconds=pre.config
-                                 .request_timeout)
+                                 .request_timeout,
+                                 trace=st.req.id)
             if pre.telemetry is not None:
                 pre.telemetry.requests_total.inc()
+            rt = pre._trace(st.req.id)
+            if rt is not None:
+                rt.attrs.update(finish_reason="timeout", new_tokens=0)
+                rt.finish("timeout", now)
             results[st.req.id] = RequestResult(
                 id=st.req.id, tokens=[], logprobs=[],
                 finish_reason="timeout", ttft=-1.0, token_times=[],
@@ -1630,8 +1752,23 @@ class DisaggEngine:
                     f"but the prefill pool has "
                     f"{pre.page_allocator.usable} usable")
             pre.scheduler.submit(r)
+            if self.tracer is not None:
+                rt = self.tracer.begin_request(
+                    r.id, t0=r.arrival, prompt_len=len(r.prompt),
+                    max_new_tokens=r.max_new_tokens, disagg=True)
+                if rt is not None:
+                    # the facade has no front door queue: admission
+                    # starts at arrival (the run clock starts at 0)
+                    rt.begin_hop("serve.admission", r.arrival)
         t0 = time.perf_counter()
         now_fn = lambda: time.perf_counter() - t0   # noqa: E731
+        # both pools stamp trace hops on the SAME run clock, so a
+        # request's prefill/handoff/decode hops stay contiguous across
+        # the pool boundary
+        pre._trace_now = dec._trace_now = now_fn
+        if self.tracer is not None:
+            dec._session_span = self.tracer.begin_session(
+                now_fn(), slots=dec.config.slots, pool="decode")
         results: Dict[int, RequestResult] = {}
         pending = None
         while not (pre.scheduler.idle and not self._handoff_q
@@ -1710,6 +1847,10 @@ class DisaggEngine:
                 eng.telemetry.prefill_compiles.set(counts["prefill"])
                 eng.telemetry.queue_depth.set(0)
                 eng.telemetry.slot_occupancy.set(eng.slots.occupied)
+        if dec._session_span is not None:
+            dec._session_span.end(now_fn())
+            dec._session_span = None
+        pre._trace_now = dec._trace_now = None
         return results
 
 

@@ -75,6 +75,7 @@ import time
 from dataclasses import dataclass, field
 from typing import Callable, Dict, List, Optional, Sequence
 
+from ..telemetry import span
 from .engine import Request, RequestResult, ServingEngine
 from .scheduler import Scheduler
 
@@ -180,9 +181,13 @@ class Router:
 
     def __init__(self, engines: Sequence[ServingEngine],
                  config: Optional[RouterConfig] = None,
-                 telemetry=None):
+                 telemetry=None, tracer=None):
         """telemetry: a telemetry.RouterTelemetry (optional,
-        None-cost when absent)."""
+        None-cost when absent). tracer: a telemetry.Tracer — the router
+        opens each request's ROOT span at intake (queue-wait hop,
+        dispatch/shed/failover span events) and shares the tracer with
+        every replica engine that doesn't have its own, so one request
+        keeps ONE trace no matter how many replicas serve it."""
         if not engines:
             raise ValueError("router needs at least one engine replica")
         cfg = config or RouterConfig()
@@ -192,6 +197,9 @@ class Router:
         self.config = cfg
         self.replicas = [ReplicaHandle(i, e) for i, e in enumerate(engines)]
         self.telemetry = telemetry
+        self.tracer = tracer
+        for rep in self.replicas:
+            self._share_tracer(rep.engine)
         self.results: Dict[int, RequestResult] = {}
         self.shed: Dict[int, RequestResult] = {}
         self.resubmitted_total = 0
@@ -208,6 +216,22 @@ class Router:
         self._now_fn: Optional[Callable[[], float]] = None
 
     # -- routing policy ---------------------------------------------------
+
+    def _share_tracer(self, engine) -> None:
+        """Hand the router's tracer to a replica engine that has none —
+        the engine-side hops (admission/prefill/decode) land in the
+        SAME trace registry the router's root spans live in. Tolerates
+        engines (test fakes) that don't carry the attribute."""
+        if self.tracer is None \
+                or getattr(engine, "tracer", None) is not None:
+            return
+        try:
+            engine.tracer = self.tracer
+        except AttributeError:
+            pass
+
+    def _trace(self, rid: int):
+        return self.tracer.active(rid) if self.tracer is not None else None
 
     def _live(self) -> List[ReplicaHandle]:
         return [r for r in self.replicas if r.alive]
@@ -272,6 +296,10 @@ class Router:
             ttft=-1.0, token_times=[], cached_tokens=0, admitted_at=now)
         if self.telemetry is not None:
             self.telemetry.shed_total.inc()
+        rt = self._trace(req.id)
+        if rt is not None:
+            rt.event("shed")
+            rt.finish("shed", now)
 
     def _dispatch(self, req: Request, now: float) -> bool:
         """Route one due request: pick a replica (or shed), record the
@@ -296,6 +324,12 @@ class Router:
             tel.affinity_miss_pages.inc(full - warm)
             if now >= req.arrival:
                 tel.queue_wait_seconds.observe(now - req.arrival)
+        rt = self._trace(req.id)
+        if rt is not None:
+            # the dispatch decision as a span event on the root; the
+            # engine's submit() closes the queue-wait hop where its
+            # admission hop begins
+            rt.event("dispatch", replica=rep.index, warm_pages=warm)
         rep.engine.submit(req)
         rep.inflight[req.id] = req
         rep.dispatched_total += 1
@@ -313,6 +347,11 @@ class Router:
         rep.draining = False
         if self.telemetry is not None:
             self.telemetry.replica_deaths.inc()
+        # the dead engine's per-session trace root closes as a failover
+        # casualty so its batch spans keep a parent (zero orphans)
+        abandon = getattr(rep.engine, "trace_abandon", None)
+        if abandon is not None:
+            abandon(now)
         for req in rep.inflight.values():
             replay = Request(
                 id=req.id, prompt=list(req.prompt),
@@ -323,6 +362,14 @@ class Router:
             self.resubmitted_total += 1
             if self.telemetry is not None:
                 self.telemetry.resubmits_total.inc()
+            rt = self._trace(req.id)
+            if rt is not None:
+                # ONE trace across replicas: the open hop dies with the
+                # replica, the root survives into the replay's fresh
+                # queue-wait hop
+                rt.event("failover", replica=rep.index)
+                rt.abandon(now)
+                rt.begin_hop("router.queue_wait", now)
         rep.inflight.clear()
 
     # -- live topology (the autoscaler's surgical ±1 path) -----------------
@@ -376,14 +423,18 @@ class Router:
         records how long it took, for the live_scale ledger entry).
         Outside a session the handle simply joins the roster and run()
         starts it with the rest."""
-        self._require_warm(engine)
-        now = self._now(now)
-        idx = max(r.index for r in self.replicas) + 1
-        rep = ReplicaHandle(idx, engine)
-        self.replicas.append(rep)
-        if self._now_fn is not None:
-            engine.start(self._on_token, now_fn=self._now_fn)
-            self._wire_heartbeat(rep)
+        # host-span coverage for the attach path: live-scale stalls
+        # (warm check + session join) show up in XProf captures
+        with span("router.attach_replica"):
+            self._require_warm(engine)
+            now = self._now(now)
+            idx = max(r.index for r in self.replicas) + 1
+            rep = ReplicaHandle(idx, engine)
+            self.replicas.append(rep)
+            self._share_tracer(engine)
+            if self._now_fn is not None:
+                engine.start(self._on_token, now_fn=self._now_fn)
+                self._wire_heartbeat(rep)
         self.live_scale_log.append({
             "action": "attach", "replica": idx,
             "ts": round(now, 6),
@@ -434,6 +485,11 @@ class Router:
             self.resubmitted_total += 1
             if self.telemetry is not None:
                 self.telemetry.resubmits_total.inc()
+            rt = self._trace(q.id)
+            if rt is not None:
+                rt.event("drain_requeue", replica=rep.index)
+                rt.abandon(now)
+                rt.begin_hop("router.queue_wait", replay.arrival)
         self._backlog.sort(key=lambda r: r.arrival)
 
     def schedule_attach(self, at: float, engine,
@@ -476,8 +532,11 @@ class Router:
                 continue
             if rep.inflight or rep.engine.active:
                 continue
-            self._collect(rep, final=rep.engine.finish())
-            self._verify_reclaim(rep)
+            # host-span coverage for the drain finalize (session close
+            # + reclaim audit) — the other half of a live-scale stall
+            with span("router.service_drain"):
+                self._collect(rep, final=rep.engine.finish())
+                self._verify_reclaim(rep)
             rep.alive = False
             rep.draining = False
             rep.detached = True
@@ -539,6 +598,14 @@ class Router:
             if r.id in seen:
                 raise ValueError(f"duplicate request id {r.id}")
             seen.add(r.id)
+            if self.tracer is not None:
+                # ROOT span at the front door, t0 = arrival; the
+                # queue-wait hop runs until dispatch closes it
+                rt = self.tracer.begin_request(
+                    r.id, t0=r.arrival, prompt_len=len(r.prompt),
+                    max_new_tokens=r.max_new_tokens)
+                if rt is not None:
+                    rt.begin_hop("router.queue_wait", r.arrival)
         try:
             while True:
                 now = now_fn()

@@ -39,6 +39,8 @@ from typing import Dict, List, Sequence, Tuple
 import jax
 import jax.numpy as jnp
 
+from ..telemetry import span
+
 
 def _bucket(n: int) -> int:
     """Smallest power of two >= n (>= 1)."""
@@ -98,11 +100,16 @@ class PageTransfer:
         pad = [self.TRASH] * (width - n)
         sids = jnp.asarray(list(src_ids) + pad, jnp.int32)
         dids = jnp.asarray(list(dst_ids) + pad, jnp.int32)
-        payload = self._gather(src_cache, sids)
-        dst_dev = self._device_of(dst_cache)
-        if dst_dev is not None:
-            payload = jax.device_put(payload, dst_dev)
-        dst_cache = self._scatter(dst_cache, dids, payload)
+        # the same span name the request trace's kv_handoff hop uses
+        # (telemetry/trace.py taxonomy), scoped to the actual page move
+        # so an XProf capture attributes gather/copy/scatter separately
+        # from the install bookkeeping around it
+        with span("serve.kv_handoff.move"):
+            payload = self._gather(src_cache, sids)
+            dst_dev = self._device_of(dst_cache)
+            if dst_dev is not None:
+                payload = jax.device_put(payload, dst_dev)
+            dst_cache = self._scatter(dst_cache, dids, payload)
         self.pages_moved += n
         return dst_cache, n
 

@@ -2,8 +2,8 @@
 job-level collector, and XProf span annotations. See core.py for the
 design constraints and collector.py for the operator-side job view."""
 from .collector import (ClockSync, JobObservatory, MetricsFederation,
-                        goodput_ledger, merge_timeline, parse_prometheus,
-                        resize_ledger, resize_lines)
+                        TraceFederation, goodput_ledger, merge_timeline,
+                        parse_prometheus, resize_ledger, resize_lines)
 from .core import Counter, Gauge, Histogram, Registry
 from .events import (BoundEventLog, EventLog, read_events,
                      PREEMPTION_DRAIN, EMERGENCY_CHECKPOINT,
@@ -17,12 +17,15 @@ from .events import (BoundEventLog, EventLog, read_events,
 from .prometheus import (CONTENT_TYPE, TelemetryServer, escape_label_value,
                          format_value, histogram_lines, render_registry)
 from .spans import span
+from .trace import (RequestTrace, SessionSpan, Tracer, build_trees,
+                    hop_percentiles, read_trace_spans, render_tree)
 from .worker import (
     RouterTelemetry, ServeTelemetry, TrainTelemetry, WorkerTelemetry,
 )
 
 __all__ = [
-    "ClockSync", "JobObservatory", "MetricsFederation", "goodput_ledger",
+    "ClockSync", "JobObservatory", "MetricsFederation", "TraceFederation",
+    "goodput_ledger",
     "merge_timeline", "parse_prometheus", "resize_ledger", "resize_lines",
     "Counter", "Gauge", "Histogram", "Registry",
     "BoundEventLog", "EventLog", "read_events",
@@ -36,6 +39,8 @@ __all__ = [
     "CONTENT_TYPE", "TelemetryServer", "escape_label_value", "format_value",
     "histogram_lines", "render_registry",
     "span",
+    "RequestTrace", "SessionSpan", "Tracer", "build_trees",
+    "hop_percentiles", "read_trace_spans", "render_tree",
     "RouterTelemetry", "ServeTelemetry", "TrainTelemetry",
     "WorkerTelemetry",
 ]

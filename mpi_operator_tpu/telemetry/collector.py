@@ -57,6 +57,8 @@ from typing import Callable, Dict, Iterable, List, Optional, Tuple
 from . import events as ev
 from .events import EventLog, _env_int, read_events, rotate_chain
 from .prometheus import escape_label_value, format_value
+from .trace import (REQUEST_ROOT, SPAN, TRACE_HOP_BUCKETS, build_trees,
+                    hop_name)
 
 logger = logging.getLogger("mpi_operator_tpu.telemetry.collector")
 
@@ -464,6 +466,162 @@ def merge_timeline(sources: List[Tuple[Optional[str], List[Dict]]],
 
 
 # ---------------------------------------------------------------------------
+# cross-pod request-trace federation
+# ---------------------------------------------------------------------------
+
+class TraceFederation:
+    """Per-job span-record federation: cross-pod trace trees, hop-latency
+    histograms, and slowest-trace exemplars.
+
+    ingest() takes one pod's batch of span records (telemetry/trace.py
+    schema, straight from traces.jsonl / a /traces pull / a push report)
+    plus that pod's clock offset from the SAME ClockSync the event
+    timeline uses, so a span's wall ``ts`` lands on the controller clock.
+    Re-ingesting a file every scrape is the normal mode — dedup is by
+    (pod, trace, span), so repeated pulls are idempotent and a replayed
+    failover span (same ids, emitted once by construction) can never
+    double-count a hop.
+
+    Hop durations come from the span's own ``seconds`` (one monotonic
+    session clock per pod — no correction needed); only cross-pod
+    ORDERING uses the corrected wall stamp. Aggregates:
+
+    * ``tpu_job_trace_hop_seconds{hop=...}`` histograms over the shared
+      TRACE_HOP_BUCKETS edges, one label set per hop name
+    * slowest-K completed request traces in the trailing ``window``
+      seconds, the SLO-breach exemplar pool (``slowest_trace()``)
+    """
+
+    EXEMPLAR_K = 5
+
+    def __init__(self, job: str, clock: Callable[[], float] = time.time,
+                 window: float = 600.0,
+                 extra_labels: Optional[Dict[str, str]] = None):
+        self.job = job
+        self.clock = clock
+        self.window = float(window)
+        self.extra_labels = dict(extra_labels or {})
+        self._seen: set = set()
+        #: trace id -> every span record federated for it (pod-stamped)
+        self.spans: Dict[int, List[Dict]] = {}
+        #: hop name -> {"buckets": [per TRACE_HOP_BUCKETS edge], "sum",
+        #: "count"} — cumulative render happens at render_lines time
+        self.hops: Dict[str, Dict] = {}
+        #: [(root seconds, trace id, arrival wall ts)] slowest-first
+        self._exemplars: List[Tuple[float, int, float]] = []
+
+    def ingest(self, pod: str, records: Iterable[Dict],
+               offset: float = 0.0) -> int:
+        """Fold one pod's span batch in; returns the count of NEW spans
+        (already-seen ids skip everything, including the histograms)."""
+        fresh = 0
+        for rec in records:
+            if rec.get("event") != SPAN:
+                continue
+            trace, span_id = rec.get("trace"), rec.get("span")
+            key = (pod, trace, span_id)
+            if trace is None or span_id is None or key in self._seen:
+                continue
+            self._seen.add(key)
+            fresh += 1
+            out = dict(rec)
+            out["pod"] = pod
+            if offset and "ts" in out:
+                out["ts_raw"] = out["ts"]
+                out["ts"] = round(out["ts"] + offset, 3)
+            self.spans.setdefault(trace, []).append(out)
+            if trace < 0:           # session spans carry no request hops
+                continue
+            if out.get("parent") is not None:
+                self._observe_hop(hop_name(out), float(out["seconds"]))
+            elif out.get("name") == REQUEST_ROOT:
+                self._note_exemplar(trace, float(out["seconds"]),
+                                    float(out.get("ts", self.clock())))
+        return fresh
+
+    def _observe_hop(self, hop: str, seconds: float) -> None:
+        h = self.hops.setdefault(hop, {
+            "buckets": [0] * len(TRACE_HOP_BUCKETS), "sum": 0.0,
+            "count": 0})
+        for i, edge in enumerate(TRACE_HOP_BUCKETS):
+            if seconds <= edge:
+                h["buckets"][i] += 1
+                break
+        h["sum"] += seconds
+        h["count"] += 1
+
+    def _note_exemplar(self, trace: int, seconds: float, ts: float) -> None:
+        self._exemplars.append((seconds, trace, ts))
+        self._exemplars.sort(key=lambda e: -e[0])
+        self._prune(self.clock())
+
+    def _prune(self, now: float) -> None:
+        live = [e for e in self._exemplars if now - e[2] <= self.window]
+        del self._exemplars[:]
+        self._exemplars.extend(live[:self.EXEMPLAR_K])
+
+    # -- accessors --------------------------------------------------------
+
+    def exemplars(self) -> List[Tuple[float, int]]:
+        """[(root seconds, trace id)] slowest-first, window-pruned."""
+        self._prune(self.clock())
+        return [(s, t) for s, t, _ts in self._exemplars]
+
+    def slowest_trace(self) -> Optional[int]:
+        """Trace id of the slowest completed request in the window —
+        what an SLO-breach record attaches as its exemplar."""
+        ex = self.exemplars()
+        return ex[0][1] if ex else None
+
+    def tree(self, trace: int) -> Optional[Dict]:
+        """build_trees-shaped {"root", "spans"} for one trace id, or
+        None when no span of it has federated yet."""
+        spans = self.spans.get(trace)
+        if not spans:
+            return None
+        return build_trees(spans).get(trace)
+
+    def trees(self) -> Dict[int, Dict]:
+        """Every federated trace reconstructed (sessions included)."""
+        return build_trees(s for lst in self.spans.values() for s in lst)
+
+    # -- rendering --------------------------------------------------------
+
+    def _labels(self, extra: Optional[Dict] = None) -> str:
+        merged = {"job": self.job, **self.extra_labels}
+        if extra:
+            merged.update(extra)
+        inner = ",".join(f'{k}="{escape_label_value(v)}"'
+                         for k, v in merged.items())
+        return "{" + inner + "}"
+
+    def render_lines(self) -> List[str]:
+        if not self.hops:
+            return []
+        name = "tpu_job_trace_hop_seconds"
+        lines = [f"# HELP {name} request-trace hop duration by hop name, "
+                 f"federated across pods",
+                 f"# TYPE {name} histogram"]
+        for hop in sorted(self.hops):
+            h = self.hops[hop]
+            cum = 0
+            for edge, c in zip(TRACE_HOP_BUCKETS, h["buckets"]):
+                cum += c
+                lines.append(
+                    f"{name}_bucket"
+                    f"{self._labels({'hop': hop, 'le': format_value(edge)})}"
+                    f" {cum}")
+            lines.append(f"{name}_bucket"
+                         f"{self._labels({'hop': hop, 'le': '+Inf'})}"
+                         f" {h['count']}")
+            lines.append(f"{name}_sum{self._labels({'hop': hop})} "
+                         f"{format_value(round(h['sum'], 6))}")
+            lines.append(f"{name}_count{self._labels({'hop': hop})} "
+                         f"{h['count']}")
+        return lines
+
+
+# ---------------------------------------------------------------------------
 # restart-aware goodput
 # ---------------------------------------------------------------------------
 
@@ -757,6 +915,7 @@ class JobObservatory:
             "created": False, "pods_ready": False, "first_step": False,
             "terminal": False, "labels": {},
             "federation": MetricsFederation(job, clock=self.clock),
+            "traces": TraceFederation(job, clock=self.clock),
             "clock_sync": ClockSync(),
             "controller_records": [], "worker_records": {},
             "last_scrape": 0.0,
@@ -981,6 +1140,21 @@ class JobObservatory:
                                     payload.get("now", self.clock()),
                                     latest_boot_id(records))
             view["worker_records"][host] = records
+            try:
+                tpayload = json.loads(
+                    self._scrape(rank, base + "/traces"))
+            except Exception:
+                # best-effort like /events: a pod without a trace sink
+                # 404s here and its metrics still count
+                continue
+            view["traces"].ingest(host, tpayload.get("records", []),
+                                  offset=view["clock_sync"].offset(host))
+        self._advance_frontier(job, view, now)
+
+    def _advance_frontier(self, job: str, view: Dict, now: float) -> None:
+        """Post-ingest progress bookkeeping, shared by the scrape loop
+        and ingest_push so a pushed report renews the progress lease
+        exactly like a scraped one."""
         step = self._observed_step(view)
         if step > 0 and not view["first_step"]:
             view["first_step"] = True
@@ -1010,6 +1184,52 @@ class JobObservatory:
             if slope_ok:
                 view["progress_step"] = step
                 view["progress_ts"] = now
+
+    def ingest_push(self, job: str, rank: int, payload: Dict,
+                    host: Optional[str] = None,
+                    serving: Optional[bool] = None) -> bool:
+        """Accept one pushed worker report (WorkerTelemetry.push_report())
+        with scrape-identical bookkeeping: the metrics text feeds the
+        same federation, the ``now`` stamp anchors the same clock
+        correction, event records land in the same per-host cache (same
+        staleness convention — a pod that stops pushing goes stale just
+        like one that stops answering scrapes), and trace spans federate
+        the same way. The payload is routed through the scrape-fault
+        injector when one is installed, so --chaos drops/replays pushes
+        on the exact surface it drops scrapes. Returns False when the
+        report was lost or unparseable (counted as a failed scrape)."""
+        view = self.view(job)
+        if serving is not None:
+            view["serving"] = bool(serving)
+        now = self.clock()
+        host = host or f"push-{rank}"
+        fed = view["federation"]
+        body = json.dumps(payload)
+        try:
+            if self.scrape_injector is not None:
+                body = self.scrape_injector.fetch(
+                    rank, f"push://{host}/report", lambda _url: body)
+            report = json.loads(body)
+            fed.ingest(rank, report.get("metrics", ""))
+        except Exception:
+            fed.scrape_failed(rank)
+            return False
+        records = report.get("events") or []
+        view["clock_sync"].note(host, now, report.get("now", now),
+                                latest_boot_id(records))
+        if records:
+            view["worker_records"][host] = records
+        traces = report.get("traces") or []
+        if traces:
+            view["traces"].ingest(host, traces,
+                                  offset=view["clock_sync"].offset(host))
+        self._advance_frontier(job, view, now)
+        return True
+
+    def slowest_trace(self, job: str) -> Optional[int]:
+        """The job's slowest completed request trace in the exemplar
+        window — what an SLO-breach record attaches as its exemplar."""
+        return self.view(job)["traces"].slowest_trace()
 
     def _observed_step(self, view: Dict) -> int:
         if view.get("serving"):
@@ -1093,6 +1313,7 @@ class JobObservatory:
             view = self.jobs[job]
             merged = self.merged_records(job)
             lines += view["federation"].render_lines()
+            lines += view["traces"].render_lines()
             lines += ledger_lines(job, goodput_ledger(merged),
                                   extra_labels=view["labels"])
             resizes = resize_ledger(merged)
@@ -1189,7 +1410,7 @@ if __name__ == "__main__":
 
 
 __all__ = ["parse_prometheus", "MetricsFederation", "ClockSync",
-           "merge_timeline", "goodput_ledger", "ledger_lines",
-           "resize_ledger", "resize_lines", "RESIZE_BUCKETS",
-           "JobObservatory", "latest_boot_id", "main",
+           "TraceFederation", "merge_timeline", "goodput_ledger",
+           "ledger_lines", "resize_ledger", "resize_lines",
+           "RESIZE_BUCKETS", "JobObservatory", "latest_boot_id", "main",
            "WORKER_PREFIX", "ROUTER_PREFIX", "JOB_PREFIX"]

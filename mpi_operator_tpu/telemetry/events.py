@@ -95,6 +95,13 @@ GANG_RESIZE = "gang_resize"
 # newest entry OF ITS OWN KIND so one expensive gang resize cannot pin
 # live-scale reaction times. scripts/tier1.sh greps for this literal.
 LIVE_SCALE = "live_scale"
+# SLO-breach-driven autoscale decision (controller/autoscale.py): a
+# persisted p99/queue breach the controller acted on. Carries the
+# decision target + reason and, when the trace federation had a
+# completed trace in its exemplar window, exemplar_trace= — the trace
+# id of the slowest request behind the breached percentile, which the
+# postmortem's "slow traces:" section renders as a hop tree
+AUTOSCALE_BREACH = "autoscale_breach"
 # Fleet-scheduler decisions (controller/scheduler.py). Every record
 # carries the action's principals so the postmortem can explain WHY a
 # gang shrank: victim/beneficiary job names, chip targets, and the
@@ -336,6 +343,7 @@ __all__ = ["EventLog", "BoundEventLog", "read_events", "event_files",
            "JOB_CREATED", "GANG_RESTART", "GANG_STUCK", "GANG_DEGRADED",
            "PODS_READY", "FIRST_STEP_OBSERVED",
            "JOB_PACKED", "JOB_RESIZED", "GANG_RESIZE", "LIVE_SCALE",
+           "AUTOSCALE_BREACH",
            "SCHED_QUEUE", "SCHED_PREEMPT", "SCHED_ADMIT",
            "SCHED_GROW_BACK", "SCHED_SKIP", "SCHED_MIGRATE",
            "FIRST_RESUME_STEP", "JOB_SUCCEEDED", "JOB_FAILED"]

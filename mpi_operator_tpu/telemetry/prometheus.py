@@ -109,11 +109,17 @@ class TelemetryServer:
     same request that ships the records, so offset = local_now - now
     holds to within one round trip. read_events tolerates the live
     writer, so a scrape never races a torn record into an error.
+
+    `traces_path` serves GET /traces the same way for the request-trace
+    span log (telemetry/trace.py): same envelope, same clock anchor, so
+    the collector corrects span wall-times with the offsets it already
+    learned from the metrics scrape of the same pod.
     """
 
     def __init__(self, registry: Registry, port: int = 0, host: str = "",
                  healthy: Optional[Callable[[], bool]] = None,
-                 events_path: Optional[str] = None):
+                 events_path: Optional[str] = None,
+                 traces_path: Optional[str] = None):
         outer = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -125,6 +131,12 @@ class TelemetryServer:
                 elif self.path == "/events" and outer.events_path:
                     payload = {"now": time.time(),
                                "records": read_events(outer.events_path)}
+                    body = (json.dumps(payload) + "\n").encode()
+                    self.send_response(200)
+                    self.send_header("Content-Type", "application/json")
+                elif self.path == "/traces" and outer.traces_path:
+                    payload = {"now": time.time(),
+                               "records": read_events(outer.traces_path)}
                     body = (json.dumps(payload) + "\n").encode()
                     self.send_response(200)
                     self.send_header("Content-Type", "application/json")
@@ -147,6 +159,7 @@ class TelemetryServer:
         self.registry = registry
         self.healthy = healthy
         self.events_path = events_path
+        self.traces_path = traces_path
         self._httpd = ThreadingHTTPServer((host, port), Handler)
         self.port = self._httpd.server_address[1]
         self._thread = threading.Thread(

@@ -103,12 +103,16 @@ the collector federates these into ``tpu_job_router_*``):
 """
 from __future__ import annotations
 
+import json
 import threading
+import time
+import urllib.error
+import urllib.request
 from typing import Dict, Optional
 
 from .core import Registry
-from .events import EventLog
-from .prometheus import TelemetryServer
+from .events import EventLog, read_events
+from .prometheus import TelemetryServer, render_registry
 
 
 class TrainTelemetry:
@@ -472,12 +476,24 @@ class WorkerTelemetry:
     """One per worker process: shared registry + lazy train/serve bundles
     + optional /metrics server + optional event log. Both hot loops feed
     the SAME registry, so one scrape shows train and serve series side by
-    side (a worker can do both — e.g. background eval during serving)."""
+    side (a worker can do both — e.g. background eval during serving).
+
+    Two transports, one payload shape. Pull: `serve()` exposes /metrics,
+    /events and /traces for the collector to scrape. Push: `push_report()`
+    bundles the same three bodies (text-format metrics, event records,
+    trace-span records) plus a `now` clock anchor into one JSON dict, and
+    `push(url)` POSTs it — call it on the heartbeat cadence from the same
+    loop that beats the router, so a NAT'd or sidecar-less worker reports
+    without being reachable. JobObservatory.ingest_push accepts the dict
+    with scrape-identical bookkeeping: same staleness convention, same
+    clock correction, same fault-injection surface."""
 
     def __init__(self, registry: Optional[Registry] = None,
-                 events: Optional[EventLog] = None):
+                 events: Optional[EventLog] = None,
+                 traces_path: Optional[str] = None):
         self.registry = registry if registry is not None else Registry()
         self.events = events
+        self.traces_path = traces_path
         self._train: Optional[TrainTelemetry] = None
         self._serving: Optional[ServeTelemetry] = None
         self._server: Optional[TelemetryServer] = None
@@ -503,8 +519,37 @@ class WorkerTelemetry:
             events_path = self.events.path if self.events else None
             self._server = TelemetryServer(
                 self.registry, port=port, host=host, healthy=healthy,
-                events_path=events_path)
+                events_path=events_path, traces_path=self.traces_path)
         return self._server
+
+    def push_report(self) -> Dict[str, object]:
+        """One push payload: the exact bodies the three GET endpoints
+        would serve, in one dict. `now` is sampled here — the collector
+        anchors clock correction on it just as it does for a scrape."""
+        report: Dict[str, object] = {
+            "now": time.time(),
+            "metrics": render_registry(self.registry)}
+        if self.events is not None:
+            self.events.flush()
+            report["events"] = read_events(self.events.path)
+        if self.traces_path:
+            report["traces"] = read_events(self.traces_path)
+        return report
+
+    def push(self, url: str, timeout: float = 5.0) -> bool:
+        """POST push_report() to a collector ingest endpoint. Returns
+        False (never raises) on transport failure — push is best-effort
+        like a missed scrape; the next heartbeat retries with fresher
+        data, and the collector's staleness convention covers the gap."""
+        body = json.dumps(self.push_report()).encode()
+        req = urllib.request.Request(
+            url, data=body, method="POST",
+            headers={"Content-Type": "application/json"})
+        try:
+            with urllib.request.urlopen(req, timeout=timeout) as resp:
+                return 200 <= resp.status < 300
+        except (OSError, ValueError, urllib.error.URLError):
+            return False
 
     @property
     def port(self) -> Optional[int]:

@@ -89,6 +89,17 @@ if [ "${1:-}" = "--serving" ]; then
       cat "$dir/disagg.json"; exit 1
     fi
   done
+  # request tracing: every measured request must reconstruct as a full
+  # prefill -> kv_handoff -> decode span tree, and the kv_handoff hops
+  # must carry the page counts the transfer actually moved
+  if ! grep -q '"disagg_trace_complete": true' "$dir/disagg.json"; then
+    echo "FAIL: a disagg request's span tree is missing a hop (or a root)"
+    cat "$dir/disagg.json"; exit 1
+  fi
+  if grep -q '"disagg_trace_handoff_pages": 0' "$dir/disagg.json"; then
+    echo "FAIL: the kv_handoff hops carry zero moved pages"
+    cat "$dir/disagg.json"; exit 1
+  fi
   echo "serving smoke: OK (disagg A/B token-identical, pool pins held," \
        "$(grep -o '"disagg_handoffs": [0-9]*' "$dir/disagg.json" | grep -o '[0-9]*') handoffs)"
   exit 0
@@ -142,6 +153,13 @@ if [ "${1:-}" = "--router" ]; then
     echo "FAIL: a replica broke the compile-count pins"
     cat "$dir/router.json"; exit 1
   fi
+  # request tracing: every routed request must reconstruct as one
+  # queue_wait -> admission -> prefill -> decode span tree whose hop
+  # durations sum to the root e2e within tolerance
+  if ! grep -q '"router_trace_complete": true' "$dir/router.json"; then
+    echo "FAIL: a routed request's span tree is incomplete or gapped"
+    cat "$dir/router.json"; exit 1
+  fi
   echo "router smoke: OK (token-identical, hit rate" \
        "$(grep -o '"router_affinity_hit_rate": [0-9.]*' "$dir/router.json" | grep -o '[0-9.]*$') vs" \
        "$(grep -o '"router_noaffinity_hit_rate": [0-9.]*' "$dir/router.json" | grep -o '[0-9.]*$') load-only," \
@@ -182,6 +200,12 @@ if [ "${1:-}" = "--router" ]; then
   fi
   if ! grep -q '"livescale_ledger_vs_gang_ok": true' "$dir/livescale.json"; then
     echo "FAIL: live_scale ledger total did not beat the gang-restart total"
+    cat "$dir/livescale.json"; exit 1
+  fi
+  # tracing across the scale steps: failed-over requests must still
+  # reconstruct as ONE contiguous root each
+  if ! grep -q '"livescale_trace_complete": true' "$dir/livescale.json"; then
+    echo "FAIL: a live-arm request's span tree is incomplete across the scale step"
     cat "$dir/livescale.json"; exit 1
   fi
   echo "livescale smoke: OK (ledger" \
@@ -353,8 +377,11 @@ fi
 #   be caught via the frozen token frontier within
 #   progressDeadlineSeconds; request timeouts must leak zero slots and
 #   zero KV pages; bursty (time-varying) scrape faults must neither trip
-#   nor disarm the serving lease; and a mid-trace replica kill behind
-#   the router must lose zero requests — PLUS the fleet-scheduler legs:
+#   nor disarm the serving lease; a mid-trace replica kill behind
+#   the router must lose zero requests; and the same kill under a
+#   sample=1.0 tracer must leave every request's span tree complete
+#   (zero orphans, failovers folded into their roots) — PLUS the
+#   fleet-scheduler legs:
 #   the priority rebalance (preempt -> admit -> grow-back) must converge
 #   under crash-at-every-write with zero double-shrinks and zero lost
 #   admissions, the anti-thrash gate must record an explicit sched_skip
@@ -435,6 +462,16 @@ if [ "${1:-}" = "--chaos" ]; then
   if ! grep -q '"router_failover_lost": 0' "$dir/chaos-$s.json" \
       || grep -q '"router_resubmitted": 0' "$dir/chaos-$s.json"; then
     echo "FAIL: seed $s: the router-failover leg lost or never resubmitted requests"
+    cat "$dir/chaos-$s.json"; exit 1
+  fi
+  # trace completeness under the same kill: every request (shed and
+  # failed-over alike) must reconstruct as ONE rooted span tree with
+  # zero orphaned spans, the failover riding as an event inside the
+  # surviving root, and hop sums within tolerance of the root e2e
+  if ! grep -q '"trace_complete_orphans": 0' "$dir/chaos-$s.json" \
+      || grep -q '"trace_complete_requests": 0' "$dir/chaos-$s.json" \
+      || grep -q '"trace_complete_failover_roots": 0' "$dir/chaos-$s.json"; then
+    echo "FAIL: seed $s: the trace-completeness leg orphaned spans or never ran"
     cat "$dir/chaos-$s.json"; exit 1
   fi
   # live decode-pool scaling under burst scrape faults with the
